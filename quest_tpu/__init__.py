@@ -1,0 +1,55 @@
+"""quest_tpu — a TPU-native exact quantum circuit simulation framework.
+
+A brand-new framework with the capabilities of QuEST (the Quantum Exact
+Simulation Toolkit): dense state-vector and density-matrix simulation of
+universal quantum circuits — the full gate set (arbitrary multi-controlled
+multi-qubit unitaries, rotations, phase gates), decoherence channels
+(dephasing, depolarising, damping, general Kraus maps), measurement and
+collapse, inner-product / fidelity / purity / Pauli-expectation calculations,
+and QASM logging.
+
+Architecture (TPU-first, not a C port):
+  - state:   functional `Qureg` pytree of 2^N complex amplitudes
+             (2^2N for density matrices, via the Choi isomorphism,
+             cf. reference QuEST/src/QuEST.c:8-10)
+  - ops:     gates as tensor contractions on the (2,)*N view of the state;
+             whole circuits trace into ONE XLA program so adjacent gates fuse
+  - parallel: amplitudes sharded over a `jax.sharding.Mesh`; the reference's
+             MPI_Sendrecv pair exchange (QuEST_cpu_distributed.c:481-509)
+             becomes `lax.ppermute` over ICI, MPI_Allreduce becomes `lax.psum`
+  - api:     a QuEST-compatible eager layer exposing the reference's ~106
+             public functions (QuEST/include/QuEST.h) over the functional core
+"""
+
+from quest_tpu.precision import (
+    get_default_dtype,
+    set_default_dtype,
+    real_eps,
+    real_dtype_of,
+)
+from quest_tpu.state import (
+    Qureg,
+    create_qureg,
+    create_density_qureg,
+    init_blank_state,
+    init_zero_state,
+    init_plus_state,
+    init_classical_state,
+    init_debug_state,
+    init_pure_state,
+    init_state_from_amps,
+    set_amps,
+    set_density_amps,
+    clone,
+    get_amp,
+    get_density_amp,
+)
+from quest_tpu.env import QuESTEnv, create_quest_env
+from quest_tpu.validation import QuESTError
+
+from quest_tpu.ops import gates
+from quest_tpu import calculations
+from quest_tpu import measurement
+from quest_tpu.circuit import Circuit
+
+__version__ = "0.1.0"

@@ -1,0 +1,209 @@
+"""Circuit abstraction: trace a whole gate sequence into ONE XLA program.
+
+The reference dispatches each gate eagerly into a fresh kernel launch
+(QuEST.c validate->dispatch per call). On TPU the idiomatic — and much
+faster — shape is to trace the entire circuit under one jit so XLA fuses
+adjacent elementwise/diagonal gates, keeps the state resident in HBM/VMEM,
+and (with donation) updates it in place. This is a genuine capability the
+reference architecture cannot express, and the main single-chip perf lever
+(SURVEY.md section 7 step 8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import cplx
+from quest_tpu.ops import apply as A
+from quest_tpu.ops import matrices as M
+from quest_tpu.state import Qureg
+
+
+@dataclasses.dataclass(frozen=True)
+class GateOp:
+    kind: str                 # 'matrix' | 'diagonal' | 'parity' | 'allones'
+    targets: Tuple[int, ...]
+    controls: Tuple[int, ...] = ()
+    cstates: Tuple[int, ...] = ()
+    operand: object = None    # matrix / diag vector / angle / phase term
+
+
+def _apply_op(amps, n, density, op: GateOp):
+    operand = op.operand
+    if op.kind == "parity":
+        amps = A.apply_parity_phase(amps, n, op.targets, operand)
+        if density:
+            s = n // 2
+            amps = A.apply_parity_phase(
+                amps, n, tuple(t + s for t in op.targets), -operand)
+        return amps
+    if op.kind == "allones":
+        term = cplx.unpack(cplx.pack(operand), amps.dtype)
+        amps = A.apply_phase_on_all_ones(amps, n, op.targets, term)
+        if density:
+            s = n // 2
+            amps = A.apply_phase_on_all_ones(
+                amps, n, tuple(t + s for t in op.targets), jnp.conj(term))
+        return amps
+    fn = A.apply_diagonal if op.kind == "diagonal" else A.apply_matrix
+    mat = cplx.unpack(cplx.pack(operand), amps.dtype)
+    amps = fn(amps, n, mat, op.targets, op.controls, op.cstates)
+    if density:
+        s = n // 2
+        amps = fn(amps, n, jnp.conj(mat),
+                  tuple(t + s for t in op.targets),
+                  tuple(c + s for c in op.controls), op.cstates)
+    return amps
+
+
+class Circuit:
+    """Builder for a fixed gate sequence over `num_qubits` qubits.
+
+    Gate operands are baked into the compiled program as constants; the
+    compiled function is cached per (num_state_qubits, density, dtype).
+    """
+
+    def __init__(self, num_qubits: int):
+        self.num_qubits = num_qubits
+        self.ops: List[GateOp] = []
+        self._compiled = {}
+
+    # -- builders (chainable) ------------------------------------------------
+
+    def _add(self, kind, targets, operand, controls=(), cstates=None):
+        targets = tuple(int(t) for t in targets)
+        controls = tuple(int(c) for c in controls)
+        cstates = tuple(cstates) if cstates is not None else (1,) * len(controls)
+        for qb in targets + controls:
+            if not (0 <= qb < self.num_qubits):
+                raise ValueError(f"qubit {qb} out of range")
+        if len(set(targets)) != len(targets):
+            raise ValueError("target qubits must be unique")
+        if len(set(controls)) != len(controls):
+            raise ValueError("control qubits must be unique")
+        if set(targets) & set(controls):
+            raise ValueError("control and target qubits must be disjoint")
+        self.ops.append(GateOp(kind, targets, controls, cstates, operand))
+        self._compiled.clear()
+        return self
+
+    def gate(self, matrix, targets, controls=(), cstates=None):
+        return self._add("matrix", targets, np.asarray(matrix, dtype=np.complex128),
+                         controls, cstates)
+
+    def h(self, t):
+        return self._add("matrix", (t,), M.HADAMARD)
+
+    def x(self, t, *controls):
+        return self._add("matrix", (t,), M.PAULI_X, controls)
+
+    def y(self, t):
+        return self._add("matrix", (t,), M.PAULI_Y)
+
+    def z(self, t):
+        return self._add("diagonal", (t,), M.Z_DIAG)
+
+    def s(self, t):
+        return self._add("diagonal", (t,), M.S_DIAG)
+
+    def t(self, tq):
+        return self._add("diagonal", (tq,), M.T_DIAG)
+
+    def phase(self, t, angle):
+        return self._add("diagonal", (t,),
+                         np.array([1.0, np.exp(1j * angle)]))
+
+    def rx(self, t, angle):
+        return self._add("matrix", (t,), np.asarray(M.rotation(angle, (1., 0., 0.))))
+
+    def ry(self, t, angle):
+        return self._add("matrix", (t,), np.asarray(M.rotation(angle, (0., 1., 0.))))
+
+    def rz(self, t, angle):
+        return self._add("parity", (t,), float(angle))
+
+    def cnot(self, control, target):
+        return self._add("matrix", (target,), M.PAULI_X, (control,))
+
+    def cz(self, q1, q2):
+        return self._add("allones", (q1, q2), -1.0 + 0.0j)
+
+    def swap(self, q1, q2):
+        return self._add("matrix", (q1, q2), M.SWAP)
+
+    def multi_rotate_z(self, targets, angle):
+        return self._add("parity", tuple(targets), float(angle))
+
+    # -- compilation & execution --------------------------------------------
+
+    def trace(self, amps, n: int, density: bool):
+        """Apply all ops to raw amplitudes inside an existing trace."""
+        for op in self.ops:
+            amps = _apply_op(amps, n, density, op)
+        return amps
+
+    def compiled(self, n: int, density: bool, donate: bool = True):
+        key = (n, density, donate)
+        fn = self._compiled.get(key)
+        if fn is None:
+            def run(amps):
+                return self.trace(amps, n, density)
+            fn = jax.jit(run, donate_argnums=(0,) if donate else ())
+            self._compiled[key] = fn
+        return fn
+
+    def apply(self, q: Qureg, donate: bool = False) -> Qureg:
+        """Apply the circuit to a register (donate=True invalidates q)."""
+        n = q.num_state_qubits
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        return q.replace_amps(self.compiled(n, q.is_density, donate)(q.amps))
+
+
+# ---------------------------------------------------------------------------
+# Benchmark circuit generators
+# ---------------------------------------------------------------------------
+
+
+def random_circuit(num_qubits: int, depth: int, seed: int = 0,
+                   entangler: str = "cz") -> Circuit:
+    """RCS-style benchmark circuit: layers of random single-qubit rotations
+    followed by a brick pattern of entangling gates (BASELINE.json config
+    '30-qubit random-circuit-sampling statevector')."""
+    rng = np.random.default_rng(seed)
+    c = Circuit(num_qubits)
+    for d in range(depth):
+        for q in range(num_qubits):
+            angle = float(rng.uniform(0, 2 * np.pi))
+            kind = rng.integers(0, 3)
+            if kind == 0:
+                c.rx(q, angle)
+            elif kind == 1:
+                c.ry(q, angle)
+            else:
+                c.rz(q, angle)
+        start = d % 2
+        for q in range(start, num_qubits - 1, 2):
+            if entangler == "cz":
+                c.cz(q, q + 1)
+            else:
+                c.cnot(q, q + 1)
+    return c
+
+
+def qft_circuit(num_qubits: int) -> Circuit:
+    """Quantum Fourier transform (BASELINE.json config 'distributed QFT')."""
+    c = Circuit(num_qubits)
+    for q in reversed(range(num_qubits)):
+        c.h(q)
+        for j in range(q):
+            angle = np.pi / (1 << (q - j))
+            c._add("allones", (j, q), np.exp(1j * angle))
+    for q in range(num_qubits // 2):
+        c.swap(q, num_qubits - 1 - q)
+    return c

@@ -1,0 +1,33 @@
+"""Device->host transfer helpers.
+
+Some TPU runtimes (notably the axon PJRT plugin used in this environment)
+cannot transfer complex-dtype buffers to the host, while float transfers
+work. Every host fetch of complex amplitudes therefore goes through a
+jitted split into (real, imag) float pairs, reassembled in numpy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _split(x):
+    return jnp.real(x), jnp.imag(x)
+
+
+def fetch(x) -> np.ndarray:
+    """device_get that is safe for complex arrays on any backend."""
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return np.asarray(jax.device_get(x))
+    re, im = _split(x)
+    re = np.asarray(jax.device_get(re))
+    im = np.asarray(jax.device_get(im))
+    return re + 1j * im
+
+
+def fetch_scalar(x) -> complex:
+    return complex(fetch(x).reshape(()))

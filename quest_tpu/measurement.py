@@ -1,0 +1,136 @@
+"""Measurement: outcome probabilities, collapse, and sampling.
+
+Mirrors the reference's semantics (QuEST_common.c:154-169, 360-374;
+QuEST_cpu.c:3111-3495): the outcome probability is a psum-style reduction,
+the outcome is drawn from the seeded host RNG (identical on every shard),
+and collapse renormalizes the kept amplitudes (by 1/sqrt(p) for
+statevectors, by 1/p for density matrices) while zeroing the rest.
+
+A fully-traced variant (`measure_functional`) keeps measurement inside jit
+using a jax.random key and lax-free branchless collapse, for circuit-level
+compilation on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from quest_tpu import precision
+from quest_tpu import random_ as rng
+from quest_tpu import validation as val
+from quest_tpu.state import Qureg
+
+
+def _bit_values(n: int, qubit: int):
+    """(2,)*n-broadcastable tensor holding bit `qubit` of each flat index."""
+    shape = [1] * n
+    shape[n - 1 - qubit] = 2
+    return jnp.arange(2).reshape(shape)
+
+
+@partial(jax.jit, static_argnames=("n", "qubit", "density"))
+def _prob_of_zero(amps, *, n, qubit, density):
+    if density:
+        # probability from the diagonal: rho[k,k] with bit `qubit` of k == 0
+        # (ref densmatr_findProbabilityOfZeroLocal, QuEST_cpu.c:3111-3157)
+        dim = 1 << (n // 2)
+        d = jnp.diagonal(amps.reshape((dim, dim)))  # diag is transpose-proof
+        k = jnp.arange(dim)
+        keep = ((k >> qubit) & 1) == 0
+        return jnp.sum(jnp.where(keep, d.real, 0.0))
+    t = amps.reshape((2,) * n)
+    keep = _bit_values(n, qubit) == 0
+    return jnp.sum(jnp.where(keep, (t.real ** 2 + t.imag ** 2), 0.0))
+
+
+@partial(jax.jit, static_argnames=("n", "qubit", "density"))
+def _collapse(amps, outcome, prob, *, n, qubit, density):
+    t = amps.reshape((2,) * n)
+    rdt = amps.real.dtype
+    prob = jnp.asarray(prob, dtype=rdt)
+    if density:
+        nq = n // 2
+        keep = (_bit_values(n, qubit) == outcome) & \
+               (_bit_values(n, qubit + nq) == outcome)
+        renorm = 1.0 / prob
+    else:
+        keep = _bit_values(n, qubit) == outcome
+        renorm = jax.lax.rsqrt(prob)
+    # branch-free masked renormalize (complex x real; no complex constants)
+    out = t * (keep.astype(rdt) * renorm)
+    return out.reshape(-1)
+
+
+def calc_prob_of_outcome(q: Qureg, qubit: int, outcome: int) -> float:
+    val.validate_target(q, qubit)
+    val.validate_outcome(outcome)
+    p0 = _prob_of_zero(q.amps, n=q.num_state_qubits, qubit=qubit,
+                       density=q.is_density)
+    return float(p0) if outcome == 0 else float(1.0 - p0)
+
+
+def collapse_to_outcome(q: Qureg, qubit: int, outcome: int) -> Tuple[Qureg, float]:
+    """Project onto `outcome` and renormalize; returns (state, prob)."""
+    val.validate_target(q, qubit)
+    val.validate_outcome(outcome)
+    prob = calc_prob_of_outcome(q, qubit, outcome)
+    val.validate_measurement_prob(prob, precision.real_eps(q.dtype))
+    amps = _collapse(q.amps, jnp.asarray(outcome),
+                     jnp.asarray(prob, dtype=precision.real_dtype_of(q.dtype)),
+                     n=q.num_state_qubits, qubit=qubit, density=q.is_density)
+    return q.replace_amps(amps), prob
+
+
+def measure_with_stats(q: Qureg, qubit: int) -> Tuple[Qureg, int, float]:
+    """Sample an outcome, collapse, return (state, outcome, outcomeProb)
+    (ref statevec_measureWithStats, QuEST_common.c:360-366)."""
+    val.validate_target(q, qubit)
+    eps = precision.real_eps(q.dtype)
+    zero_prob = calc_prob_of_outcome(q, qubit, 0)
+    # identical draw on every shard (ref generateMeasurementOutcome)
+    if zero_prob < eps:
+        outcome = 1
+    elif 1 - zero_prob < eps:
+        outcome = 0
+    else:
+        outcome = int(rng.uniform() > zero_prob)
+    prob = zero_prob if outcome == 0 else 1 - zero_prob
+    amps = _collapse(q.amps, jnp.asarray(outcome),
+                     jnp.asarray(prob, dtype=precision.real_dtype_of(q.dtype)),
+                     n=q.num_state_qubits, qubit=qubit, density=q.is_density)
+    return q.replace_amps(amps), outcome, prob
+
+
+def measure(q: Qureg, qubit: int) -> Tuple[Qureg, int]:
+    q, outcome, _ = measure_with_stats(q, qubit)
+    return q, outcome
+
+
+@partial(jax.jit, static_argnames=("n", "qubit", "density"))
+def _measure_traced(amps, key, *, n, qubit, density):
+    p0 = _prob_of_zero(amps, n=n, qubit=qubit, density=density)
+    eps = jnp.asarray(precision.real_eps(jnp.float32), dtype=p0.dtype)
+    u = jax.random.uniform(key, dtype=p0.dtype)
+    # force the outcome when one branch has (numerically) zero probability,
+    # like the host path (ref generateMeasurementOutcome, QuEST_common.c:154)
+    outcome = jnp.where(p0 < eps, 1,
+                        jnp.where(1.0 - p0 < eps, 0,
+                                  (u > p0).astype(jnp.int32)))
+    prob = jnp.where(outcome == 0, p0, 1.0 - p0)
+    prob = jnp.maximum(prob, eps)  # collapse never divides by zero
+    new = _collapse(amps, outcome, prob, n=n, qubit=qubit, density=density)
+    return new, outcome, prob
+
+
+def measure_functional(q: Qureg, qubit: int, key) -> Tuple[Qureg, jax.Array, jax.Array]:
+    """Fully-traced measurement for use inside jitted circuits: outcome and
+    probability are device values; the RNG is an explicit jax.random key
+    (TPU-native improvement over the reference's host RNG)."""
+    val.validate_target(q, qubit)
+    amps, outcome, prob = _measure_traced(
+        q.amps, key, n=q.num_state_qubits, qubit=qubit, density=q.is_density)
+    return q.replace_amps(amps), outcome, prob

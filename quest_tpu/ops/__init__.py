@@ -1,0 +1,1 @@
+from quest_tpu.ops import apply, matrices, gates, channels

@@ -1,0 +1,241 @@
+"""Decoherence channels on density-matrix registers.
+
+The analytic channels (dephasing / depolarising / damping) act elementwise
+or pairwise on the doubled register and compile to fused masked multiplies;
+general Kraus maps become a superoperator Sum_k conj(K) (x) K applied as a
+2k-qubit operator on [targets, targets + N] — the same reduction the
+reference performs (QuEST_common.c:540-673), but running through the one
+general tensor-contraction apply path.
+
+Superoperators are assembled INSIDE the trace from real/imaginary float
+parts (complex data never crosses the host<->device boundary; float
+constants are fine — see quest_tpu.cplx).
+
+Reference semantics (QuEST.h decoherence doc-group):
+  mixDephasing(p):      rho -> (1-p) rho + p Z rho Z                (p <= 1/2)
+  mixTwoQubitDephasing: rho -> (1-p) rho + p/3 (Z1 + Z2 + Z1Z2 terms) (p <= 3/4)
+  mixDepolarising(p):   rho -> (1-p) rho + p/3 (X+Y+Z terms)        (p <= 3/4)
+  mixTwoQubitDepolarising: uniform over the 15 non-identity 2q Paulis (p <= 15/16)
+  mixDamping(p):        K0 = [[1,0],[0,sqrt(1-p)]], K1 = [[0,sqrt(p)],[0,0]]
+  mixPauli(px,py,pz):   4-op Kraus map (ref QuEST_common.c:675-695)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import cplx
+from quest_tpu import validation as val
+from quest_tpu.ops import apply as A
+from quest_tpu.ops import matrices as M
+from quest_tpu.state import Qureg
+
+
+def _bit(n, q):
+    shape = [1] * n
+    shape[n - 1 - q] = 2
+    return jnp.arange(2).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# dephasing: pure elementwise factors on mismatched row/col bits
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n", "target"))
+def _dephase_one(amps, fac, *, n, target):
+    t = amps.reshape((2,) * n)
+    differ = _bit(n, target) != _bit(n, target + n // 2)
+    out = jnp.where(differ, t * fac, t)
+    return out.reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("n", "t1", "t2"))
+def _dephase_two(amps, fac, *, n, t1, t2):
+    t = amps.reshape((2,) * n)
+    nq = n // 2
+    differ = (_bit(n, t1) != _bit(n, t1 + nq)) | (_bit(n, t2) != _bit(n, t2 + nq))
+    out = jnp.where(differ, t * fac, t)
+    return out.reshape(-1)
+
+
+def mix_dephasing(q: Qureg, target: int, prob) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_target(q, target)
+    val.validate_one_qubit_dephase_prob(float(prob))
+    fac = jnp.asarray(1.0 - 2.0 * float(prob), dtype=cplx.real_dtype(q.dtype))
+    return q.replace_amps(_dephase_one(q.amps, fac, n=q.num_state_qubits,
+                                       target=int(target)))
+
+
+def mix_two_qubit_dephasing(q: Qureg, t1: int, t2: int, prob) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_multi_targets(q, (t1, t2))
+    val.validate_two_qubit_dephase_prob(float(prob))
+    fac = jnp.asarray(1.0 - 4.0 * float(prob) / 3.0, dtype=cplx.real_dtype(q.dtype))
+    return q.replace_amps(_dephase_two(q.amps, fac, n=q.num_state_qubits,
+                                       t1=int(t1), t2=int(t2)))
+
+
+# ---------------------------------------------------------------------------
+# depolarising / damping / Kraus: superoperator on [targets, targets+N]
+# ---------------------------------------------------------------------------
+
+# Sum over all Pauli tensor-products of conj(P) (x) P, split into float
+# real/imag constants (safe to bake into traced programs).
+def _pauli_twirl_matrix(num_qubits: int) -> np.ndarray:
+    dim = 1 << num_qubits
+    acc = np.zeros((dim * dim, dim * dim), dtype=np.complex128)
+    paulis = M.PAULIS
+    if num_qubits == 1:
+        group = list(paulis)
+    else:
+        # matrix bit 0 = first target => first target is the LSB factor
+        group = [np.kron(p2, p1) for p2 in paulis for p1 in paulis]
+    for p in group:
+        acc += np.kron(np.conj(p), p)
+    return acc
+
+
+_TWIRL1_RE, _TWIRL1_IM = cplx.pack(_pauli_twirl_matrix(1))
+_TWIRL2_RE, _TWIRL2_IM = cplx.pack(_pauli_twirl_matrix(2))
+
+
+def _superop_targets(targets, nq):
+    return tuple(targets) + tuple(t + nq for t in targets)
+
+
+@partial(jax.jit, static_argnames=("n", "targets"))
+def _apply_packed_superop(amps, sup_pair, *, n, targets):
+    sup = cplx.unpack(sup_pair, amps.dtype)
+    return A.apply_matrix(amps, n, sup, _superop_targets(targets, n // 2))
+
+
+@partial(jax.jit, static_argnames=("n", "target"))
+def _depol_one(amps, p, *, n, target):
+    rdt = amps.real.dtype
+    p = jnp.asarray(p, dtype=rdt)
+    eye = jnp.eye(4, dtype=rdt)
+    sup_re = (1.0 - p) * eye + (p / 3.0) * (jnp.asarray(_TWIRL1_RE, rdt) - eye)
+    sup_im = (p / 3.0) * jnp.asarray(_TWIRL1_IM, rdt)
+    sup = cplx.make(sup_re, sup_im)
+    return A.apply_matrix(amps, n, sup.astype(amps.dtype),
+                          _superop_targets((target,), n // 2))
+
+
+@partial(jax.jit, static_argnames=("n", "t1", "t2"))
+def _depol_two(amps, p, *, n, t1, t2):
+    rdt = amps.real.dtype
+    p = jnp.asarray(p, dtype=rdt)
+    eye = jnp.eye(16, dtype=rdt)
+    sup_re = (1.0 - p) * eye + (p / 15.0) * (jnp.asarray(_TWIRL2_RE, rdt) - eye)
+    sup_im = (p / 15.0) * jnp.asarray(_TWIRL2_IM, rdt)
+    sup = cplx.make(sup_re, sup_im)
+    return A.apply_matrix(amps, n, sup.astype(amps.dtype),
+                          _superop_targets((t1, t2), n // 2))
+
+
+@partial(jax.jit, static_argnames=("n", "target"))
+def _damping(amps, p, *, n, target):
+    rdt = amps.real.dtype
+    p = jnp.asarray(p, dtype=rdt)
+    s = jnp.sqrt(1.0 - p)
+    # superop = conj(K0) (x) K0 + conj(K1) (x) K1 — all entries real:
+    # rows/cols over (col-bit, row-bit):
+    #   [[1, 0, 0, p], [0, s, 0, 0], [0, 0, s, 0], [0, 0, 0, 1-p]]
+    zero = jnp.zeros_like(p)
+    one = jnp.ones_like(p)
+    sup_re = jnp.stack([
+        jnp.stack([one, zero, zero, p]),
+        jnp.stack([zero, s, zero, zero]),
+        jnp.stack([zero, zero, s, zero]),
+        jnp.stack([zero, zero, zero, one - p]),
+    ])
+    sup = cplx.make(sup_re, jnp.zeros_like(sup_re))
+    return A.apply_matrix(amps, n, sup.astype(amps.dtype),
+                          _superop_targets((target,), n // 2))
+
+
+def _mix_packed(q: Qureg, targets, sup_np) -> Qureg:
+    """Apply a concrete superoperator (numpy complex) via float packing."""
+    return q.replace_amps(_apply_packed_superop(
+        q.amps, cplx.pack(sup_np), n=q.num_state_qubits,
+        targets=tuple(int(t) for t in targets)))
+
+
+def mix_depolarising(q: Qureg, target: int, prob) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_target(q, target)
+    val.validate_one_qubit_depol_prob(float(prob))
+    return q.replace_amps(_depol_one(q.amps, float(prob),
+                                     n=q.num_state_qubits, target=int(target)))
+
+
+def mix_two_qubit_depolarising(q: Qureg, t1: int, t2: int, prob) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_multi_targets(q, (t1, t2))
+    val.validate_two_qubit_depol_prob(float(prob))
+    return q.replace_amps(_depol_two(q.amps, float(prob),
+                                     n=q.num_state_qubits, t1=int(t1), t2=int(t2)))
+
+
+def mix_damping(q: Qureg, target: int, prob) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_target(q, target)
+    val.validate_one_qubit_damping_prob(float(prob))
+    return q.replace_amps(_damping(q.amps, float(prob),
+                                   n=q.num_state_qubits, target=int(target)))
+
+
+def mix_pauli(q: Qureg, target: int, prob_x, prob_y, prob_z) -> Qureg:
+    """4-op Kraus map from Pauli error probabilities
+    (ref densmatr_mixPauli, QuEST_common.c:675-695)."""
+    val.validate_density_matr(q)
+    val.validate_target(q, target)
+    val.validate_pauli_probs(float(prob_x), float(prob_y), float(prob_z))
+    pi = 1.0 - float(prob_x) - float(prob_y) - float(prob_z)
+    ops = [np.sqrt(pi) * M.PAULI_I, np.sqrt(float(prob_x)) * M.PAULI_X,
+           np.sqrt(float(prob_y)) * M.PAULI_Y, np.sqrt(float(prob_z)) * M.PAULI_Z]
+    return _mix_packed(q, (target,), M.kraus_superoperator(ops))
+
+
+def mix_kraus_map(q: Qureg, target: int, ops: Sequence) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_target(q, target)
+    val.validate_kraus_ops(ops, 1, max_ops=4)
+    return _mix_packed(q, (target,), M.kraus_superoperator(ops))
+
+
+def mix_two_qubit_kraus_map(q: Qureg, t1: int, t2: int, ops: Sequence) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_multi_targets(q, (t1, t2))
+    val.validate_kraus_ops(ops, 2, max_ops=16)
+    return _mix_packed(q, (t1, t2), M.kraus_superoperator(ops))
+
+
+def mix_multi_qubit_kraus_map(q: Qureg, targets: Sequence[int], ops: Sequence) -> Qureg:
+    val.validate_density_matr(q)
+    val.validate_multi_targets(q, targets)
+    k = len(tuple(targets))
+    val.validate_kraus_ops(ops, k, max_ops=(1 << (2 * k)))
+    return _mix_packed(q, tuple(targets), M.kraus_superoperator(ops))
+
+
+@jax.jit
+def _mix_combine(a, b, p):
+    return a + p * (b - a)
+
+
+def mix_density_matrix(q: Qureg, prob, other: Qureg) -> Qureg:
+    """rho -> (1-p) rho + p sigma (ref densmatr_mixDensityMatrix)."""
+    val.validate_density_matr(q)
+    val.validate_density_matr(other)
+    val.validate_match(q, other)
+    val.validate_prob(float(prob))
+    p = jnp.asarray(float(prob), dtype=cplx.real_dtype(q.dtype))
+    return q.replace_amps(_mix_combine(q.amps, other.amps.astype(q.dtype), p))

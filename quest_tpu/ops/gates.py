@@ -1,0 +1,444 @@
+"""Functional gate layer: Qureg -> Qureg operations for the full gate set.
+
+Each public QuEST gate (QuEST/include/QuEST.h doc-groups "unitaries" and
+"operators") has a functional equivalent here. Density matrices are handled
+exactly as the reference does (QuEST/src/QuEST.c:8-10): a gate U on targets T
+of a density register additionally applies conj(U) on the column-space copy
+T + N (Choi isomorphism) — both halves are traced into ONE jitted program.
+
+Compilation caching: workers are jitted with static (n, targets, controls)
+and dynamic gate parameters, so e.g. rotating qubit 3 by a new angle reuses
+the compiled program. Parameterized operators are built INSIDE the trace by
+a static builder callable from real-valued parameters; concrete matrices are
+passed as (re, im) float pairs (complex data never crosses the host<->device
+boundary — see quest_tpu.cplx).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quest_tpu import cplx
+from quest_tpu import validation as val
+from quest_tpu.ops import apply as A
+from quest_tpu.ops import matrices as M
+from quest_tpu.state import Qureg
+
+# ---------------------------------------------------------------------------
+# jitted workers
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=(
+    "n", "targets", "controls", "cstates", "density", "builder", "diagonal"))
+def _gate_worker(amps, params, *, n, targets, controls, cstates, density,
+                 builder, diagonal):
+    if builder is not None:
+        op = builder(*[jnp.asarray(p) for p in params])
+    else:
+        op = cplx.unpack(params, amps.dtype)
+    op = op.astype(amps.dtype)
+    fn = A.apply_diagonal if diagonal else A.apply_matrix
+    amps = fn(amps, n, op, targets, controls, cstates)
+    if density:
+        shift = n // 2
+        s_targets = tuple(t + shift for t in targets)
+        s_controls = tuple(c + shift for c in controls)
+        amps = fn(amps, n, jnp.conj(op), s_targets, s_controls, cstates)
+    return amps
+
+
+@partial(jax.jit, static_argnames=("n", "targets", "density"))
+def _parity_phase_worker(amps, angle, *, n, targets, density):
+    amps = A.apply_parity_phase(amps, n, targets, angle)
+    if density:
+        shift = n // 2
+        s_targets = tuple(t + shift for t in targets)
+        amps = A.apply_parity_phase(amps, n, s_targets, -angle)
+    return amps
+
+
+@partial(jax.jit, static_argnames=("n", "qubits", "density"))
+def _all_ones_phase_worker(amps, term_re, term_im, *, n, qubits, density):
+    term = cplx.make(jnp.asarray(term_re), jnp.asarray(term_im)).astype(amps.dtype)
+    amps = A.apply_phase_on_all_ones(amps, n, qubits, term)
+    if density:
+        shift = n // 2
+        s_qubits = tuple(q + shift for q in qubits)
+        amps = A.apply_phase_on_all_ones(amps, n, s_qubits, jnp.conj(term))
+    return amps
+
+
+def _run(q: Qureg, op, targets, controls=(), cstates=None, builder=None,
+         diagonal=False) -> Qureg:
+    """Dispatch one gate. `op` is a concrete numpy complex matrix/diagonal
+    when builder is None, else a tuple of real scalar parameters."""
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
+    cstates = tuple(int(s) for s in cstates) if cstates is not None \
+        else (1,) * len(controls)
+    if builder is None:
+        op = cplx.pack(op)
+    amps = _gate_worker(
+        q.amps, op, n=q.num_state_qubits, targets=targets, controls=controls,
+        cstates=cstates, density=q.is_density, builder=builder,
+        diagonal=diagonal)
+    return q.replace_amps(amps)
+
+
+def _phase_all_ones(q: Qureg, qubits, term_re, term_im) -> Qureg:
+    amps = _all_ones_phase_worker(
+        q.amps, term_re, term_im, n=q.num_state_qubits,
+        qubits=tuple(int(x) for x in qubits), density=q.is_density)
+    return q.replace_amps(amps)
+
+
+# ---------------------------------------------------------------------------
+# traced builders (module-level for stable jit cache keys; all parameters
+# are real scalars, all complex values assembled via lax.complex)
+# ---------------------------------------------------------------------------
+
+
+def _assemble_compact(alpha, beta):
+    """[[alpha, -conj(beta)], [beta, conj(alpha)]] from traced complex."""
+    row0 = jnp.stack([alpha, -jnp.conj(beta)])
+    row1 = jnp.stack([beta, jnp.conj(alpha)])
+    return jnp.stack([row0, row1])
+
+
+def _build_compact(a_re, a_im, b_re, b_im):
+    alpha = cplx.make(a_re, a_im)
+    beta = cplx.make(b_re, b_im)
+    return _assemble_compact(alpha, beta)
+
+
+def _build_rotation(angle, ax, ay, az):
+    """cos(t/2) I - i sin(t/2) (n . sigma) via the reference's (alpha, beta)
+    parameterization (QuEST_common.c:114-122)."""
+    norm = jnp.sqrt(ax * ax + ay * ay + az * az)
+    ux, uy, uz = ax / norm, ay / norm, az / norm
+    half = angle / 2.0
+    c, s = jnp.cos(half), jnp.sin(half)
+    alpha = cplx.make(c, -s * uz)
+    beta = cplx.make(s * uy, -s * ux)
+    return _assemble_compact(alpha, beta)
+
+
+def _build_phase_diag(angle):
+    """diag(1, e^{i angle})."""
+    one = jnp.ones_like(angle)
+    zero = jnp.zeros_like(angle)
+    return cplx.make(jnp.stack([one, jnp.cos(angle)]),
+                     jnp.stack([zero, jnp.sin(angle)]))
+
+
+# ---------------------------------------------------------------------------
+# single-qubit unitaries (ref QuEST.c:109-331)
+# ---------------------------------------------------------------------------
+
+
+def _compact_params(alpha, beta):
+    a, b = complex(alpha), complex(beta)
+    return (a.real, a.imag, b.real, b.imag)
+
+
+def compact_unitary(q: Qureg, target: int, alpha, beta) -> Qureg:
+    val.validate_target(q, target)
+    val.validate_unitary_complex_pair(alpha, beta)
+    return _run(q, _compact_params(alpha, beta), (target,), builder=_build_compact)
+
+
+def controlled_compact_unitary(q: Qureg, control: int, target: int, alpha, beta) -> Qureg:
+    val.validate_control_target(q, control, target)
+    val.validate_unitary_complex_pair(alpha, beta)
+    return _run(q, _compact_params(alpha, beta), (target,), (control,),
+                builder=_build_compact)
+
+
+def unitary(q: Qureg, target: int, matrix) -> Qureg:
+    val.validate_target(q, target)
+    val.validate_unitary(matrix, 1)
+    return _run(q, matrix, (target,))
+
+
+def controlled_unitary(q: Qureg, control: int, target: int, matrix) -> Qureg:
+    val.validate_control_target(q, control, target)
+    val.validate_unitary(matrix, 1)
+    return _run(q, matrix, (target,), (control,))
+
+
+def multi_controlled_unitary(q: Qureg, controls: Sequence[int], target: int, matrix) -> Qureg:
+    val.validate_multi_controls_targets(q, controls, (target,))
+    val.validate_unitary(matrix, 1)
+    return _run(q, matrix, (target,), tuple(controls))
+
+
+def multi_state_controlled_unitary(
+        q: Qureg, controls: Sequence[int], control_states: Sequence[int],
+        target: int, matrix) -> Qureg:
+    val.validate_multi_controls_targets(q, controls, (target,))
+    val.validate_control_states(controls, control_states)
+    val.validate_unitary(matrix, 1)
+    return _run(q, matrix, (target,), tuple(controls), tuple(control_states))
+
+
+def pauli_x(q: Qureg, target: int) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, M.PAULI_X, (target,))
+
+
+def pauli_y(q: Qureg, target: int) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, M.PAULI_Y, (target,))
+
+
+def pauli_z(q: Qureg, target: int) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, M.Z_DIAG, (target,), diagonal=True)
+
+
+def hadamard(q: Qureg, target: int) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, M.HADAMARD, (target,))
+
+
+def s_gate(q: Qureg, target: int) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, M.S_DIAG, (target,), diagonal=True)
+
+
+def t_gate(q: Qureg, target: int) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, M.T_DIAG, (target,), diagonal=True)
+
+
+def phase_shift(q: Qureg, target: int, angle) -> Qureg:
+    val.validate_target(q, target)
+    return _run(q, (float(angle),), (target,), builder=_build_phase_diag,
+                diagonal=True)
+
+
+def controlled_not(q: Qureg, control: int, target: int) -> Qureg:
+    val.validate_control_target(q, control, target)
+    return _run(q, M.PAULI_X, (target,), (control,))
+
+
+def controlled_pauli_y(q: Qureg, control: int, target: int) -> Qureg:
+    val.validate_control_target(q, control, target)
+    return _run(q, M.PAULI_Y, (target,), (control,))
+
+
+# -- rotations ---------------------------------------------------------------
+
+
+def rotate_around_axis(q: Qureg, target: int, angle, axis) -> Qureg:
+    val.validate_target(q, target)
+    val.validate_vector(axis)
+    ax = np.asarray(axis, dtype=np.float64)
+    return _run(q, (float(angle), ax[0], ax[1], ax[2]), (target,),
+                builder=_build_rotation)
+
+
+def rotate_x(q: Qureg, target: int, angle) -> Qureg:
+    return rotate_around_axis(q, target, angle, (1.0, 0.0, 0.0))
+
+
+def rotate_y(q: Qureg, target: int, angle) -> Qureg:
+    return rotate_around_axis(q, target, angle, (0.0, 1.0, 0.0))
+
+
+def rotate_z(q: Qureg, target: int, angle) -> Qureg:
+    return rotate_around_axis(q, target, angle, (0.0, 0.0, 1.0))
+
+
+def controlled_rotate_around_axis(q: Qureg, control: int, target: int, angle, axis) -> Qureg:
+    val.validate_control_target(q, control, target)
+    val.validate_vector(axis)
+    ax = np.asarray(axis, dtype=np.float64)
+    return _run(q, (float(angle), ax[0], ax[1], ax[2]), (target,), (control,),
+                builder=_build_rotation)
+
+
+def controlled_rotate_x(q: Qureg, control: int, target: int, angle) -> Qureg:
+    return controlled_rotate_around_axis(q, control, target, angle, (1.0, 0.0, 0.0))
+
+
+def controlled_rotate_y(q: Qureg, control: int, target: int, angle) -> Qureg:
+    return controlled_rotate_around_axis(q, control, target, angle, (0.0, 1.0, 0.0))
+
+
+def controlled_rotate_z(q: Qureg, control: int, target: int, angle) -> Qureg:
+    return controlled_rotate_around_axis(q, control, target, angle, (0.0, 0.0, 1.0))
+
+
+# -- symmetric phase family --------------------------------------------------
+
+
+def controlled_phase_shift(q: Qureg, qubit1: int, qubit2: int, angle) -> Qureg:
+    val.validate_unique_targets(q, qubit1, qubit2)
+    a = float(angle)
+    return _phase_all_ones(q, (qubit1, qubit2), np.cos(a), np.sin(a))
+
+
+def multi_controlled_phase_shift(q: Qureg, qubits: Sequence[int], angle) -> Qureg:
+    val.validate_multi_targets(q, qubits)
+    a = float(angle)
+    return _phase_all_ones(q, tuple(qubits), np.cos(a), np.sin(a))
+
+
+def controlled_phase_flip(q: Qureg, qubit1: int, qubit2: int) -> Qureg:
+    val.validate_unique_targets(q, qubit1, qubit2)
+    return _phase_all_ones(q, (qubit1, qubit2), -1.0, 0.0)
+
+
+def multi_controlled_phase_flip(q: Qureg, qubits: Sequence[int]) -> Qureg:
+    val.validate_multi_targets(q, qubits)
+    return _phase_all_ones(q, tuple(qubits), -1.0, 0.0)
+
+
+def multi_rotate_z(q: Qureg, qubits: Sequence[int], angle) -> Qureg:
+    val.validate_multi_targets(q, qubits)
+    return q.replace_amps(_parity_phase_worker(
+        q.amps, jnp.asarray(float(angle)), n=q.num_state_qubits,
+        targets=tuple(int(x) for x in qubits), density=q.is_density))
+
+
+def multi_rotate_pauli(q: Qureg, targets: Sequence[int], paulis: Sequence[int],
+                       angle) -> Qureg:
+    """exp(-i angle/2 * P1 x P2 x ...) via basis rotation + multiRotateZ
+    (ref statevec_multiRotatePauli, QuEST_common.c:410-447)."""
+    val.validate_multi_targets(q, targets)
+    val.validate_pauli_targets(targets, paulis)
+    val.validate_pauli_codes(paulis)
+    fac = 1.0 / np.sqrt(2.0)
+    # (alpha, beta) as (re, im) float 4-tuples:
+    # Rx(pi/2)* rotates Z -> Y : alpha = fac, beta = -i fac
+    rx = (fac, 0.0, 0.0, -fac)
+    # Ry(-pi/2) rotates Z -> X : alpha = fac, beta = -fac
+    ry = (fac, 0.0, -fac, 0.0)
+    rx_undo = (fac, 0.0, 0.0, fac)
+    ry_undo = (fac, 0.0, fac, 0.0)
+    z_targets = []
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == 0:
+            continue
+        z_targets.append(int(t))
+        if p == 1:
+            q = _run(q, ry, (t,), builder=_build_compact)
+        elif p == 2:
+            q = _run(q, rx, (t,), builder=_build_compact)
+    if z_targets:
+        q = q.replace_amps(_parity_phase_worker(
+            q.amps, jnp.asarray(float(angle)), n=q.num_state_qubits,
+            targets=tuple(z_targets), density=q.is_density))
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == 1:
+            q = _run(q, ry_undo, (t,), builder=_build_compact)
+        elif p == 2:
+            q = _run(q, rx_undo, (t,), builder=_build_compact)
+    return q
+
+
+# -- multi-qubit unitaries ---------------------------------------------------
+
+
+def swap_gate(q: Qureg, qubit1: int, qubit2: int) -> Qureg:
+    val.validate_unique_targets(q, qubit1, qubit2)
+    return _run(q, M.SWAP, (qubit1, qubit2))
+
+
+def sqrt_swap_gate(q: Qureg, qubit1: int, qubit2: int) -> Qureg:
+    val.validate_unique_targets(q, qubit1, qubit2)
+    return _run(q, M.SQRT_SWAP, (qubit1, qubit2))
+
+
+def two_qubit_unitary(q: Qureg, target1: int, target2: int, matrix) -> Qureg:
+    val.validate_multi_targets(q, (target1, target2))
+    val.validate_unitary(matrix, 2)
+    return _run(q, matrix, (target1, target2))
+
+
+def controlled_two_qubit_unitary(q: Qureg, control: int, target1: int,
+                                 target2: int, matrix) -> Qureg:
+    val.validate_multi_controls_targets(q, (control,), (target1, target2))
+    val.validate_unitary(matrix, 2)
+    return _run(q, matrix, (target1, target2), (control,))
+
+
+def multi_controlled_two_qubit_unitary(q: Qureg, controls: Sequence[int],
+                                       target1: int, target2: int, matrix) -> Qureg:
+    val.validate_multi_controls_targets(q, controls, (target1, target2))
+    val.validate_unitary(matrix, 2)
+    return _run(q, matrix, (target1, target2), tuple(controls))
+
+
+def multi_qubit_unitary(q: Qureg, targets: Sequence[int], matrix) -> Qureg:
+    val.validate_multi_targets(q, targets)
+    val.validate_unitary(matrix, len(tuple(targets)))
+    return _run(q, matrix, tuple(targets))
+
+
+def controlled_multi_qubit_unitary(q: Qureg, control: int,
+                                   targets: Sequence[int], matrix) -> Qureg:
+    val.validate_multi_controls_targets(q, (control,), targets)
+    val.validate_unitary(matrix, len(tuple(targets)))
+    return _run(q, matrix, tuple(targets), (control,))
+
+
+def multi_controlled_multi_qubit_unitary(q: Qureg, controls: Sequence[int],
+                                         targets: Sequence[int], matrix) -> Qureg:
+    val.validate_multi_controls_targets(q, controls, targets)
+    val.validate_unitary(matrix, len(tuple(targets)))
+    return _run(q, matrix, tuple(targets), tuple(controls))
+
+
+# -- non-unitary helpers -----------------------------------------------------
+
+
+def apply_pauli_prod(q: Qureg, targets: Sequence[int], paulis: Sequence[int]) -> Qureg:
+    """Left-multiply by a product of Pauli operators (possibly non-trace-
+    preserving on density matrices; ref statevec_applyPauliProd,
+    QuEST_common.c:450-461). NOTE: on density registers this multiplies the
+    ROW space only (P rho, not P rho P+), exactly like the reference."""
+    val.validate_pauli_targets(targets, paulis)
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == 0:
+            continue
+        mat = cplx.unpack(cplx.pack(M.PAULIS[p]), q.dtype)
+        amps = A.apply_matrix(q.amps, q.num_state_qubits, mat, (int(t),))
+        q = q.replace_amps(amps)
+    return q
+
+
+@jax.jit
+def _weighted_sum(a1, a2, a_out, f1, f2, f_out):
+    return f1 * a1 + f2 * a2 + f_out * a_out
+
+
+def set_weighted_qureg(fac1, q1: Qureg, fac2, q2: Qureg, fac_out, out: Qureg) -> Qureg:
+    """out = fac1*q1 + fac2*q2 + facOut*out (ref QuEST_cpu.c:3579-3620)."""
+    val.validate_match(q1, q2)
+    val.validate_match(q1, out)
+    if not (q1.is_density == q2.is_density == out.is_density):
+        raise val.QuESTError("Invalid Qureg pair: types must match.")
+    dt = out.dtype
+    rdt = cplx.real_dtype(dt)
+
+    def scal(f):
+        f = complex(f)
+        return cplx.make(jnp.asarray(f.real, dtype=rdt),
+                         jnp.asarray(f.imag, dtype=rdt))
+
+    amps = _weighted_sum(
+        q1.amps.astype(dt), q2.amps.astype(dt), out.amps,
+        scal(fac1), scal(fac2), scal(fac_out))
+    return out.replace_amps(amps)

@@ -1,0 +1,59 @@
+"""Precision policy.
+
+The reference fixes precision at compile time (QuEST/include/QuEST_precision.h:
+QuEST_PREC in {1,2,4} -> qreal in {float, double, long double}, with
+REAL_EPS = 1e-5 / 1e-13 / 1e-14). On TPU, precision is a runtime dtype choice:
+complex64 is the fast native path (f32 pairs on the VPU/MXU), complex128 is
+available for CPU verification and high-accuracy runs (requires
+jax_enable_x64). There is no quad-precision analogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_COMPLEX_DTYPES = (jnp.complex64, jnp.complex128)
+
+# Validation/comparison tolerance per precision, mirroring the role of the
+# reference's REAL_EPS (QuEST_precision.h:35,48).
+_REAL_EPS = {
+    np.dtype(np.complex64): 1e-5,
+    np.dtype(np.complex128): 1e-13,
+    np.dtype(np.float32): 1e-5,
+    np.dtype(np.float64): 1e-13,
+}
+
+_default_dtype = jnp.complex64
+
+
+def set_default_dtype(dtype) -> None:
+    """Set the default amplitude dtype for newly created Quregs."""
+    global _default_dtype
+    dtype = jnp.dtype(dtype)
+    if dtype not in (np.dtype(np.complex64), np.dtype(np.complex128)):
+        raise ValueError(f"amplitude dtype must be complex64 or complex128, got {dtype}")
+    if dtype == np.dtype(np.complex128) and not jax.config.jax_enable_x64:
+        raise ValueError("complex128 requires jax_enable_x64=True")
+    _default_dtype = dtype
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def real_eps(dtype) -> float:
+    """Numerical tolerance for the given amplitude dtype."""
+    return _REAL_EPS[np.dtype(dtype)]
+
+
+def real_dtype_of(dtype):
+    """The real scalar dtype paired with a complex amplitude dtype
+    (host-side mapping; never touches the device)."""
+    d = np.dtype(dtype)
+    if d == np.dtype(np.complex64):
+        return np.dtype(np.float32)
+    if d == np.dtype(np.complex128):
+        return np.dtype(np.float64)
+    return d

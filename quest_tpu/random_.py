@@ -1,0 +1,38 @@
+"""Seeded RNG for measurement outcomes.
+
+The reference uses a globally-seeded Mersenne Twister (mt19937ar.c), seeded
+by time+pid by default, with the seed broadcast to all MPI ranks so every
+rank draws identical outcomes (QuEST_cpu_distributed.c:1321-1332). Here a
+module-level numpy Generator plays that role for the eager API (all devices
+see the same host, so the identical-outcome invariant is structural), and
+`jax.random` keys are used for fully-traced in-jit measurement.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+_rng = None
+
+
+def seed_quest(seeds) -> None:
+    """Seed the measurement RNG from a list of ints (ref seedQuEST,
+    QuEST_common.c:207-213)."""
+    global _rng
+    _rng = np.random.Generator(np.random.MT19937(list(np.asarray(seeds, dtype=np.uint64))))
+
+
+def seed_quest_default() -> None:
+    """Seed from time + pid (ref getQuESTDefaultSeedKey, QuEST_common.c:181-203)."""
+    seed_quest([int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()])
+
+
+def uniform() -> float:
+    """One uniform draw in [0, 1]."""
+    global _rng
+    if _rng is None:
+        seed_quest_default()
+    return float(_rng.random())

@@ -1,0 +1,251 @@
+"""The simulation state: a functional `Qureg` pytree.
+
+The reference's Qureg (QuEST/include/QuEST.h:160-191) is a mutable pair of
+real/imag C arrays plus chunk metadata. Here the state is an immutable pytree
+holding one complex jax.Array of 2^N amplitudes (2^2N for a density matrix:
+rho_{r,c} lives at flat index r + c*2^N, i.e. an N-qubit density matrix IS a
+2N-qubit statevector under the Choi isomorphism, exactly as the reference
+stores it — QuEST/src/QuEST.c:48-60). Qubit indices are little-endian: qubit
+q is bit q of the flat amplitude index.
+
+Distribution metadata (the reference's chunkId/numChunks) is carried by the
+array's sharding, not by the pytree: a sharded Qureg is simply one whose
+`amps` is a jax.Array laid out over a Mesh (see quest_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quest_tpu import cplx
+from quest_tpu import precision
+from quest_tpu import validation
+from quest_tpu.host import fetch, fetch_scalar
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Qureg:
+    """Functional quantum register: statevector or density matrix.
+
+    amps: (2**num_state_qubits,) complex array. For a density matrix over N
+          qubits, num_state_qubits = 2N and amps[r + c*2**N] = rho[r, c].
+    """
+
+    amps: jax.Array
+    num_qubits: int = dataclasses.field(metadata=dict(static=True))
+    is_density: bool = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_state_qubits(self) -> int:
+        return 2 * self.num_qubits if self.is_density else self.num_qubits
+
+    @property
+    def num_amps(self) -> int:
+        return 1 << self.num_state_qubits
+
+    @property
+    def dtype(self):
+        return self.amps.dtype
+
+    def replace_amps(self, amps: jax.Array) -> "Qureg":
+        return dataclasses.replace(self, amps=amps)
+
+
+def _make(num_qubits: int, is_density: bool, dtype, sharding=None) -> Qureg:
+    validation.validate_num_qubits(num_qubits)
+    dtype = np.dtype(dtype) if dtype is not None else precision.get_default_dtype()
+    n = 2 * num_qubits if is_density else num_qubits
+    rdt = cplx.real_dtype(dtype)
+    re = jnp.zeros((1 << n,), dtype=rdt).at[0].set(1.0)
+    im = jnp.zeros((1 << n,), dtype=rdt)
+    amps = lax.complex(re, im)
+    if sharding is not None:
+        amps = jax.device_put(amps, sharding)
+    return Qureg(amps=amps, num_qubits=num_qubits, is_density=is_density)
+
+
+def create_qureg(num_qubits: int, env=None, dtype=None) -> Qureg:
+    """Statevector register initialized to |0...0> (ref: QuEST.c:34-46)."""
+    sharding = env.sharding_for(num_qubits) if env is not None else None
+    return _make(num_qubits, False, dtype, sharding)
+
+
+def create_density_qureg(num_qubits: int, env=None, dtype=None) -> Qureg:
+    """Density-matrix register initialized to |0..0><0..0| (ref: QuEST.c:48-60)."""
+    sharding = env.sharding_for(2 * num_qubits) if env is not None else None
+    return _make(num_qubits, True, dtype, sharding)
+
+
+def clone(qureg: Qureg) -> Qureg:
+    """Deep copy (ref createCloneQureg, QuEST.c:62-72). The copy is made by
+    a device-side re-combination (never a host round-trip)."""
+    return qureg.replace_amps(lax.complex(jnp.real(qureg.amps), jnp.imag(qureg.amps)))
+
+
+# ---------------------------------------------------------------------------
+# State initializers (ref: QuEST_cpu.c:1366-1655 init kernels)
+# ---------------------------------------------------------------------------
+
+
+def init_blank_state(qureg: Qureg) -> Qureg:
+    """All amplitudes zero (an unnormalized, unphysical state)."""
+    return qureg.replace_amps(cplx.czeros((qureg.num_amps,), qureg.dtype))
+
+
+def init_zero_state(qureg: Qureg) -> Qureg:
+    """|0...0> or |0..0><0..0|."""
+    rdt = precision.real_dtype_of(qureg.dtype)
+    re = jnp.zeros((qureg.num_amps,), dtype=rdt).at[0].set(1.0)
+    im = jnp.zeros((qureg.num_amps,), dtype=rdt)
+    return qureg.replace_amps(lax.complex(re, im))
+
+
+def init_plus_state(qureg: Qureg) -> Qureg:
+    """|+>^N; density: uniform matrix 1/2^N (ref QuEST_cpu.c:1406-1473)."""
+    n = qureg.num_qubits
+    if qureg.is_density:
+        val = 1.0 / (1 << n)
+    else:
+        val = 1.0 / np.sqrt(1 << n)
+    rdt = precision.real_dtype_of(qureg.dtype)
+    re = jnp.full((qureg.num_amps,), val, dtype=rdt)
+    im = jnp.zeros((qureg.num_amps,), dtype=rdt)
+    return qureg.replace_amps(lax.complex(re, im))
+
+
+def init_classical_state(qureg: Qureg, state_index: int) -> Qureg:
+    """Basis state |k> or |k><k| (ref QuEST_cpu.c:1475-1539)."""
+    validation.validate_state_index(qureg, state_index)
+    if qureg.is_density:
+        flat = state_index + (state_index << qureg.num_qubits)
+    else:
+        flat = state_index
+    rdt = precision.real_dtype_of(qureg.dtype)
+    re = jnp.zeros((qureg.num_amps,), dtype=rdt).at[flat].set(1.0)
+    im = jnp.zeros((qureg.num_amps,), dtype=rdt)
+    return qureg.replace_amps(lax.complex(re, im))
+
+
+def init_debug_state(qureg: Qureg) -> Qureg:
+    """Deterministic unphysical state: amp[k] = (2k + i(2k+1))/10.
+
+    Matches the reference's initDebugState exactly (QuEST_cpu.c:1559-1590),
+    which the whole test strategy leans on.
+    """
+    n = qureg.num_amps
+    rdt = precision.real_dtype_of(qureg.dtype)
+    k = jnp.arange(n, dtype=rdt)
+    amps = lax.complex((2.0 * k) / 10.0, (2.0 * k + 1.0) / 10.0)
+    return qureg.replace_amps(amps)
+
+
+def init_pure_state(qureg: Qureg, pure: Qureg) -> Qureg:
+    """Set qureg to the pure state |psi> (statevec copy) or |psi><psi|
+    (ref densmatr_initPureState, QuEST_cpu.c / QuEST.c:139-146)."""
+    validation.validate_pure_state_args(qureg, pure)
+    if not qureg.is_density:
+        return qureg.replace_amps(pure.amps.astype(qureg.dtype))
+    psi = pure.amps.astype(qureg.dtype)
+    rho = jnp.outer(psi, jnp.conj(psi))  # rho[r, c]
+    # flat index r + c*2^N == column-major flatten == row-major of rho^T
+    return qureg.replace_amps(rho.T.reshape(-1))
+
+
+def init_state_from_amps(qureg: Qureg, reals, imags) -> Qureg:
+    """Overwrite all amplitudes from real/imag arrays (ref QuEST.c:155-161)."""
+    reals = np.asarray(reals).reshape(-1)
+    imags = np.asarray(imags).reshape(-1)
+    validation.validate_equal_lengths(reals, imags)
+    validation.validate_num_amps(qureg, 0, reals.size)
+    if reals.size != qureg.num_amps:
+        raise validation.QuESTError(
+            "Invalid number of amplitudes: must match the register size")
+    amps = cplx.unpack((reals, imags), qureg.dtype)
+    return qureg.replace_amps(amps)
+
+
+def set_amps(qureg: Qureg, start_index: int, reals, imags) -> Qureg:
+    """Overwrite a contiguous slice of amplitudes (ref QuEST.c:779-786)."""
+    if qureg.is_density:
+        raise validation.QuESTError(
+            "Invalid operation: setAmps requires a statevector")
+    reals = np.asarray(reals).reshape(-1)
+    imags = np.asarray(imags).reshape(-1)
+    validation.validate_equal_lengths(reals, imags)
+    validation.validate_num_amps(qureg, start_index, reals.size)
+    vals = cplx.unpack((reals, imags), qureg.dtype)
+    amps = jax.lax.dynamic_update_slice(qureg.amps, vals, (start_index,))
+    return qureg.replace_amps(amps)
+
+
+def set_density_amps(qureg: Qureg, start_row: int, start_col: int, reals, imags) -> Qureg:
+    """Debug-grade density amplitude writer (ref QuEST_debug.h:44-48).
+
+    Writes a flat run of amplitudes starting at rho[start_row, start_col] in
+    the column-major flat ordering.
+    """
+    if not qureg.is_density:
+        raise validation.QuESTError(
+            "Invalid operation: setDensityAmps requires a density matrix")
+    reals = np.asarray(reals).reshape(-1)
+    imags = np.asarray(imags).reshape(-1)
+    validation.validate_equal_lengths(reals, imags)
+    dim = 1 << qureg.num_qubits
+    validation.validate_amp_index(qureg, start_row, dim=dim)
+    validation.validate_amp_index(qureg, start_col, dim=dim)
+    start = start_row + (start_col << qureg.num_qubits)
+    validation.validate_num_amps(qureg, start, reals.size)
+    vals = cplx.unpack((reals, imags), qureg.dtype)
+    amps = jax.lax.dynamic_update_slice(qureg.amps, vals, (start,))
+    return qureg.replace_amps(amps)
+
+
+# ---------------------------------------------------------------------------
+# Amplitude getters (ref QuEST.c:671-705)
+# ---------------------------------------------------------------------------
+
+
+def get_amp(qureg: Qureg, index: int) -> complex:
+    validation.validate_amp_index(qureg, index)
+    if qureg.is_density:
+        raise validation.QuESTError(
+            "Invalid operation: getAmp requires a statevector")
+    return fetch_scalar(qureg.amps[index])
+
+
+def get_real_amp(qureg: Qureg, index: int) -> float:
+    return get_amp(qureg, index).real
+
+
+def get_imag_amp(qureg: Qureg, index: int) -> float:
+    return get_amp(qureg, index).imag
+
+
+def get_prob_amp(qureg: Qureg, index: int) -> float:
+    a = get_amp(qureg, index)
+    return a.real * a.real + a.imag * a.imag
+
+
+def get_density_amp(qureg: Qureg, row: int, col: int) -> complex:
+    if not qureg.is_density:
+        raise validation.QuESTError(
+            "Invalid operation: getDensityAmp requires a density matrix")
+    validation.validate_amp_index(qureg, row, dim=1 << qureg.num_qubits)
+    validation.validate_amp_index(qureg, col, dim=1 << qureg.num_qubits)
+    return fetch_scalar(qureg.amps[row + (col << qureg.num_qubits)])
+
+
+def to_dense(qureg: Qureg) -> np.ndarray:
+    """Fetch the full state to host: (2^N,) vector or (2^N, 2^N) matrix."""
+    arr = fetch(qureg.amps)
+    if qureg.is_density:
+        dim = 1 << qureg.num_qubits
+        return arr.reshape(dim, dim, order="F")
+    return arr

@@ -1,0 +1,46 @@
+"""Shared test scaffolding: prepare debug states, compare against the oracle.
+
+Mirrors the reference's PREPARE_TEST pattern (test_unitaries.cpp:24-92):
+every check runs on BOTH a 5-qubit statevector and a 5-qubit density matrix,
+each initialized to the deterministic debug state, and compares every
+amplitude against the dense oracle within tolerance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import quest_tpu as qt
+from quest_tpu.state import to_dense
+
+from . import oracle
+
+N = 5
+
+
+def make_sv(dtype):
+    q = qt.init_debug_state(qt.create_qureg(N, dtype=dtype))
+    return q, oracle.debug_state_vector(N)
+
+
+def make_dm(dtype):
+    q = qt.init_debug_state(qt.create_density_qureg(N, dtype=dtype))
+    flat = oracle.debug_state_vector(2 * N)
+    rho = flat.reshape((1 << N, 1 << N), order="F")  # rho[r,c] = amps[r + c*2^N]
+    return q, rho
+
+
+def check_gate(op, matrix, targets, tol, controls=(), cstates=None, dtype=np.complex64):
+    """Apply `op` (Qureg -> Qureg) to debug statevector AND density register;
+    compare against the oracle applying `matrix` at targets/controls."""
+    sv, ref_v = make_sv(dtype)
+    out = to_dense(op(sv))
+    want = oracle.apply_to_vector(ref_v, N, matrix, targets, controls, cstates)
+    np.testing.assert_allclose(out, want, atol=tol, rtol=0,
+                               err_msg=f"statevec targets={targets} controls={controls}")
+
+    dm, ref_m = make_dm(dtype)
+    out = to_dense(op(dm))
+    want = oracle.apply_to_density(ref_m, N, matrix, targets, controls, cstates)
+    np.testing.assert_allclose(out, want, atol=10 * tol, rtol=0,
+                               err_msg=f"density targets={targets} controls={controls}")

@@ -1,0 +1,115 @@
+"""Dense NumPy oracle: an independent brute-force simulator.
+
+Plays the role of the reference's QVector/QMatrix utilities
+(tests/utilities.hpp:49-98, getFullOperatorMatrix at utilities.hpp:256) but
+is implemented differently: the full 2^n x 2^n operator is assembled by
+column construction from index arithmetic rather than by kron chains.
+Everything is complex128. Qubit indices are little-endian; matrix bit j of a
+k-qubit operator corresponds to targets[j].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def full_operator(n, matrix, targets, controls=(), cstates=None) -> np.ndarray:
+    """Embed a k-qubit operator (optionally controlled) into the full
+    2^n-dim space."""
+    matrix = np.asarray(matrix, dtype=np.complex128)
+    targets = list(targets)
+    k = len(targets)
+    assert matrix.shape == (1 << k, 1 << k)
+    controls = list(controls)
+    cstates = list(cstates) if cstates is not None else [1] * len(controls)
+    dim = 1 << n
+    op = np.zeros((dim, dim), dtype=np.complex128)
+    for j in range(dim):
+        ctrl_ok = all(((j >> c) & 1) == s for c, s in zip(controls, cstates))
+        if not ctrl_ok:
+            op[j, j] = 1.0
+            continue
+        a = 0  # sub-index of column j over targets
+        for bit, t in enumerate(targets):
+            a |= ((j >> t) & 1) << bit
+        rest = j
+        for t in targets:
+            rest &= ~(1 << t)
+        for ap in range(1 << k):
+            i = rest
+            for bit, t in enumerate(targets):
+                if (ap >> bit) & 1:
+                    i |= 1 << t
+            op[i, j] = matrix[ap, a]
+    return op
+
+
+def apply_to_vector(vec, n, matrix, targets, controls=(), cstates=None):
+    return full_operator(n, matrix, targets, controls, cstates) @ vec
+
+
+def apply_to_density(rho, n, matrix, targets, controls=(), cstates=None):
+    op = full_operator(n, matrix, targets, controls, cstates)
+    return op @ rho @ op.conj().T
+
+
+def apply_kraus_to_density(rho, n, ops, targets):
+    out = np.zeros_like(rho)
+    for kop in ops:
+        full = full_operator(n, kop, targets)
+        out += full @ rho @ full.conj().T
+    return out
+
+
+# -- random inputs (strategy mirrors tests/utilities.hpp:282-353) ------------
+
+
+def random_statevector(n, rng) -> np.ndarray:
+    v = rng.normal(size=1 << n) + 1j * rng.normal(size=1 << n)
+    return v / np.linalg.norm(v)
+
+
+def random_density(n, rng, rank=None) -> np.ndarray:
+    dim = 1 << n
+    rank = rank or dim
+    a = rng.normal(size=(dim, rank)) + 1j * rng.normal(size=(dim, rank))
+    rho = a @ a.conj().T
+    return rho / np.trace(rho)
+
+
+def random_unitary(k_qubits, rng) -> np.ndarray:
+    dim = 1 << k_qubits
+    z = rng.normal(size=(dim, dim)) + 1j * rng.normal(size=(dim, dim))
+    q, r = np.linalg.qr(z)
+    # fix the phase convention so the distribution is Haar
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def random_kraus_map(k_qubits, num_ops, rng):
+    """Trace-preserving set of num_ops Kraus operators via a random isometry
+    (columns of a Haar unitary on the dilated space)."""
+    dim = 1 << k_qubits
+    big = random_unitary_dim(dim * num_ops, rng)
+    iso = big[:, :dim]  # isometry: iso^dag iso = I
+    return [iso[i * dim:(i + 1) * dim, :] for i in range(num_ops)]
+
+
+def random_unitary_dim(dim, rng) -> np.ndarray:
+    z = rng.normal(size=(dim, dim)) + 1j * rng.normal(size=(dim, dim))
+    q, r = np.linalg.qr(z)
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+# -- state bridges ------------------------------------------------------------
+
+
+def debug_state_vector(n_state_qubits) -> np.ndarray:
+    k = np.arange(1 << n_state_qubits, dtype=np.float64)
+    return (2 * k) / 10.0 + 1j * (2 * k + 1) / 10.0
+
+
+def sublists(items, length):
+    """All ordered sublists of `items` of the given length with distinct
+    elements (analogue of the reference's `sublists` Catch generator)."""
+    import itertools
+    return list(itertools.permutations(items, length))

@@ -1,0 +1,317 @@
+"""Per-gate correctness against the dense oracle — statevector AND density
+register for every case, exhaustive target/control sweeps at 5 qubits.
+
+This is the analogue of the reference's test_unitaries.cpp (38 TEST_CASEs,
+one per public unitary-family function).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from quest_tpu.ops import gates as G
+from quest_tpu.ops import matrices as M
+
+from . import oracle
+from .helpers import N, check_gate
+
+ALL_TARGETS = range(N)
+
+
+def _pairs():
+    return [(a, b) for a in range(N) for b in range(N) if a != b]
+
+
+# ---------------------------------------------------------------------------
+# fixed single-qubit gates
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_hadamard(target, dtype, tol):
+    check_gate(lambda q: G.hadamard(q, target), M.HADAMARD, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_pauli_x(target, dtype, tol):
+    check_gate(lambda q: G.pauli_x(q, target), M.PAULI_X, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_pauli_y(target, dtype, tol):
+    check_gate(lambda q: G.pauli_y(q, target), M.PAULI_Y, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_pauli_z(target, dtype, tol):
+    check_gate(lambda q: G.pauli_z(q, target), M.PAULI_Z, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_s_gate(target, dtype, tol):
+    check_gate(lambda q: G.s_gate(q, target), np.diag([1, 1j]), (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_t_gate(target, dtype, tol):
+    mat = np.diag([1, np.exp(1j * np.pi / 4)])
+    check_gate(lambda q: G.t_gate(q, target), mat, (target,), tol, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# parameterized single-qubit gates
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_phase_shift(target, dtype, tol):
+    angle = 0.7324
+    mat = np.diag([1, np.exp(1j * angle)])
+    check_gate(lambda q: G.phase_shift(q, target, angle), mat, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_compact_unitary(target, dtype, tol, rng):
+    # random normalized (alpha, beta)
+    v = rng.normal(size=4)
+    alpha = complex(v[0], v[1])
+    beta = complex(v[2], v[3])
+    norm = np.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
+    alpha, beta = alpha / norm, beta / norm
+    mat = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    check_gate(lambda q: G.compact_unitary(q, target, alpha, beta),
+               mat, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+@pytest.mark.parametrize("axis_name", ["x", "y", "z", "tilted"])
+def test_rotations(target, axis_name, dtype, tol):
+    angle = 1.2345
+    axis = {"x": (1., 0., 0.), "y": (0., 1., 0.), "z": (0., 0., 1.),
+            "tilted": (1.0, -2.0, 0.5)}[axis_name]
+    ax = np.asarray(axis) / np.linalg.norm(axis)
+    half = angle / 2
+    mat = (np.cos(half) * np.eye(2)
+           - 1j * np.sin(half) * (ax[0] * M.PAULI_X + ax[1] * M.PAULI_Y + ax[2] * M.PAULI_Z))
+    ops = {"x": lambda q: G.rotate_x(q, target, angle),
+           "y": lambda q: G.rotate_y(q, target, angle),
+           "z": lambda q: G.rotate_z(q, target, angle),
+           "tilted": lambda q: G.rotate_around_axis(q, target, angle, axis)}
+    check_gate(ops[axis_name], mat, (target,), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("target", ALL_TARGETS)
+def test_unitary(target, dtype, tol, rng):
+    u = oracle.random_unitary(1, rng)
+    check_gate(lambda q: G.unitary(q, target, u), u, (target,), tol, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# controlled gates
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("control,target", _pairs())
+def test_controlled_not(control, target, dtype, tol):
+    check_gate(lambda q: G.controlled_not(q, control, target),
+               M.PAULI_X, (target,), tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("control,target", _pairs())
+def test_controlled_pauli_y(control, target, dtype, tol):
+    check_gate(lambda q: G.controlled_pauli_y(q, control, target),
+               M.PAULI_Y, (target,), tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("control,target", _pairs()[:10])
+def test_controlled_unitary(control, target, dtype, tol, rng):
+    u = oracle.random_unitary(1, rng)
+    check_gate(lambda q: G.controlled_unitary(q, control, target, u),
+               u, (target,), tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("control,target", _pairs()[:10])
+def test_controlled_compact_unitary(control, target, dtype, tol, rng):
+    u = oracle.random_unitary(1, rng)
+    # extract a compact (alpha,beta) pair from a random SU(2)
+    det = np.linalg.det(u)
+    su = u / np.sqrt(det)
+    alpha, beta = su[0, 0], su[1, 0]
+    mat = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    check_gate(lambda q: G.controlled_compact_unitary(q, control, target, alpha, beta),
+               mat, (target,), tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("control,target", _pairs()[:8])
+@pytest.mark.parametrize("axis_name", ["x", "y", "z", "tilted"])
+def test_controlled_rotations(control, target, axis_name, dtype, tol):
+    angle = -0.5432
+    axis = {"x": (1., 0., 0.), "y": (0., 1., 0.), "z": (0., 0., 1.),
+            "tilted": (0.3, 1.1, -0.7)}[axis_name]
+    ax = np.asarray(axis) / np.linalg.norm(axis)
+    half = angle / 2
+    mat = (np.cos(half) * np.eye(2)
+           - 1j * np.sin(half) * (ax[0] * M.PAULI_X + ax[1] * M.PAULI_Y + ax[2] * M.PAULI_Z))
+    ops = {"x": lambda q: G.controlled_rotate_x(q, control, target, angle),
+           "y": lambda q: G.controlled_rotate_y(q, control, target, angle),
+           "z": lambda q: G.controlled_rotate_z(q, control, target, angle),
+           "tilted": lambda q: G.controlled_rotate_around_axis(q, control, target, angle, axis)}
+    check_gate(ops[axis_name], mat, (target,), tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("num_controls", [1, 2, 3])
+def test_multi_controlled_unitary(num_controls, dtype, tol, rng):
+    u = oracle.random_unitary(1, rng)
+    for combo in itertools.combinations(range(N), num_controls + 1):
+        target, controls = combo[0], combo[1:]
+        check_gate(lambda q: G.multi_controlled_unitary(q, controls, target, u),
+                   u, (target,), tol, controls=controls, dtype=dtype)
+        break  # one qubit-combo per control-count per dtype keeps runtime sane
+    # plus a couple of random combos
+    for _ in range(2):
+        qubits = rng.permutation(N)[:num_controls + 1]
+        target, controls = int(qubits[0]), tuple(int(c) for c in qubits[1:])
+        check_gate(lambda q: G.multi_controlled_unitary(q, controls, target, u),
+                   u, (target,), tol, controls=controls, dtype=dtype)
+
+
+def test_multi_state_controlled_unitary(dtype, tol, rng):
+    u = oracle.random_unitary(1, rng)
+    for controls, cstates in [((1, 3), (0, 1)), ((0, 2, 4), (1, 0, 0)), ((4,), (0,))]:
+        target = next(t for t in range(N) if t not in controls)
+        check_gate(lambda q: G.multi_state_controlled_unitary(q, controls, cstates, target, u),
+                   u, (target,), tol, controls=controls, cstates=cstates, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# symmetric phase family
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("q1,q2", _pairs()[:10])
+def test_controlled_phase_shift(q1, q2, dtype, tol):
+    angle = 0.91
+    mat = np.diag([1, 1, 1, np.exp(1j * angle)])
+    check_gate(lambda q: G.controlled_phase_shift(q, q1, q2, angle),
+               mat, (q1, q2), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("q1,q2", _pairs()[:10])
+def test_controlled_phase_flip(q1, q2, dtype, tol):
+    mat = np.diag([1, 1, 1, -1])
+    check_gate(lambda q: G.controlled_phase_flip(q, q1, q2),
+               mat, (q1, q2), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("qubits", [(0, 1, 2), (1, 3, 4), (0, 2, 3, 4), (0, 1, 2, 3, 4)])
+def test_multi_controlled_phase_shift(qubits, dtype, tol):
+    angle = -1.17
+    k = len(qubits)
+    diag = np.ones(1 << k, dtype=np.complex128)
+    diag[-1] = np.exp(1j * angle)
+    check_gate(lambda q: G.multi_controlled_phase_shift(q, qubits, angle),
+               np.diag(diag), qubits, tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("qubits", [(0, 1, 2), (1, 3, 4), (0, 2, 3, 4), (0, 1, 2, 3, 4)])
+def test_multi_controlled_phase_flip(qubits, dtype, tol):
+    k = len(qubits)
+    diag = np.ones(1 << k, dtype=np.complex128)
+    diag[-1] = -1
+    check_gate(lambda q: G.multi_controlled_phase_flip(q, qubits),
+               np.diag(diag), qubits, tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("qubits", [(0,), (2,), (0, 1), (1, 4), (0, 2, 3), (0, 1, 2, 3, 4)])
+def test_multi_rotate_z(qubits, dtype, tol):
+    angle = 0.666
+    k = len(qubits)
+    # eigenvalue of Z...Z on |b> is (-1)^popcount(b)
+    diag = np.array([np.exp(-1j * angle / 2 * ((-1.0) ** bin(i).count("1")))
+                     for i in range(1 << k)])
+    check_gate(lambda q: G.multi_rotate_z(q, qubits, angle),
+               np.diag(diag), qubits, tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("paulis", [(1,), (2,), (3,), (0, 1), (1, 2), (3, 3),
+                                    (1, 2, 3), (2, 0, 1)])
+def test_multi_rotate_pauli(paulis, dtype, tol, rng):
+    angle = 0.4321
+    k = len(paulis)
+    targets = tuple(int(t) for t in rng.permutation(N)[:k])
+    full = np.array([[1.0]])
+    # build P = paulis[k-1] (x) ... (x) paulis[0]  (matrix bit j = targets[j])
+    for p in paulis:
+        full = np.kron(M.PAULIS[p], full)
+    mat = (np.cos(angle / 2) * np.eye(1 << k) - 1j * np.sin(angle / 2) * full)
+    check_gate(lambda q: G.multi_rotate_pauli(q, targets, paulis, angle),
+               mat, targets, tol, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# two-qubit and general multi-qubit unitaries
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("q1,q2", _pairs()[:12])
+def test_swap(q1, q2, dtype, tol):
+    check_gate(lambda q: G.swap_gate(q, q1, q2), M.SWAP, (q1, q2), tol, dtype=dtype)
+
+
+@pytest.mark.parametrize("q1,q2", _pairs()[:8])
+def test_sqrt_swap(q1, q2, dtype, tol):
+    check_gate(lambda q: G.sqrt_swap_gate(q, q1, q2), M.SQRT_SWAP, (q1, q2), tol,
+               dtype=dtype)
+    # sqrtSwap^2 == swap
+    sq = M.SQRT_SWAP @ M.SQRT_SWAP
+    np.testing.assert_allclose(sq, M.SWAP, atol=1e-12)
+
+
+@pytest.mark.parametrize("q1,q2", _pairs())
+def test_two_qubit_unitary(q1, q2, dtype, tol, rng):
+    u = oracle.random_unitary(2, rng)
+    check_gate(lambda q: G.two_qubit_unitary(q, q1, q2, u), u, (q1, q2), tol,
+               dtype=dtype)
+
+
+@pytest.mark.parametrize("control,q1,q2", [(0, 1, 2), (2, 0, 4), (4, 3, 1), (1, 4, 0)])
+def test_controlled_two_qubit_unitary(control, q1, q2, dtype, tol, rng):
+    u = oracle.random_unitary(2, rng)
+    check_gate(lambda q: G.controlled_two_qubit_unitary(q, control, q1, q2, u),
+               u, (q1, q2), tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("controls,q1,q2", [((0, 1), 2, 3), ((4, 2), 1, 0),
+                                            ((0, 1, 2), 3, 4)])
+def test_multi_controlled_two_qubit_unitary(controls, q1, q2, dtype, tol, rng):
+    u = oracle.random_unitary(2, rng)
+    check_gate(lambda q: G.multi_controlled_two_qubit_unitary(q, controls, q1, q2, u),
+               u, (q1, q2), tol, controls=controls, dtype=dtype)
+
+
+@pytest.mark.parametrize("num_targets", [1, 2, 3, 4])
+def test_multi_qubit_unitary(num_targets, dtype, tol, rng):
+    u = oracle.random_unitary(num_targets, rng)
+    for _ in range(3):
+        targets = tuple(int(t) for t in rng.permutation(N)[:num_targets])
+        check_gate(lambda q: G.multi_qubit_unitary(q, targets, u), u, targets, tol,
+                   dtype=dtype)
+
+
+@pytest.mark.parametrize("num_targets", [1, 2, 3])
+def test_controlled_multi_qubit_unitary(num_targets, dtype, tol, rng):
+    u = oracle.random_unitary(num_targets, rng)
+    for _ in range(2):
+        qubits = rng.permutation(N)[:num_targets + 1]
+        control, targets = int(qubits[0]), tuple(int(t) for t in qubits[1:])
+        check_gate(lambda q: G.controlled_multi_qubit_unitary(q, control, targets, u),
+                   u, targets, tol, controls=(control,), dtype=dtype)
+
+
+@pytest.mark.parametrize("num_controls,num_targets", [(1, 1), (2, 1), (1, 2),
+                                                      (2, 2), (3, 2), (2, 3)])
+def test_multi_controlled_multi_qubit_unitary(num_controls, num_targets, dtype, tol, rng):
+    u = oracle.random_unitary(num_targets, rng)
+    for _ in range(2):
+        qubits = rng.permutation(N)[:num_controls + num_targets]
+        controls = tuple(int(c) for c in qubits[:num_controls])
+        targets = tuple(int(t) for t in qubits[num_controls:])
+        check_gate(
+            lambda q: G.multi_controlled_multi_qubit_unitary(q, controls, targets, u),
+            u, targets, tol, controls=controls, dtype=dtype)
