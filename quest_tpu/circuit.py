@@ -164,6 +164,27 @@ class Circuit:
             raise ValueError("circuit/register size mismatch")
         return q.replace_amps(self.compiled(n, q.is_density, donate)(q.amps))
 
+    def compiled_sharded(self, n: int, density: bool, mesh, donate: bool = True):
+        """Compiled explicit-distribution program (one shard_map over the
+        whole circuit, reference-style ppermute schedule — see
+        quest_tpu.parallel.sharded)."""
+        from quest_tpu.parallel import sharded as S
+        key = ("sharded", n, density, id(mesh), int(mesh.devices.size), donate)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = S.compile_circuit_sharded(self.ops, n, density, mesh, donate)
+            self._compiled[key] = fn
+        return fn
+
+    def apply_sharded(self, q: Qureg, mesh, donate: bool = False) -> Qureg:
+        """Apply via the explicit shard_map engine on a mesh-sharded register."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        from quest_tpu.parallel import mesh as MM
+        fn = self.compiled_sharded(q.num_state_qubits, q.is_density, mesh, donate)
+        amps = jax.device_put(q.amps, MM.amp_sharding(mesh))
+        return q.replace_amps(fn(amps))
+
 
 # ---------------------------------------------------------------------------
 # Benchmark circuit generators

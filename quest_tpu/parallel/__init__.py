@@ -1,0 +1,36 @@
+"""Distribution subsystem: amplitude sharding over a device mesh.
+
+The reference distributes the 2^N-amplitude array as equal contiguous chunks
+per MPI rank (QuEST/src/CPU/QuEST_cpu_distributed.c) with three mechanisms:
+pair-rank full-chunk exchange for high-qubit gates (exchangeStateVectors,
+:481-509), SWAP-relabeling of high target qubits into the local range for
+multi-target gates (:1441-1483), and MPI_Allreduce for reductions.
+
+Here the same distribution strategy is expressed TPU-natively:
+  - the amplitude array is sharded over a 1-D `jax.sharding.Mesh`; the top
+    log2(D) qubits are the "global" (device-index) qubits — identical chunk
+    layout to the reference;
+  - pair exchange is `lax.ppermute` over the mesh axis (ICI neighbours when
+    the hot qubit maps to the innermost mesh dimension);
+  - swap-relabeling is a half-chunk ppermute (cheaper than the reference's
+    full-chunk exchange);
+  - reductions are `lax.psum` (inserted explicitly in the shard_map engine,
+    or automatically by GSPMD for the eager path).
+
+Two execution paths, mirroring the reference's local/distributed split:
+  - GSPMD (automatic): every eager op in quest_tpu.ops runs unchanged on
+    sharded arrays; XLA partitions and inserts collectives.
+  - Explicit (quest_tpu.parallel.sharded): a whole Circuit runs inside ONE
+    shard_map with hand-placed ppermutes — the reference-faithful
+    communication-avoiding schedule, used by the benchmark path.
+"""
+
+from quest_tpu.parallel.mesh import make_amp_mesh, amp_sharding, shard_qureg
+from quest_tpu.parallel.sharded import apply_circuit_sharded
+
+__all__ = [
+    "make_amp_mesh",
+    "amp_sharding",
+    "shard_qureg",
+    "apply_circuit_sharded",
+]

@@ -1,0 +1,48 @@
+"""Mesh construction and sharding helpers for the amplitude axis.
+
+Chunk layout matches the reference exactly (QuEST_cpu.c:1280-1312): device d
+of D holds amplitudes [d*2^n/D, (d+1)*2^n/D) — i.e. the top log2(D) qubits
+select the device. Power-of-2 device counts only (ref validateNumRanks,
+QuEST_validation.c:81).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.state import Qureg
+
+
+def make_amp_mesh(num_devices: Optional[int] = None,
+                  devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the amplitude axis. num_devices must be a power of 2."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is None:
+        num_devices = 1 << (len(devices).bit_length() - 1)
+    if num_devices & (num_devices - 1):
+        raise ValueError(
+            f"Invalid number of devices {num_devices}: must be a power of 2 "
+            "(ref QuEST_validation.c:81)")
+    if num_devices > len(devices):
+        raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:num_devices]), (AMP_AXIS,))
+
+
+def amp_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AMP_AXIS))
+
+
+def shard_qureg(q: Qureg, mesh: Mesh) -> Qureg:
+    """Lay the register's amplitudes out over the mesh (one contiguous chunk
+    per device). Requires 2^n >= mesh size."""
+    if q.num_amps < mesh.devices.size:
+        raise ValueError(
+            f"register of {q.num_amps} amps cannot shard over "
+            f"{mesh.devices.size} devices (ref QuEST_validation.c:129)")
+    return q.replace_amps(jax.device_put(q.amps, amp_sharding(mesh)))

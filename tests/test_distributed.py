@@ -1,0 +1,183 @@
+"""Distributed-engine tests on a virtual 8-device CPU mesh.
+
+The analogue of running the reference suite under `mpirun -np 8`
+(SURVEY.md §4): the same circuits produce identical amplitudes whether the
+register lives on one device or is sharded over the mesh, including gates
+whose targets/controls fall on "global" (device-index) qubits — the cases
+that exercise ppermute pair exchange and swap-to-local relabeling
+(ref QuEST_cpu_distributed.c:846-881, 1441-1483).
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuit import Circuit, qft_circuit, random_circuit
+from quest_tpu.parallel import make_amp_mesh, shard_qureg
+from quest_tpu.state import to_dense
+
+from . import oracle
+
+N = 6          # statevector qubits; with D=8 the top 3 are global
+ND = 3         # density-matrix qubits (6 state qubits)
+DTYPE = np.complex128
+TOL = 1e-12
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_amp_mesh(8)
+
+
+def run_both(circ: Circuit, mesh, density=False):
+    """Apply circ via the single-device path and the sharded engine; return
+    (dense_single, dense_sharded)."""
+    make = qt.create_density_qureg if density else qt.create_qureg
+    n = ND if density else N
+    q1 = qt.init_debug_state(make(n, dtype=DTYPE))
+    q2 = qt.init_debug_state(make(n, dtype=DTYPE))
+    out1 = circ.apply(q1)
+    out2 = circ.apply_sharded(shard_qureg(q2, mesh), mesh)
+    return to_dense(out1), to_dense(out2)
+
+
+def check(circ, mesh, density=False):
+    a, b = run_both(circ, mesh, density)
+    np.testing.assert_allclose(a, b, atol=TOL, rtol=0)
+
+
+# -- single-qubit gates on every position (local + global) -------------------
+
+@pytest.mark.parametrize("q", range(N))
+def test_hadamard_all_positions(mesh, q):
+    check(Circuit(N).h(q), mesh)
+
+
+@pytest.mark.parametrize("q", range(N))
+def test_rotation_all_positions(mesh, q):
+    check(Circuit(N).rx(q, 0.7).ry(q, -0.3).rz(q, 1.9), mesh)
+
+
+# -- controlled gates across the local/global boundary -----------------------
+
+@pytest.mark.parametrize("ctrl,targ", [(0, 5), (5, 0), (4, 5), (5, 4), (1, 3)])
+def test_cnot_boundary(mesh, ctrl, targ):
+    check(Circuit(N).cnot(ctrl, targ), mesh)
+
+
+def test_multi_controlled_global(mesh):
+    c = Circuit(N).x(0, 3, 4, 5)   # target 0, controls on all global qubits
+    check(c, mesh)
+    c = Circuit(N).x(5, 0, 1, 4)   # global target, mixed controls
+    check(c, mesh)
+
+
+# -- diagonal / parity / all-ones phase ops on global qubits -----------------
+
+@pytest.mark.parametrize("q", [0, 3, 5])
+def test_diagonal_positions(mesh, q):
+    check(Circuit(N).z(q).s(q).t(q).phase(q, 0.41), mesh)
+
+
+def test_multi_rotate_z_mixed(mesh):
+    check(Circuit(N).multi_rotate_z((0, 2, 4, 5), 0.83), mesh)
+    check(Circuit(N).multi_rotate_z((3, 4, 5), -1.2), mesh)
+
+
+@pytest.mark.parametrize("pair", [(0, 1), (2, 4), (3, 5), (4, 5)])
+def test_cz_positions(mesh, pair):
+    check(Circuit(N).cz(*pair), mesh)
+
+
+# -- multi-target unitaries requiring swap-to-local --------------------------
+
+@pytest.mark.parametrize("targets", [(0, 5), (4, 5), (5, 2), (3, 4)])
+def test_two_qubit_unitary_global(mesh, targets, rng):
+    u = oracle.random_unitary(2, rng)
+    check(Circuit(N).gate(u, targets), mesh)
+
+
+def test_three_qubit_unitary_all_global(mesh, rng):
+    u = oracle.random_unitary(3, rng)
+    check(Circuit(N).gate(u, (3, 4, 5)), mesh)
+    check(Circuit(N).gate(u, (5, 1, 4)), mesh)
+
+
+def test_controlled_multi_qubit_global(mesh, rng):
+    u = oracle.random_unitary(2, rng)
+    check(Circuit(N).gate(u, (4, 5), controls=(0, 3)), mesh)
+    check(Circuit(N).gate(u, (0, 5), controls=(4,), cstates=(0,)), mesh)
+
+
+def test_controlled_gate_using_control_slot(mesh, rng):
+    """All three global qubits are targets and a local qubit is a control:
+    the swap dance must borrow the control's slot and remap the control to
+    the vacated global position (ref ctrlMask fixup,
+    QuEST_cpu_distributed.c:1457-1466)."""
+    u = oracle.random_unitary(3, rng)
+    check(Circuit(N).gate(u, (5, 1, 4), controls=(0,)), mesh)
+    check(Circuit(N).gate(u, (3, 4, 5), controls=(0, 2), cstates=(1, 0)), mesh)
+
+
+def test_swap_global_pairs(mesh):
+    check(Circuit(N).swap(0, 5), mesh)
+    check(Circuit(N).swap(4, 5), mesh)
+
+
+# -- density registers (conjugate column-space half hits global qubits) ------
+
+@pytest.mark.parametrize("q", range(ND))
+def test_density_single_qubit(mesh, q):
+    check(Circuit(ND).h(q).t(q).ry(q, 0.9), mesh, density=True)
+
+
+def test_density_cnot_and_unitary(mesh, rng):
+    check(Circuit(ND).cnot(0, 2).cz(1, 2), mesh, density=True)
+    u = oracle.random_unitary(2, rng)
+    check(Circuit(ND).gate(u, (0, 2)), mesh, density=True)
+
+
+# -- whole-circuit: QFT and RCS vs the dense oracle --------------------------
+
+def test_qft_sharded_matches_oracle(mesh):
+    circ = qft_circuit(N)
+    q = qt.init_zero_state(qt.create_qureg(N, dtype=DTYPE))
+    q = qt.init_classical_state(q, 13)
+    out = to_dense(circ.apply_sharded(shard_qureg(q, mesh), mesh))
+    # QFT of |13>: amplitudes exp(2 pi i * 13 k / 64) / 8
+    k = np.arange(1 << N)
+    want = np.exp(2j * np.pi * 13 * k / (1 << N)) / np.sqrt(1 << N)
+    np.testing.assert_allclose(out, want, atol=1e-10, rtol=0)
+
+
+def test_random_circuit_sharded(mesh):
+    check(random_circuit(N, depth=6, seed=7), mesh)
+
+
+# -- eager GSPMD path: same ops on a sharded register, no shard_map ----------
+
+def test_eager_gspmd_on_sharded_register(mesh):
+    q = qt.init_debug_state(
+        shard_qureg(qt.create_qureg(N, dtype=DTYPE), mesh))
+    q = qt.gates.hadamard(q, 5)
+    q = qt.gates.controlled_not(q, 5, 0)
+    q = qt.gates.multi_rotate_z(q, (3, 5), 0.5)
+    ref = qt.init_debug_state(qt.create_qureg(N, dtype=DTYPE))
+    ref = qt.gates.hadamard(ref, 5)
+    ref = qt.gates.controlled_not(ref, 5, 0)
+    ref = qt.gates.multi_rotate_z(ref, (3, 5), 0.5)
+    np.testing.assert_allclose(to_dense(q), to_dense(ref), atol=TOL, rtol=0)
+
+
+def test_distributed_reductions(mesh):
+    """psum-terminated reductions on a sharded register (ref MPI_Allreduce
+    paths, QuEST_cpu_distributed.c:1263-1299)."""
+    q = shard_qureg(qt.create_qureg(N, dtype=DTYPE), mesh)
+    q = qt.init_plus_state(q)
+    assert abs(qt.calculations.calc_total_prob(q) - 1.0) < 1e-12
+    p0 = qt.measurement.calc_prob_of_outcome(q, 5, 0)
+    assert abs(p0 - 0.5) < 1e-12
+    q2 = shard_qureg(qt.create_qureg(N, dtype=DTYPE), mesh)
+    q2 = qt.init_plus_state(q2)
+    ip = qt.calculations.calc_inner_product(q, q2)
+    assert abs(ip - 1.0) < 1e-12
