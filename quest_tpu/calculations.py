@@ -1,12 +1,14 @@
 """Scalar calculations on registers: norms, overlaps, expectations.
 
-Every function here is a reduction over the amplitude array; when the array
-is sharded over a mesh these compile to per-shard partial sums followed by an
-XLA all-reduce — the TPU-native form of the reference's OpenMP
-`reduction(+:)` + `MPI_Allreduce` pattern (QuEST_cpu_distributed.c:35-117,
-1263-1299).
+Every function here is a reduction over the amplitude planes; when the
+array is sharded over a mesh these compile to per-shard partial sums
+followed by an XLA all-reduce — the TPU-native form of the reference's
+OpenMP `reduction(+:)` + `MPI_Allreduce` pattern
+(QuEST_cpu_distributed.c:35-117, 1263-1299).
 
-Reference semantics per function are cited inline.
+Complex results are computed as (re, im) float pairs on device and
+assembled on the host (complex cannot cross the boundary here — see
+quest_tpu.cplx). Reference semantics per function are cited inline.
 """
 
 from __future__ import annotations
@@ -18,36 +20,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from quest_tpu import cplx
 from quest_tpu import validation as val
-from quest_tpu.host import fetch_scalar
 from quest_tpu.ops import gates
 from quest_tpu.state import Qureg
 
 
 @jax.jit
-def _total_prob_statevec(amps):
+def _sum_sq(amps):
     # ref statevec_calcTotalProb: Kahan-summed sum |a|^2; on TPU a single
     # fused reduction (f32 accumulation is exact enough at test scale, and
-    # c128 is available when the reference's 1e-13 envelope is required).
-    return jnp.sum(amps.real ** 2 + amps.imag ** 2)
+    # f64 planes are available when the reference's 1e-13 envelope is
+    # required).
+    return jnp.sum(amps * amps)
 
 
 @partial(jax.jit, static_argnames=("dim",))
 def _total_prob_density(amps, *, dim):
-    return jnp.sum(jnp.diagonal(amps.reshape((dim, dim))).real)
+    return jnp.sum(jnp.diagonal(amps[0].reshape((dim, dim))))
 
 
 def calc_total_prob(q: Qureg) -> float:
     """Total probability (statevec: sum |a|^2; density: Re trace)."""
     if q.is_density:
         return float(_total_prob_density(q.amps, dim=1 << q.num_qubits))
-    return float(_total_prob_statevec(q.amps))
+    return float(_sum_sq(q.amps))
 
 
 @jax.jit
 def _inner(bra, ket):
-    return jnp.sum(jnp.conj(bra) * ket)
+    """<bra|ket> = sum conj(b) k as a stacked (re, im) pair."""
+    br, bi = bra[0], bra[1]
+    kr, ki = ket[0], ket[1]
+    return jnp.stack([jnp.sum(br * kr + bi * ki),
+                      jnp.sum(br * ki - bi * kr)])
 
 
 def calc_inner_product(bra: Qureg, ket: Qureg) -> complex:
@@ -56,7 +61,9 @@ def calc_inner_product(bra: Qureg, ket: Qureg) -> complex:
     val.validate_state_vector(bra)
     val.validate_state_vector(ket)
     val.validate_match(bra, ket)
-    return fetch_scalar(_inner(bra.amps, ket.amps.astype(bra.dtype)))
+    pair = np.asarray(jax.device_get(
+        _inner(bra.amps, ket.amps.astype(bra.real_dtype))))
+    return complex(pair[0], pair[1])
 
 
 def calc_density_inner_product(rho1: Qureg, rho2: Qureg) -> float:
@@ -65,21 +72,29 @@ def calc_density_inner_product(rho1: Qureg, rho2: Qureg) -> float:
     val.validate_density_matr(rho1)
     val.validate_density_matr(rho2)
     val.validate_match(rho1, rho2)
-    return float(_inner(rho1.amps, rho2.amps.astype(rho1.dtype)).real)
+    pair = _inner(rho1.amps, rho2.amps.astype(rho1.real_dtype))
+    return float(pair[0])
 
 
 def calc_purity(q: Qureg) -> float:
     """Tr(rho^2) = sum |rho_ij|^2 (ref densmatr_calcPurityLocal)."""
     val.validate_density_matr(q)
-    return float(_total_prob_statevec(q.amps))
+    return float(_sum_sq(q.amps))
 
 
 @partial(jax.jit, static_argnames=("dim",))
 def _fidelity_density(rho_amps, psi_amps, *, dim):
-    # <psi| rho |psi>: rho flat index = row + col*dim
-    rho = rho_amps.reshape((dim, dim)).T  # now rho[row, col]
-    rho_psi = jnp.matmul(rho, psi_amps, precision=jax.lax.Precision.HIGHEST)
-    return jnp.real(jnp.sum(jnp.conj(psi_amps) * rho_psi))
+    # <psi| rho |psi>: rho flat index = row + col*dim, so the row-major
+    # reshape is rho^T; transpose back before the matvec
+    hi = jax.lax.Precision.HIGHEST
+    rre = rho_amps[0].reshape((dim, dim)).T
+    rim = rho_amps[1].reshape((dim, dim)).T
+    pre, pim = psi_amps[0], psi_amps[1]
+    # (rho psi) as planes
+    vr = jnp.matmul(rre, pre, precision=hi) - jnp.matmul(rim, pim, precision=hi)
+    vi = jnp.matmul(rre, pim, precision=hi) + jnp.matmul(rim, pre, precision=hi)
+    # Re <psi | v>
+    return jnp.sum(pre * vr + pim * vi)
 
 
 def calc_fidelity(q: Qureg, pure: Qureg) -> float:
@@ -88,16 +103,17 @@ def calc_fidelity(q: Qureg, pure: Qureg) -> float:
     val.validate_state_vector(pure)
     val.validate_match(q, pure)
     if q.is_density:
-        return float(_fidelity_density(q.amps, pure.amps.astype(q.dtype),
+        return float(_fidelity_density(q.amps, pure.amps.astype(q.real_dtype),
                                        dim=1 << q.num_qubits))
-    ip = _inner(q.amps, pure.amps.astype(q.dtype))
-    return float(jnp.abs(ip) ** 2)
+    pair = np.asarray(jax.device_get(
+        _inner(q.amps, pure.amps.astype(q.real_dtype))))
+    return float(pair[0] ** 2 + pair[1] ** 2)
 
 
 @jax.jit
 def _hs_dist_sq(a, b):
     d = a - b
-    return jnp.sum(d.real ** 2 + d.imag ** 2)
+    return jnp.sum(d * d)
 
 
 def calc_hilbert_schmidt_distance(a: Qureg, b: Qureg) -> float:
@@ -105,7 +121,7 @@ def calc_hilbert_schmidt_distance(a: Qureg, b: Qureg) -> float:
     val.validate_density_matr(a)
     val.validate_density_matr(b)
     val.validate_match(a, b)
-    return float(np.sqrt(_hs_dist_sq(a.amps, b.amps.astype(a.dtype))))
+    return float(np.sqrt(_hs_dist_sq(a.amps, b.amps.astype(a.real_dtype))))
 
 
 # ---------------------------------------------------------------------------
@@ -122,7 +138,7 @@ def calc_expec_pauli_prod(q: Qureg, targets: Sequence[int],
     work = gates.apply_pauli_prod(q, targets, paulis)
     if q.is_density:
         return float(_total_prob_density(work.amps, dim=1 << q.num_qubits))
-    return float(_inner(work.amps, q.amps).real)
+    return float(_inner(work.amps, q.amps)[0])
 
 
 def calc_expec_pauli_sum(q: Qureg, all_codes, coeffs) -> float:
@@ -147,9 +163,8 @@ def apply_pauli_sum(q: Qureg, all_codes, coeffs) -> Qureg:
     val.validate_num_pauli_sum_terms(len(coeffs))
     val.validate_pauli_codes(codes)
     targets = list(range(q.num_qubits))
-    acc = cplx.czeros((q.num_amps,), q.dtype)
-    rdt = cplx.real_dtype(q.dtype)
+    acc = jnp.zeros((2, q.num_amps), dtype=q.real_dtype)
     for term, c in zip(codes, coeffs):
-        fac = jnp.asarray(float(c), dtype=rdt)  # termCoeffs are real
+        fac = jnp.asarray(float(c), dtype=q.real_dtype)  # termCoeffs are real
         acc = acc + fac * gates.apply_pauli_prod(q, targets, list(term)).amps
     return q.replace_amps(acc)

@@ -43,19 +43,20 @@ def _apply_op(amps, n, density, op: GateOp):
                 amps, n, tuple(t + s for t in op.targets), -operand)
         return amps
     if op.kind == "allones":
-        term = cplx.unpack(cplx.pack(operand), amps.dtype)
-        amps = A.apply_phase_on_all_ones(amps, n, op.targets, term)
+        pair = cplx.pack(operand)
+        amps = A.apply_phase_on_all_ones(amps, n, op.targets, pair)
         if density:
             s = n // 2
             amps = A.apply_phase_on_all_ones(
-                amps, n, tuple(t + s for t in op.targets), jnp.conj(term))
+                amps, n, tuple(t + s for t in op.targets),
+                (pair[0], -pair[1]))
         return amps
     fn = A.apply_diagonal if op.kind == "diagonal" else A.apply_matrix
-    mat = cplx.unpack(cplx.pack(operand), amps.dtype)
-    amps = fn(amps, n, mat, op.targets, op.controls, op.cstates)
+    pair = cplx.pack(operand)
+    amps = fn(amps, n, pair, op.targets, op.controls, op.cstates)
     if density:
         s = n // 2
-        amps = fn(amps, n, jnp.conj(mat),
+        amps = fn(amps, n, (pair[0], -pair[1]),
                   tuple(t + s for t in op.targets),
                   tuple(c + s for c in op.controls), op.cstates)
     return amps

@@ -1,16 +1,12 @@
-"""Complex-value plumbing that never transfers complex data host<->device.
+"""Host-side complex packing.
 
-Some TPU runtimes (the axon PJRT plugin in particular) cannot move
-complex-dtype buffers across the host<->device boundary, and fail on complex
-constants baked into programs — while complex arithmetic on device-produced
-values works fine. The framework therefore follows one convention:
-
-  * complex data ENTERS a program as a (re, im) float pair, reconstructed
-    on device with `lax.complex` (see `pack` / `unpack`);
-  * complex data LEAVES via jnp.real/jnp.imag splits fetched as floats
-    (see quest_tpu.host.fetch);
-  * traced code never writes complex literals (no `1j`, no
-    `jnp.zeros(..., complex)`) — use the constructors below.
+The framework stores amplitudes as split (re, im) float planes throughout
+(see quest_tpu/state.py) and never materializes complex-dtype device
+buffers: the axon TPU runtime cannot move complex arrays across the
+host<->device boundary and fails on complex constants baked into programs
+(one failure can poison the process). All complex data therefore enters
+programs as (re, im) float pairs produced by `pack`; results leave as
+float planes reassembled on the host (state.to_dense).
 
 Incidentally this matches the reference's storage model, which also keeps
 real and imaginary parts in separate arrays (QuEST.h ComplexArray).
@@ -20,54 +16,13 @@ from __future__ import annotations
 
 from typing import Tuple
 
-import jax.numpy as jnp
 import numpy as np
-from jax import lax
-
-from quest_tpu.precision import real_dtype_of as real_dtype
 
 
 def pack(x) -> Tuple[np.ndarray, np.ndarray]:
     """Host side: complex ndarray -> contiguous (re, im) float64 pair,
-    safe to pass as jit arguments."""
+    safe to pass as jit arguments or bake into traced programs."""
     x = np.asarray(x)
     # np.array (not ascontiguousarray — that promotes 0-d to (1,))
     return (np.array(x.real, dtype=np.float64, order="C"),
             np.array(x.imag, dtype=np.float64, order="C"))
-
-
-def unpack(pair, cdtype):
-    """Traced: (re, im) floats -> complex array of dtype `cdtype`."""
-    rdt = real_dtype(cdtype)
-    re = jnp.asarray(pair[0], dtype=rdt)
-    im = jnp.asarray(pair[1], dtype=rdt)
-    return lax.complex(re, im)
-
-
-def make(re, im):
-    """Traced: elementwise complex from float re/im (dtype follows inputs)."""
-    re = jnp.asarray(re)
-    im = jnp.asarray(im, dtype=re.dtype)
-    return lax.complex(re, im)
-
-
-def czeros(shape, cdtype):
-    rdt = real_dtype(cdtype)
-    z = jnp.zeros(shape, dtype=rdt)
-    return lax.complex(z, z)
-
-
-def cones(shape, cdtype):
-    rdt = real_dtype(cdtype)
-    return lax.complex(jnp.ones(shape, dtype=rdt), jnp.zeros(shape, dtype=rdt))
-
-
-def expi(theta):
-    """e^{i theta} for real traced theta, without complex literals."""
-    theta = jnp.asarray(theta)
-    return lax.complex(jnp.cos(theta), jnp.sin(theta))
-
-
-def scale_i(x):
-    """Multiply by the imaginary unit: i*x = complex(-im, re)."""
-    return lax.complex(-jnp.imag(x), jnp.real(x))

@@ -52,7 +52,7 @@ class QuESTEnv:
         register is too small to shard."""
         if self.num_ranks == 1 or (1 << num_state_qubits) < self.num_ranks:
             return None
-        return NamedSharding(self.mesh, P(AMP_AXIS))
+        return NamedSharding(self.mesh, P(None, AMP_AXIS))
 
     def sync(self) -> None:
         """Block until all queued device work completes (ref syncQuESTEnv)."""

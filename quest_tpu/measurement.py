@@ -7,7 +7,7 @@ and collapse renormalizes the kept amplitudes (by 1/sqrt(p) for
 statevectors, by 1/p for density matrices) while zeroing the rest.
 
 A fully-traced variant (`measure_functional`) keeps measurement inside jit
-using a jax.random key and lax-free branchless collapse, for circuit-level
+using a jax.random key and branchless collapse, for circuit-level
 compilation on TPU.
 """
 
@@ -22,14 +22,8 @@ import jax.numpy as jnp
 from quest_tpu import precision
 from quest_tpu import random_ as rng
 from quest_tpu import validation as val
+from quest_tpu.ops import apply as A
 from quest_tpu.state import Qureg
-
-
-def _bit_values(n: int, qubit: int):
-    """(2,)*n-broadcastable tensor holding bit `qubit` of each flat index."""
-    shape = [1] * n
-    shape[n - 1 - qubit] = 2
-    return jnp.arange(2).reshape(shape)
 
 
 @partial(jax.jit, static_argnames=("n", "qubit", "density"))
@@ -38,31 +32,35 @@ def _prob_of_zero(amps, *, n, qubit, density):
         # probability from the diagonal: rho[k,k] with bit `qubit` of k == 0
         # (ref densmatr_findProbabilityOfZeroLocal, QuEST_cpu.c:3111-3157)
         dim = 1 << (n // 2)
-        d = jnp.diagonal(amps.reshape((dim, dim)))  # diag is transpose-proof
+        d = jnp.diagonal(amps[0].reshape((dim, dim)))  # diag is transpose-proof
         k = jnp.arange(dim)
         keep = ((k >> qubit) & 1) == 0
-        return jnp.sum(jnp.where(keep, d.real, 0.0))
-    t = amps.reshape((2,) * n)
-    keep = _bit_values(n, qubit) == 0
-    return jnp.sum(jnp.where(keep, (t.real ** 2 + t.imag ** 2), 0.0))
+        return jnp.sum(jnp.where(keep, d, 0.0))
+    pre, post = 1 << (n - 1 - qubit), 1 << qubit
+    re = amps[0].reshape(pre, 2, post)[:, 0, :]
+    im = amps[1].reshape(pre, 2, post)[:, 0, :]
+    return jnp.sum(re * re + im * im)
 
 
 @partial(jax.jit, static_argnames=("n", "qubit", "density"))
 def _collapse(amps, outcome, prob, *, n, qubit, density):
-    t = amps.reshape((2,) * n)
-    rdt = amps.real.dtype
+    rdt = amps.dtype
     prob = jnp.asarray(prob, dtype=rdt)
     if density:
         nq = n // 2
-        keep = (_bit_values(n, qubit) == outcome) & \
-               (_bit_values(n, qubit + nq) == outcome)
+        qubits = tuple(sorted({qubit, qubit + nq}, reverse=True))
+        dims, axis_of = A.seg_view(n, qubits)
+        keep = ((A.bit_tensor(len(dims), axis_of[qubit]) == outcome) &
+                (A.bit_tensor(len(dims), axis_of[qubit + nq]) == outcome))
         renorm = 1.0 / prob
     else:
-        keep = _bit_values(n, qubit) == outcome
+        dims, axis_of = A.seg_view(n, (qubit,))
+        keep = A.bit_tensor(len(dims), axis_of[qubit]) == outcome
         renorm = jax.lax.rsqrt(prob)
-    # branch-free masked renormalize (complex x real; no complex constants)
-    out = t * (keep.astype(rdt) * renorm)
-    return out.reshape(-1)
+    factor = keep.astype(rdt) * renorm
+    re = amps[0].reshape(dims) * factor
+    im = amps[1].reshape(dims) * factor
+    return jnp.stack([re.reshape(-1), im.reshape(-1)])
 
 
 def calc_prob_of_outcome(q: Qureg, qubit: int, outcome: int) -> float:
@@ -80,7 +78,7 @@ def collapse_to_outcome(q: Qureg, qubit: int, outcome: int) -> Tuple[Qureg, floa
     prob = calc_prob_of_outcome(q, qubit, outcome)
     val.validate_measurement_prob(prob, precision.real_eps(q.dtype))
     amps = _collapse(q.amps, jnp.asarray(outcome),
-                     jnp.asarray(prob, dtype=precision.real_dtype_of(q.dtype)),
+                     jnp.asarray(prob, dtype=q.real_dtype),
                      n=q.num_state_qubits, qubit=qubit, density=q.is_density)
     return q.replace_amps(amps), prob
 
@@ -100,7 +98,7 @@ def measure_with_stats(q: Qureg, qubit: int) -> Tuple[Qureg, int, float]:
         outcome = int(rng.uniform() > zero_prob)
     prob = zero_prob if outcome == 0 else 1 - zero_prob
     amps = _collapse(q.amps, jnp.asarray(outcome),
-                     jnp.asarray(prob, dtype=precision.real_dtype_of(q.dtype)),
+                     jnp.asarray(prob, dtype=q.real_dtype),
                      n=q.num_state_qubits, qubit=qubit, density=q.is_density)
     return q.replace_amps(amps), outcome, prob
 

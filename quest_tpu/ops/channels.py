@@ -5,11 +5,10 @@ or pairwise on the doubled register and compile to fused masked multiplies;
 general Kraus maps become a superoperator Sum_k conj(K) (x) K applied as a
 2k-qubit operator on [targets, targets + N] — the same reduction the
 reference performs (QuEST_common.c:540-673), but running through the one
-general tensor-contraction apply path.
+general apply path over split re/im planes.
 
-Superoperators are assembled INSIDE the trace from real/imaginary float
-parts (complex data never crosses the host<->device boundary; float
-constants are fine — see quest_tpu.cplx).
+Superoperators are assembled from real/imaginary float parts (complex data
+never crosses the host<->device boundary — see quest_tpu.cplx).
 
 Reference semantics (QuEST.h decoherence doc-group):
   mixDephasing(p):      rho -> (1-p) rho + p Z rho Z                (p <= 1/2)
@@ -36,58 +35,62 @@ from quest_tpu.ops import matrices as M
 from quest_tpu.state import Qureg
 
 
-def _bit(n, q):
-    shape = [1] * n
-    shape[n - 1 - q] = 2
-    return jnp.arange(2).reshape(shape)
-
-
 # ---------------------------------------------------------------------------
 # dephasing: pure elementwise factors on mismatched row/col bits
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("n", "target"))
-def _dephase_one(amps, fac, *, n, target):
-    t = amps.reshape((2,) * n)
-    differ = _bit(n, target) != _bit(n, target + n // 2)
-    out = jnp.where(differ, t * fac, t)
-    return out.reshape(-1)
+def _dephase_mask(n, dims, axis_of, pairs):
+    """True where ANY (row-bit, col-bit) pair differs."""
+    differ = None
+    for (r, c) in pairs:
+        d = A.bit_tensor(len(dims), axis_of[r]) != \
+            A.bit_tensor(len(dims), axis_of[c])
+        differ = d if differ is None else (differ | d)
+    return differ
 
 
-@partial(jax.jit, static_argnames=("n", "t1", "t2"))
-def _dephase_two(amps, fac, *, n, t1, t2):
-    t = amps.reshape((2,) * n)
+@partial(jax.jit, static_argnames=("n", "targets"))
+def _dephase(amps, fac, *, n, targets):
+    """Scale amplitudes whose row/col bits differ on any target by `fac`
+    (ref densmatr_mixDephasing / TwoQubitDephase, QuEST_cpu.c:48-173)."""
     nq = n // 2
-    differ = (_bit(n, t1) != _bit(n, t1 + nq)) | (_bit(n, t2) != _bit(n, t2 + nq))
-    out = jnp.where(differ, t * fac, t)
-    return out.reshape(-1)
+    qubits = tuple(sorted(
+        set(targets) | set(t + nq for t in targets), reverse=True))
+    dims, axis_of = A.seg_view(n, qubits)
+    differ = _dephase_mask(n, dims, axis_of,
+                           [(t, t + nq) for t in targets])
+    re = amps[0].reshape(dims)
+    im = amps[1].reshape(dims)
+    nre = jnp.where(differ, re * fac, re)
+    nim = jnp.where(differ, im * fac, im)
+    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
 
 
 def mix_dephasing(q: Qureg, target: int, prob) -> Qureg:
     val.validate_density_matr(q)
     val.validate_target(q, target)
     val.validate_one_qubit_dephase_prob(float(prob))
-    fac = jnp.asarray(1.0 - 2.0 * float(prob), dtype=cplx.real_dtype(q.dtype))
-    return q.replace_amps(_dephase_one(q.amps, fac, n=q.num_state_qubits,
-                                       target=int(target)))
+    fac = jnp.asarray(1.0 - 2.0 * float(prob), dtype=q.real_dtype)
+    return q.replace_amps(_dephase(q.amps, fac, n=q.num_state_qubits,
+                                   targets=(int(target),)))
 
 
 def mix_two_qubit_dephasing(q: Qureg, t1: int, t2: int, prob) -> Qureg:
     val.validate_density_matr(q)
     val.validate_multi_targets(q, (t1, t2))
     val.validate_two_qubit_dephase_prob(float(prob))
-    fac = jnp.asarray(1.0 - 4.0 * float(prob) / 3.0, dtype=cplx.real_dtype(q.dtype))
-    return q.replace_amps(_dephase_two(q.amps, fac, n=q.num_state_qubits,
-                                       t1=int(t1), t2=int(t2)))
+    fac = jnp.asarray(1.0 - 4.0 * float(prob) / 3.0, dtype=q.real_dtype)
+    return q.replace_amps(_dephase(q.amps, fac, n=q.num_state_qubits,
+                                   targets=(int(t1), int(t2))))
 
 
 # ---------------------------------------------------------------------------
 # depolarising / damping / Kraus: superoperator on [targets, targets+N]
 # ---------------------------------------------------------------------------
 
-# Sum over all Pauli tensor-products of conj(P) (x) P, split into float
-# real/imag constants (safe to bake into traced programs).
+# Sum over all Pauli tensor-products of conj(P) (x) P, as float (re, im)
+# parts (safe to bake into traced programs).
 def _pauli_twirl_matrix(num_qubits: int) -> np.ndarray:
     dim = 1 << num_qubits
     acc = np.zeros((dim * dim, dim * dim), dtype=np.complex128)
@@ -112,37 +115,35 @@ def _superop_targets(targets, nq):
 
 @partial(jax.jit, static_argnames=("n", "targets"))
 def _apply_packed_superop(amps, sup_pair, *, n, targets):
-    sup = cplx.unpack(sup_pair, amps.dtype)
-    return A.apply_matrix(amps, n, sup, _superop_targets(targets, n // 2))
+    return A.apply_matrix(amps, n, sup_pair,
+                          _superop_targets(targets, n // 2))
 
 
 @partial(jax.jit, static_argnames=("n", "target"))
 def _depol_one(amps, p, *, n, target):
-    rdt = amps.real.dtype
+    rdt = amps.dtype
     p = jnp.asarray(p, dtype=rdt)
     eye = jnp.eye(4, dtype=rdt)
     sup_re = (1.0 - p) * eye + (p / 3.0) * (jnp.asarray(_TWIRL1_RE, rdt) - eye)
     sup_im = (p / 3.0) * jnp.asarray(_TWIRL1_IM, rdt)
-    sup = cplx.make(sup_re, sup_im)
-    return A.apply_matrix(amps, n, sup.astype(amps.dtype),
+    return A.apply_matrix(amps, n, (sup_re, sup_im),
                           _superop_targets((target,), n // 2))
 
 
 @partial(jax.jit, static_argnames=("n", "t1", "t2"))
 def _depol_two(amps, p, *, n, t1, t2):
-    rdt = amps.real.dtype
+    rdt = amps.dtype
     p = jnp.asarray(p, dtype=rdt)
     eye = jnp.eye(16, dtype=rdt)
     sup_re = (1.0 - p) * eye + (p / 15.0) * (jnp.asarray(_TWIRL2_RE, rdt) - eye)
     sup_im = (p / 15.0) * jnp.asarray(_TWIRL2_IM, rdt)
-    sup = cplx.make(sup_re, sup_im)
-    return A.apply_matrix(amps, n, sup.astype(amps.dtype),
+    return A.apply_matrix(amps, n, (sup_re, sup_im),
                           _superop_targets((t1, t2), n // 2))
 
 
 @partial(jax.jit, static_argnames=("n", "target"))
 def _damping(amps, p, *, n, target):
-    rdt = amps.real.dtype
+    rdt = amps.dtype
     p = jnp.asarray(p, dtype=rdt)
     s = jnp.sqrt(1.0 - p)
     # superop = conj(K0) (x) K0 + conj(K1) (x) K1 — all entries real:
@@ -156,16 +157,15 @@ def _damping(amps, p, *, n, target):
         jnp.stack([zero, zero, s, zero]),
         jnp.stack([zero, zero, zero, one - p]),
     ])
-    sup = cplx.make(sup_re, jnp.zeros_like(sup_re))
-    return A.apply_matrix(amps, n, sup.astype(amps.dtype),
+    return A.apply_matrix(amps, n, (sup_re, jnp.zeros_like(sup_re)),
                           _superop_targets((target,), n // 2))
 
 
 def _mix_packed(q: Qureg, targets, sup_np) -> Qureg:
     """Apply a concrete superoperator (numpy complex) via float packing."""
     return q.replace_amps(_apply_packed_superop(
-        q.amps, cplx.pack(sup_np), n=q.num_state_qubits,
-        targets=tuple(int(t) for t in targets)))
+        q.amps, cplx.pack(sup_np),
+        n=q.num_state_qubits, targets=tuple(int(t) for t in targets)))
 
 
 def mix_depolarising(q: Qureg, target: int, prob) -> Qureg:
@@ -237,5 +237,5 @@ def mix_density_matrix(q: Qureg, prob, other: Qureg) -> Qureg:
     val.validate_density_matr(other)
     val.validate_match(q, other)
     val.validate_prob(float(prob))
-    p = jnp.asarray(float(prob), dtype=cplx.real_dtype(q.dtype))
-    return q.replace_amps(_mix_combine(q.amps, other.amps.astype(q.dtype), p))
+    p = jnp.asarray(float(prob), dtype=q.real_dtype)
+    return q.replace_amps(_mix_combine(q.amps, other.amps.astype(q.real_dtype), p))

@@ -2,16 +2,22 @@
 
 Each public QuEST gate (QuEST/include/QuEST.h doc-groups "unitaries" and
 "operators") has a functional equivalent here. Density matrices are handled
-exactly as the reference does (QuEST/src/QuEST.c:8-10): a gate U on targets T
-of a density register additionally applies conj(U) on the column-space copy
-T + N (Choi isomorphism) — both halves are traced into ONE jitted program.
+exactly as the reference does (QuEST/src/QuEST.c:8-10): a gate U on targets
+T of a density register additionally applies conj(U) on the column-space
+copy T + N (Choi isomorphism) — both halves are traced into ONE jitted
+program.
 
-Compilation caching: workers are jitted with static (n, targets, controls)
-and dynamic gate parameters, so e.g. rotating qubit 3 by a new angle reuses
-the compiled program. Parameterized operators are built INSIDE the trace by
-a static builder callable from real-valued parameters; concrete matrices are
-passed as (re, im) float pairs (complex data never crosses the host<->device
-boundary — see quest_tpu.cplx).
+Operands and compilation caching:
+  * named constant gates (X, H, SWAP, ...) are passed as STATIC nested
+    tuples, so their zero entries are skipped at trace time (an X gate
+    compiles to pure data movement — the analogue of the reference's
+    dedicated pauliX kernel, QuEST_cpu.c:2464) and each gate compiles once
+    per (n, targets, controls) shape;
+  * parameterized gates (rotations, phase shifts) pass real scalar
+    parameters dynamically and build the operator INSIDE the trace, so a
+    new angle reuses the compiled program;
+  * user-supplied matrices pass dynamic (re, im) float pairs — complex
+    values never cross the host<->device boundary (quest_tpu.cplx).
 """
 
 from __future__ import annotations
@@ -22,7 +28,6 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from quest_tpu import cplx
 from quest_tpu import validation as val
@@ -35,22 +40,39 @@ from quest_tpu.state import Qureg
 # ---------------------------------------------------------------------------
 
 
+def _shift(qubits, by):
+    return tuple(q + by for q in qubits)
+
+
+@partial(jax.jit, static_argnames=(
+    "n", "targets", "controls", "cstates", "density", "op_re", "op_im",
+    "diagonal", "dual"))
+def _const_gate_worker(amps, *, n, targets, controls, cstates, density,
+                       op_re, op_im, diagonal, dual):
+    pair = (np.array(op_re, dtype=np.float64), np.array(op_im, dtype=np.float64))
+    fn = A.apply_diagonal if diagonal else A.apply_matrix
+    amps = fn(amps, n, pair, targets, controls, cstates)
+    if density and dual:
+        conj = (pair[0], -pair[1])
+        amps = fn(amps, n, conj, _shift(targets, n // 2),
+                  _shift(controls, n // 2), cstates)
+    return amps
+
+
 @partial(jax.jit, static_argnames=(
     "n", "targets", "controls", "cstates", "density", "builder", "diagonal"))
-def _gate_worker(amps, params, *, n, targets, controls, cstates, density,
-                 builder, diagonal):
+def _dyn_gate_worker(amps, params, *, n, targets, controls, cstates, density,
+                     builder, diagonal):
     if builder is not None:
-        op = builder(*[jnp.asarray(p) for p in params])
+        pair = builder(*[jnp.asarray(p) for p in params])
     else:
-        op = cplx.unpack(params, amps.dtype)
-    op = op.astype(amps.dtype)
+        pair = (jnp.asarray(params[0]), jnp.asarray(params[1]))
     fn = A.apply_diagonal if diagonal else A.apply_matrix
-    amps = fn(amps, n, op, targets, controls, cstates)
+    amps = fn(amps, n, pair, targets, controls, cstates)
     if density:
-        shift = n // 2
-        s_targets = tuple(t + shift for t in targets)
-        s_controls = tuple(c + shift for c in controls)
-        amps = fn(amps, n, jnp.conj(op), s_targets, s_controls, cstates)
+        conj = (pair[0], -pair[1])
+        amps = fn(amps, n, conj, _shift(targets, n // 2),
+                  _shift(controls, n // 2), cstates)
     return amps
 
 
@@ -58,64 +80,81 @@ def _gate_worker(amps, params, *, n, targets, controls, cstates, density,
 def _parity_phase_worker(amps, angle, *, n, targets, density):
     amps = A.apply_parity_phase(amps, n, targets, angle)
     if density:
-        shift = n // 2
-        s_targets = tuple(t + shift for t in targets)
-        amps = A.apply_parity_phase(amps, n, s_targets, -angle)
+        amps = A.apply_parity_phase(amps, n, _shift(targets, n // 2), -angle)
     return amps
 
 
 @partial(jax.jit, static_argnames=("n", "qubits", "density"))
 def _all_ones_phase_worker(amps, term_re, term_im, *, n, qubits, density):
-    term = cplx.make(jnp.asarray(term_re), jnp.asarray(term_im)).astype(amps.dtype)
-    amps = A.apply_phase_on_all_ones(amps, n, qubits, term)
+    amps = A.apply_phase_on_all_ones(amps, n, qubits, (term_re, term_im))
     if density:
-        shift = n // 2
-        s_qubits = tuple(q + shift for q in qubits)
-        amps = A.apply_phase_on_all_ones(amps, n, s_qubits, jnp.conj(term))
+        amps = A.apply_phase_on_all_ones(
+            amps, n, _shift(qubits, n // 2), (term_re, -term_im))
     return amps
 
 
+def _tt(arr):
+    """numpy 2-D/1-D array -> hashable nested tuple."""
+    arr = np.asarray(arr)
+    if arr.ndim == 1:
+        return tuple(float(x) for x in arr)
+    return tuple(tuple(float(x) for x in row) for row in arr)
+
+
 def _run(q: Qureg, op, targets, controls=(), cstates=None, builder=None,
-         diagonal=False) -> Qureg:
+         diagonal=False, dual=True, static=False) -> Qureg:
     """Dispatch one gate. `op` is a concrete numpy complex matrix/diagonal
-    when builder is None, else a tuple of real scalar parameters."""
+    when builder is None, else a tuple of real scalar parameters.
+
+    static=True bakes the operand into the compiled program (named constant
+    gates: zero entries skipped, one compile per shape); user-supplied
+    matrices stay dynamic so fresh values reuse the compiled program."""
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     cstates = tuple(int(s) for s in cstates) if cstates is not None \
         else (1,) * len(controls)
-    if builder is None:
-        op = cplx.pack(op)
-    amps = _gate_worker(
-        q.amps, op, n=q.num_state_qubits, targets=targets, controls=controls,
-        cstates=cstates, density=q.is_density, builder=builder,
-        diagonal=diagonal)
+    if static:
+        re, im = cplx.pack(op)
+        amps = _const_gate_worker(
+            q.amps, n=q.num_state_qubits, targets=targets, controls=controls,
+            cstates=cstates, density=q.is_density, op_re=_tt(re),
+            op_im=_tt(im), diagonal=diagonal, dual=dual)
+    elif builder is None:
+        amps = _dyn_gate_worker(
+            q.amps, cplx.pack(op), n=q.num_state_qubits, targets=targets,
+            controls=controls, cstates=cstates, density=q.is_density,
+            builder=None, diagonal=diagonal)
+    else:
+        amps = _dyn_gate_worker(
+            q.amps, op, n=q.num_state_qubits, targets=targets,
+            controls=controls, cstates=cstates, density=q.is_density,
+            builder=builder, diagonal=diagonal)
     return q.replace_amps(amps)
 
 
 def _phase_all_ones(q: Qureg, qubits, term_re, term_im) -> Qureg:
     amps = _all_ones_phase_worker(
-        q.amps, term_re, term_im, n=q.num_state_qubits,
+        q.amps, jnp.asarray(term_re, dtype=q.real_dtype),
+        jnp.asarray(term_im, dtype=q.real_dtype), n=q.num_state_qubits,
         qubits=tuple(int(x) for x in qubits), density=q.is_density)
     return q.replace_amps(amps)
 
 
 # ---------------------------------------------------------------------------
 # traced builders (module-level for stable jit cache keys; all parameters
-# are real scalars, all complex values assembled via lax.complex)
+# are real scalars; operators are (re, im) float array pairs)
 # ---------------------------------------------------------------------------
 
 
-def _assemble_compact(alpha, beta):
-    """[[alpha, -conj(beta)], [beta, conj(alpha)]] from traced complex."""
-    row0 = jnp.stack([alpha, -jnp.conj(beta)])
-    row1 = jnp.stack([beta, jnp.conj(alpha)])
-    return jnp.stack([row0, row1])
+def _assemble_compact(a_re, a_im, b_re, b_im):
+    """[[alpha, -conj(beta)], [beta, conj(alpha)]] as an (re, im) pair."""
+    re = jnp.stack([jnp.stack([a_re, -b_re]), jnp.stack([b_re, a_re])])
+    im = jnp.stack([jnp.stack([a_im, b_im]), jnp.stack([b_im, -a_im])])
+    return re, im
 
 
 def _build_compact(a_re, a_im, b_re, b_im):
-    alpha = cplx.make(a_re, a_im)
-    beta = cplx.make(b_re, b_im)
-    return _assemble_compact(alpha, beta)
+    return _assemble_compact(a_re, a_im, b_re, b_im)
 
 
 def _build_rotation(angle, ax, ay, az):
@@ -125,17 +164,15 @@ def _build_rotation(angle, ax, ay, az):
     ux, uy, uz = ax / norm, ay / norm, az / norm
     half = angle / 2.0
     c, s = jnp.cos(half), jnp.sin(half)
-    alpha = cplx.make(c, -s * uz)
-    beta = cplx.make(s * uy, -s * ux)
-    return _assemble_compact(alpha, beta)
+    return _assemble_compact(c, -s * uz, s * uy, -s * ux)
 
 
 def _build_phase_diag(angle):
-    """diag(1, e^{i angle})."""
+    """diag(1, e^{i angle}) as an (re, im) pair."""
     one = jnp.ones_like(angle)
     zero = jnp.zeros_like(angle)
-    return cplx.make(jnp.stack([one, jnp.cos(angle)]),
-                     jnp.stack([zero, jnp.sin(angle)]))
+    return (jnp.stack([one, jnp.cos(angle)]),
+            jnp.stack([zero, jnp.sin(angle)]))
 
 
 # ---------------------------------------------------------------------------
@@ -190,32 +227,32 @@ def multi_state_controlled_unitary(
 
 def pauli_x(q: Qureg, target: int) -> Qureg:
     val.validate_target(q, target)
-    return _run(q, M.PAULI_X, (target,))
+    return _run(q, M.PAULI_X, (target,), static=True)
 
 
 def pauli_y(q: Qureg, target: int) -> Qureg:
     val.validate_target(q, target)
-    return _run(q, M.PAULI_Y, (target,))
+    return _run(q, M.PAULI_Y, (target,), static=True)
 
 
 def pauli_z(q: Qureg, target: int) -> Qureg:
     val.validate_target(q, target)
-    return _run(q, M.Z_DIAG, (target,), diagonal=True)
+    return _run(q, M.Z_DIAG, (target,), diagonal=True, static=True)
 
 
 def hadamard(q: Qureg, target: int) -> Qureg:
     val.validate_target(q, target)
-    return _run(q, M.HADAMARD, (target,))
+    return _run(q, M.HADAMARD, (target,), static=True)
 
 
 def s_gate(q: Qureg, target: int) -> Qureg:
     val.validate_target(q, target)
-    return _run(q, M.S_DIAG, (target,), diagonal=True)
+    return _run(q, M.S_DIAG, (target,), diagonal=True, static=True)
 
 
 def t_gate(q: Qureg, target: int) -> Qureg:
     val.validate_target(q, target)
-    return _run(q, M.T_DIAG, (target,), diagonal=True)
+    return _run(q, M.T_DIAG, (target,), diagonal=True, static=True)
 
 
 def phase_shift(q: Qureg, target: int, angle) -> Qureg:
@@ -226,12 +263,12 @@ def phase_shift(q: Qureg, target: int, angle) -> Qureg:
 
 def controlled_not(q: Qureg, control: int, target: int) -> Qureg:
     val.validate_control_target(q, control, target)
-    return _run(q, M.PAULI_X, (target,), (control,))
+    return _run(q, M.PAULI_X, (target,), (control,), static=True)
 
 
 def controlled_pauli_y(q: Qureg, control: int, target: int) -> Qureg:
     val.validate_control_target(q, control, target)
-    return _run(q, M.PAULI_Y, (target,), (control,))
+    return _run(q, M.PAULI_Y, (target,), (control,), static=True)
 
 
 # -- rotations ---------------------------------------------------------------
@@ -352,12 +389,12 @@ def multi_rotate_pauli(q: Qureg, targets: Sequence[int], paulis: Sequence[int],
 
 def swap_gate(q: Qureg, qubit1: int, qubit2: int) -> Qureg:
     val.validate_unique_targets(q, qubit1, qubit2)
-    return _run(q, M.SWAP, (qubit1, qubit2))
+    return _run(q, M.SWAP, (qubit1, qubit2), static=True)
 
 
 def sqrt_swap_gate(q: Qureg, qubit1: int, qubit2: int) -> Qureg:
     val.validate_unique_targets(q, qubit1, qubit2)
-    return _run(q, M.SQRT_SWAP, (qubit1, qubit2))
+    return _run(q, M.SQRT_SWAP, (qubit1, qubit2), static=True)
 
 
 def two_qubit_unitary(q: Qureg, target1: int, target2: int, matrix) -> Qureg:
@@ -413,15 +450,17 @@ def apply_pauli_prod(q: Qureg, targets: Sequence[int], paulis: Sequence[int]) ->
         p = int(p)
         if p == 0:
             continue
-        mat = cplx.unpack(cplx.pack(M.PAULIS[p]), q.dtype)
-        amps = A.apply_matrix(q.amps, q.num_state_qubits, mat, (int(t),))
-        q = q.replace_amps(amps)
+        q = _run(q, M.PAULIS[p], (int(t),), dual=False, static=True)
     return q
 
 
 @jax.jit
-def _weighted_sum(a1, a2, a_out, f1, f2, f_out):
-    return f1 * a1 + f2 * a2 + f_out * a_out
+def _weighted_sum(a1, a2, a_out, facs):
+    def scale(planes, fr, fi):
+        return jnp.stack([fr * planes[0] - fi * planes[1],
+                          fr * planes[1] + fi * planes[0]])
+    return (scale(a1, facs[0], facs[1]) + scale(a2, facs[2], facs[3])
+            + scale(a_out, facs[4], facs[5]))
 
 
 def set_weighted_qureg(fac1, q1: Qureg, fac2, q2: Qureg, fac_out, out: Qureg) -> Qureg:
@@ -430,15 +469,10 @@ def set_weighted_qureg(fac1, q1: Qureg, fac2, q2: Qureg, fac_out, out: Qureg) ->
     val.validate_match(q1, out)
     if not (q1.is_density == q2.is_density == out.is_density):
         raise val.QuESTError("Invalid Qureg pair: types must match.")
-    dt = out.dtype
-    rdt = cplx.real_dtype(dt)
-
-    def scal(f):
-        f = complex(f)
-        return cplx.make(jnp.asarray(f.real, dtype=rdt),
-                         jnp.asarray(f.imag, dtype=rdt))
-
-    amps = _weighted_sum(
-        q1.amps.astype(dt), q2.amps.astype(dt), out.amps,
-        scal(fac1), scal(fac2), scal(fac_out))
+    rdt = out.real_dtype
+    f1, f2, fo = complex(fac1), complex(fac2), complex(fac_out)
+    facs = jnp.asarray([f1.real, f1.imag, f2.real, f2.imag, fo.real, fo.imag],
+                       dtype=rdt)
+    amps = _weighted_sum(q1.amps.astype(rdt), q2.amps.astype(rdt), out.amps,
+                         facs)
     return out.replace_amps(amps)

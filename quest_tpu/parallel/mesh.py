@@ -35,7 +35,9 @@ def make_amp_mesh(num_devices: Optional[int] = None,
 
 
 def amp_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(AMP_AXIS))
+    """Shard the amplitude axis of the (2, 2^n) plane array; the re/im
+    plane axis is replicated (each device holds both planes of its chunk)."""
+    return NamedSharding(mesh, P(None, AMP_AXIS))
 
 
 def shard_qureg(q: Qureg, mesh: Mesh) -> Qureg:

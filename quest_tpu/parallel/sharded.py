@@ -20,22 +20,23 @@ Everything below runs INSIDE one shard_map over the 1-D amplitude mesh; the
 whole circuit is a single XLA program, so purely-local stretches fuse and
 the collectives are laid out by the compiler over ICI.
 
-The per-device chunk holds amplitudes whose top log2(D) index bits equal the
-device index — "global" qubits. A gate is local iff all its targets are
-below local_n; the op dispatch is static (targets are trace-time constants),
-exactly as the reference's local/distributed split is resolved per call.
+The per-device chunk is a (2, 2^local_n) plane pair (see quest_tpu.state)
+holding amplitudes whose top log2(D) index bits equal the device index —
+"global" qubits. A gate is local iff all its targets are below local_n; the
+op dispatch is static (targets are trace-time constants), exactly as the
+reference's local/distributed split is resolved per call.
 """
 
 from __future__ import annotations
 
 import math
-from typing import List, Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from quest_tpu import cplx
 from quest_tpu.env import AMP_AXIS
@@ -70,18 +71,20 @@ def _global_pred(dev, glob_controls):
     return pred
 
 
-def _blend(new_flat, old_flat, local_n, loc_c, loc_s, pred):
-    """Keep `new` only where local control mask AND global predicate hold."""
+def _mask_blend(new, old, local_n, loc_c, loc_s, pred):
+    """Keep `new` only where local control mask AND global predicate hold.
+    new/old are (2, 2^local_n) plane pairs."""
     if not loc_c and pred is None:
-        return new_flat
+        return new
     if loc_c:
-        mask = A._control_mask(local_n, loc_c, loc_s)
+        dims, axis_of = A.seg_view(local_n, tuple(sorted(loc_c, reverse=True)))
+        mask = A.control_mask(len(dims), axis_of, loc_c, loc_s)
         if pred is not None:
             mask = mask & pred
-        new_t = jnp.where(mask, new_flat.reshape((2,) * local_n),
-                          old_flat.reshape((2,) * local_n))
-        return new_t.reshape(-1)
-    return jnp.where(pred, new_flat, old_flat)
+        shape = (2,) + dims
+        return jnp.where(mask, new.reshape(shape),
+                         old.reshape(shape)).reshape(2, -1)
+    return jnp.where(pred, new, old)
 
 
 def _swap_global_local(chunk, dev, D, gbit, l, local_n):
@@ -89,26 +92,30 @@ def _swap_global_local(chunk, dev, D, gbit, l, local_n):
     l — a half-chunk ppermute (the reference exchanges full chunks for this,
     QuEST_cpu.c:3539-3578; half is sufficient because only amplitudes whose
     two swapped bits differ move)."""
-    t = chunk.reshape((2,) * local_n)
-    ax = local_n - 1 - l
+    dims, axis_of = A.seg_view(local_n, (l,))
+    t = chunk.reshape((2,) + dims)
+    ax = 1 + axis_of[l]
     g = (dev >> gbit) & 1
     moving = lax.dynamic_slice_in_dim(t, 1 - g, 1, axis=ax)
     recv = lax.ppermute(moving, AMP_AXIS, _pair_perm(D, gbit))
     t = lax.dynamic_update_slice_in_dim(t, recv, 1 - g, axis=ax)
-    return t.reshape(-1)
+    return t.reshape(2, -1)
 
 
 def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
     """General k-qubit matrix gate on the local chunk, distributing over
     global target qubits when needed."""
-    dtype = chunk.dtype
     glob_targets = [t for t in targets if t >= local_n]
 
     if not glob_targets:
         loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
         pred = _global_pred(dev, glob_c)
-        new = A.apply_matrix(chunk, local_n, cplx.unpack(m_pair, dtype), targets)
-        return _blend(new, chunk, local_n, loc_c, loc_s, pred)
+        # local controls are handled inside apply_matrix; only the global
+        # predicate needs an outer blend
+        new = A.apply_matrix(chunk, local_n, m_pair, targets, loc_c, loc_s)
+        if pred is not None:
+            new = jnp.where(pred, new, chunk)
+        return new
 
     if len(targets) == 1:
         loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
@@ -118,13 +125,21 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
         gbit = targets[0] - local_n
         recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
         mybit = (dev >> gbit) & 1
-        m = cplx.unpack(m_pair, dtype)
+        mre = jnp.asarray(m_pair[0], dtype=chunk.dtype)
+        mim = jnp.asarray(m_pair[1], dtype=chunk.dtype)
         # chunk with bit 0 holds "up" amps: new_up = m00*up + m01*lo;
         # bit 1 holds "lo": new_lo = m10*up + m11*lo
-        diag = jnp.where(mybit == 0, m[0, 0], m[1, 1])
-        off = jnp.where(mybit == 0, m[0, 1], m[1, 0])
-        new = diag * chunk + off * recv
-        return _blend(new, chunk, local_n, loc_c, loc_s, pred)
+        dre = jnp.where(mybit == 0, mre[0, 0], mre[1, 1])
+        die = jnp.where(mybit == 0, mim[0, 0], mim[1, 1])
+        ore = jnp.where(mybit == 0, mre[0, 1], mre[1, 0])
+        oie = jnp.where(mybit == 0, mim[0, 1], mim[1, 0])
+        re, im = chunk[0], chunk[1]
+        rre, rim = recv[0], recv[1]
+        new = jnp.stack([
+            dre * re - die * im + ore * rre - oie * rim,
+            dre * im + die * re + ore * rim + oie * rre,
+        ])
+        return _mask_blend(new, chunk, local_n, loc_c, loc_s, pred)
 
     # multi-target with global targets: swap each global target into a local
     # position, apply locally, swap back (ref :1441-1483). Slots not holding
@@ -151,9 +166,10 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
         chunk = _swap_global_local(chunk, dev, D, gt - local_n, l, local_n)
     loc_c, loc_s, glob_c = _split_controls(new_controls, cstates, local_n)
     pred = _global_pred(dev, glob_c)
-    new = A.apply_matrix(chunk, local_n, cplx.unpack(m_pair, chunk.dtype),
-                         relabeled)
-    chunk = _blend(new, chunk, local_n, loc_c, loc_s, pred)
+    new = A.apply_matrix(chunk, local_n, m_pair, relabeled, loc_c, loc_s)
+    if pred is not None:
+        new = jnp.where(pred, new, chunk)
+    chunk = new
     for gbit, l in reversed(swaps):
         chunk = _swap_global_local(chunk, dev, D, gbit, l, local_n)
     return chunk
@@ -164,68 +180,81 @@ def _diagonal_op(chunk, dev, *, local_n, d_pair, targets, controls, cstates):
     table are resolved by indexing with the device's fixed bit (the TPU
     analogue of the reference's global-index parity reads,
     QuEST_cpu.c:2940-3109)."""
-    dtype = chunk.dtype
     loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
     pred = _global_pred(dev, glob_c)
     k = len(targets)
-    d = cplx.unpack(d_pair, dtype).reshape((2,) * k)
+    dre = jnp.asarray(d_pair[0], dtype=chunk.dtype).reshape((2,) * k)
+    dim_ = jnp.asarray(d_pair[1], dtype=chunk.dtype).reshape((2,) * k)
     # diag index bit j <-> targets[j] <-> table axis (k-1-j). Reduce global
     # axes first (ascending j removes the highest remaining axis each time,
     # leaving lower axes untouched).
-    loc_targets = []
     for j in range(k):
         if targets[j] >= local_n:
             bit = (dev >> (targets[j] - local_n)) & 1
-            d = lax.dynamic_index_in_dim(d, bit, axis=k - 1 - j, keepdims=False)
-    for j in range(k):
-        if targets[j] < local_n:
-            loc_targets.append(targets[j])
+            dre = lax.dynamic_index_in_dim(dre, bit, axis=k - 1 - j,
+                                           keepdims=False)
+            dim_ = lax.dynamic_index_in_dim(dim_, bit, axis=k - 1 - j,
+                                            keepdims=False)
+    loc_targets = [t for t in targets if t < local_n]
     if loc_targets:
-        new = A.apply_diagonal(chunk, local_n, d.reshape(-1), loc_targets)
-    else:
-        new = chunk * d  # d is a traced scalar
-    return _blend(new, chunk, local_n, loc_c, loc_s, pred)
+        new = A.apply_diagonal(chunk, local_n,
+                               (dre.reshape(-1), dim_.reshape(-1)),
+                               loc_targets, loc_c, loc_s)
+        if pred is not None:
+            new = jnp.where(pred, new, chunk)
+        return new
+    # d is a traced complex scalar pair
+    re, im = chunk[0], chunk[1]
+    new = jnp.stack([re * dre - im * dim_, re * dim_ + im * dre])
+    return _mask_blend(new, chunk, local_n, loc_c, loc_s, pred)
 
 
 def _parity_op(chunk, dev, *, local_n, targets, angle):
     """exp(-i angle/2 Z...Z): local sign tensor x traced global sign scalar."""
-    rdt = chunk.real.dtype
+    rdt = chunk.dtype
     gsign = None
     for t in targets:
         if t >= local_n:
             s = 1.0 - 2.0 * ((dev >> (t - local_n)) & 1).astype(rdt)
             gsign = s if gsign is None else gsign * s
+    loc = tuple(sorted((t for t in targets if t < local_n), reverse=True))
+    dims, axis_of = A.seg_view(local_n, loc)
     sign = None
-    for t in targets:
-        if t < local_n:
-            shape = [1] * local_n
-            shape[local_n - 1 - t] = 2
-            vec = jnp.array([1.0, -1.0], dtype=rdt).reshape(shape)
-            sign = vec if sign is None else sign * vec
+    for t in loc:
+        shape = [1] * len(dims)
+        shape[axis_of[t]] = 2
+        vec = jnp.array([1.0, -1.0], dtype=rdt).reshape(shape)
+        sign = vec if sign is None else sign * vec
     if sign is None:
         sign = jnp.ones((), dtype=rdt)
     if gsign is not None:
         sign = sign * gsign
     half = jnp.asarray(angle, dtype=rdt) / 2.0
-    factor = cplx.make(jnp.cos(half * sign), -jnp.sin(half * sign))
-    t = chunk.reshape((2,) * local_n)
-    return (t * factor.astype(chunk.dtype)).reshape(-1)
+    cosf = jnp.cos(half)
+    sinf = jnp.sin(half) * sign
+    re = chunk[0].reshape(dims)
+    im = chunk[1].reshape(dims)
+    nre = re * cosf + im * sinf
+    nim = im * cosf - re * sinf
+    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
 
 
 def _all_ones_op(chunk, dev, *, local_n, term_pair, qubits):
     """Phase `term` on amplitudes whose listed qubits are ALL 1; global
     qubits contribute a per-device scalar predicate."""
-    dtype = chunk.dtype
+    rdt = chunk.dtype
     glob = [(q - local_n, 1) for q in qubits if q >= local_n]
     loc = [q for q in qubits if q < local_n]
-    term = cplx.unpack(term_pair, dtype)
+    tre = jnp.asarray(term_pair[0], dtype=rdt).reshape(())
+    tim = jnp.asarray(term_pair[1], dtype=rdt).reshape(())
     pred = _global_pred(dev, glob)
     if pred is not None:
-        one = cplx.cones((), dtype)
-        term = jnp.where(pred, term, one)
+        tre = jnp.where(pred, tre, jnp.ones((), dtype=rdt))
+        tim = jnp.where(pred, tim, jnp.zeros((), dtype=rdt))
     if loc:
-        return A.apply_phase_on_all_ones(chunk, local_n, loc, term)
-    return chunk * term
+        return A.apply_phase_on_all_ones(chunk, local_n, loc, (tre, tim))
+    re, im = chunk[0], chunk[1]
+    return jnp.stack([re * tre - im * tim, re * tim + im * tre])
 
 
 def _apply_gateop(chunk, dev, *, D, local_n, density, op):
@@ -264,7 +293,7 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
                             donate: bool = True):
     """Compile a gate sequence into ONE shard_map program over the mesh —
     the explicit, reference-faithful distributed schedule. Returns a jitted
-    fn: sharded flat amps -> sharded flat amps."""
+    fn: sharded (2, 2^n) planes -> sharded (2, 2^n) planes."""
     D = int(mesh.devices.size)
     g = int(math.log2(D))
     local_n = n - g
@@ -273,15 +302,15 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
     ops = tuple(ops)
 
     def run(chunk):
-        chunk = chunk.reshape(-1)
+        chunk = chunk.reshape(2, -1)
         dev = lax.axis_index(AMP_AXIS)
         for op in ops:
             chunk = _apply_gateop(chunk, dev, D=D, local_n=local_n,
                                   density=density, op=op)
         return chunk
 
-    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(AMP_AXIS),
-                            out_specs=P(AMP_AXIS))
+    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
+                            out_specs=P(None, AMP_AXIS))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

@@ -57,3 +57,14 @@ def real_dtype_of(dtype):
     if d == np.dtype(np.complex128):
         return np.dtype(np.float64)
     return d
+
+
+def complex_dtype_of(dtype):
+    """The logical complex dtype for a real plane dtype (inverse of
+    real_dtype_of)."""
+    d = np.dtype(dtype)
+    if d == np.dtype(np.float32):
+        return np.dtype(np.complex64)
+    if d == np.dtype(np.float64):
+        return np.dtype(np.complex128)
+    return d
