@@ -51,5 +51,7 @@ from quest_tpu.ops import gates
 from quest_tpu import calculations
 from quest_tpu import measurement
 from quest_tpu.circuit import Circuit
+from quest_tpu import qasm
+from quest_tpu import api
 
 __version__ = "0.1.0"

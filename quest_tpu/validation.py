@@ -20,7 +20,48 @@ class QuESTError(ValueError):
     """Raised for any invalid user input (analogue of invalidQuESTInputError)."""
 
 
+def _default_handler(msg: str, func: str = ""):
+    # route through the overridable module-level hook (looked up at call
+    # time so monkeypatching quest_tpu.api.invalidQuESTInputError works,
+    # like redefining the reference's weak symbol, QuEST.h:3163-3190)
+    try:
+        from quest_tpu import api as _api
+        _api.invalidQuESTInputError(msg, func)
+    except ImportError:
+        pass
+    raise QuESTError(msg)
+
+
+_error_handler = _default_handler
+
+
+def set_error_handler(handler) -> None:
+    """Override the invalid-input hook (the reference's overridable weak
+    symbol invalidQuESTInputError, QuEST.h:3163-3190; default raises
+    QuESTError). Pass None to restore the default."""
+    global _error_handler
+    _error_handler = handler if handler is not None else _default_handler
+
+
 def _err(msg: str):
+    import inspect
+    # report the outermost quest_tpu function the USER called (the
+    # reference hands __func__ of the public API fn to the hook) — walk
+    # out of the validation helpers to the last quest_tpu frame
+    func = ""
+    frame = inspect.currentframe()
+    try:
+        f = frame.f_back if frame else None
+        while f is not None:
+            mod = f.f_globals.get("__name__", "")
+            name = f.f_code.co_name
+            if mod.startswith("quest_tpu") and not name.startswith("_"):
+                func = name
+            f = f.f_back
+    finally:
+        del frame
+    _error_handler(msg, func)
+    # a non-raising handler must not let execution continue into the op
     raise QuESTError(msg)
 
 

@@ -1,0 +1,313 @@
+"""Tests for the QuEST-compatible API layer (quest_tpu/api.py) and the QASM
+logger (quest_tpu/qasm.py).
+
+Mirrors the reference's usage patterns: the tutorial circuit end-to-end
+(examples/tutorial_example.c with its known output amplitudes), QASM
+recording behavior (QuEST_qasm.c), and the error hook override the
+reference test suite relies on (tests/main.cpp:27-29).
+"""
+
+import numpy as np
+import pytest
+
+from quest_tpu import api as Q
+from quest_tpu.validation import QuESTError
+
+from . import oracle
+
+
+def test_tutorial_circuit_exact():
+    """The tutorial circuit reproduces the reference binary's output
+    (ref examples/tutorial_example.c:50-105)."""
+    env = Q.createQuESTEnv()
+    qubits = Q.createQureg(3, env)
+    Q.hadamard(qubits, 0)
+    Q.controlledNot(qubits, 0, 1)
+    Q.rotateY(qubits, 2, 0.1)
+    Q.multiControlledPhaseFlip(qubits, [0, 1, 2])
+    u = np.array([[0.5 + 0.5j, 0.5 - 0.5j], [0.5 - 0.5j, 0.5 + 0.5j]])
+    Q.unitary(qubits, 0, u)
+    a, b = 0.5 + 0.5j, 0.5 - 0.5j
+    Q.compactUnitary(qubits, 1, a, b)
+    Q.rotateAroundAxis(qubits, 2, 3.14 / 2, (1.0, 0.0, 0.0))
+    Q.controlledCompactUnitary(qubits, 0, 1, a, b)
+    Q.multiControlledUnitary(qubits, [0, 1], 2, u)
+    toff = Q.createComplexMatrixN(3)
+    toff[6, 7] = 1
+    toff[7, 6] = 1
+    for i in range(6):
+        toff[i, i] = 1
+    Q.multiQubitUnitary(qubits, [0, 1, 2], toff)
+
+    assert Q.getProbAmp(qubits, 7) == pytest.approx(0.112422, abs=2e-6)
+    assert Q.calcProbOfOutcome(qubits, 2, 1) == pytest.approx(0.749178, abs=2e-6)
+    assert Q.calcTotalProb(qubits) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_c_style_signatures():
+    """The C calling convention (explicit counts) also works."""
+    q = Q.createQureg(4)
+    u = np.eye(2, dtype=complex)
+    Q.multiControlledUnitary(q, [0, 1], 2, 3, u)  # nCtrls=2, targ=3
+    Q.multiRotateZ(q, [0, 1, 2], 3, 0.5)
+    Q.multiControlledPhaseShift(q, [0, 1, 2], 3, 0.3)
+    assert Q.calcTotalProb(q) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_amplitude_accessors():
+    q = Q.createQureg(3)
+    Q.initDebugState(q)
+    assert Q.getAmp(q, 3) == pytest.approx((6 + 7j) / 10)
+    assert Q.getRealAmp(q, 2) == pytest.approx(0.4)
+    assert Q.getImagAmp(q, 2) == pytest.approx(0.5)
+    assert Q.getProbAmp(q, 1) == pytest.approx((0.2**2 + 0.3**2))
+    assert Q.getNumQubits(q) == 3
+    assert Q.getNumAmps(q) == 8
+
+    rho = Q.createDensityQureg(2)
+    Q.initDebugState(rho)
+    # flat index r + c*2^N: rho[1, 2] -> 1 + 8 = 9 -> (18 + 19i)/10
+    assert Q.getDensityAmp(rho, 1, 2) == pytest.approx(1.8 + 1.9j)
+
+
+def test_state_initialisations_api():
+    q = Q.createQureg(2)
+    Q.initPlusState(q)
+    assert Q.getRealAmp(q, 3) == pytest.approx(0.5)
+    Q.initClassicalState(q, 2)
+    assert Q.getProbAmp(q, 2) == pytest.approx(1.0)
+    Q.initBlankState(q)
+    assert Q.calcTotalProb(q) == pytest.approx(0.0)
+    Q.initZeroState(q)
+    assert Q.getProbAmp(q, 0) == pytest.approx(1.0)
+    Q.initStateFromAmps(q, [0.5] * 4, [0.5] * 4)
+    assert Q.getAmp(q, 3) == pytest.approx(0.5 + 0.5j)
+    Q.setAmps(q, 1, [0.1], [0.2])
+    assert Q.getAmp(q, 1) == pytest.approx(0.1 + 0.2j)
+
+    pure = Q.createQureg(2)
+    Q.initPlusState(pure)
+    rho = Q.createDensityQureg(2)
+    Q.initPureState(rho, pure)
+    assert Q.calcPurity(rho) == pytest.approx(1.0, abs=1e-6)
+    assert Q.calcFidelity(rho, pure) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_clone_and_weighted():
+    q = Q.createQureg(3)
+    Q.initDebugState(q)
+    c = Q.createCloneQureg(q)
+    assert Q.compareStates(q, c, 1e-12)
+    z = Q.createQureg(3)
+    Q.cloneQureg(z, q)
+    assert Q.compareStates(z, q, 1e-12)
+    Q.setWeightedQureg(2.0, q, -1.0, q, 0.0, z)
+    assert Q.compareStates(z, q, 1e-6)
+
+
+def test_measurement_api():
+    Q.seedQuEST([123])
+    q = Q.createQureg(2)
+    Q.initPlusState(q)
+    outcome = Q.measure(q, 0)
+    assert outcome in (0, 1)
+    assert Q.calcProbOfOutcome(q, 0, outcome) == pytest.approx(1.0, abs=1e-6)
+    outcome2, prob = Q.measureWithStats(q, 1)
+    assert prob == pytest.approx(0.5, abs=1e-6)
+    q2 = Q.createQureg(2)
+    Q.initPlusState(q2)
+    p = Q.collapseToOutcome(q2, 0, 1)
+    assert p == pytest.approx(0.5, abs=1e-6)
+
+
+def test_density_channels_api():
+    rho = Q.createDensityQureg(2)
+    Q.initPlusState(rho)
+    Q.mixDephasing(rho, 0, 0.3)
+    Q.mixTwoQubitDephasing(rho, 0, 1, 0.3)
+    Q.mixDepolarising(rho, 0, 0.3)
+    Q.mixTwoQubitDepolarising(rho, 0, 1, 0.3)
+    Q.mixDamping(rho, 0, 0.2)
+    Q.mixPauli(rho, 0, 0.1, 0.05, 0.2)
+    k0 = np.sqrt(0.5) * np.eye(2)
+    Q.mixKrausMap(rho, 0, [k0, k0])
+    assert Q.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-5)
+    other = Q.createDensityQureg(2)
+    Q.mixDensityMatrix(rho, 0.5, other)
+    assert Q.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_calculations_api():
+    q = Q.createQureg(3)
+    Q.initPlusState(q)
+    w = Q.createQureg(3)
+    Q.initZeroState(w)
+    ip = Q.calcInnerProduct(w, q)
+    assert ip == pytest.approx(1 / np.sqrt(8), abs=1e-6)
+    assert Q.calcExpecPauliProd(q, [0], [Q.PAULI_X]) == pytest.approx(1.0, abs=1e-6)
+    codes = [Q.PAULI_X, Q.PAULI_I, Q.PAULI_I,
+             Q.PAULI_I, Q.PAULI_X, Q.PAULI_I]
+    assert Q.calcExpecPauliSum(q, codes, [0.3, 0.7]) == pytest.approx(1.0, abs=1e-6)
+    rho1 = Q.createDensityQureg(2)
+    rho2 = Q.createDensityQureg(2)
+    assert Q.calcDensityInnerProduct(rho1, rho2) == pytest.approx(1.0, abs=1e-6)
+    assert Q.calcHilbertSchmidtDistance(rho1, rho2) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_apply_pauli_sum_api():
+    q = Q.createQureg(2)
+    Q.initDebugState(q)
+    out = Q.createQureg(2)
+    codes = [Q.PAULI_X, Q.PAULI_I]
+    Q.applyPauliSum(q, codes, [1.0], 1, out)
+    ref = oracle.debug_state_vector(2)
+    x = np.array([[0, 1], [1, 0]], dtype=complex)
+    want = oracle.apply_to_vector(ref, 2, x, [0])
+    got = np.array([Q.getAmp(out, i) for i in range(4)])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# QASM logging (ref QuEST_qasm.c)
+# ---------------------------------------------------------------------------
+
+
+def test_qasm_recording():
+    q = Q.createQureg(3)
+    Q.startRecordingQASM(q)
+    Q.hadamard(q, 0)
+    Q.controlledNot(q, 0, 1)
+    Q.rotateZ(q, 2, 0.5)
+    Q.phaseShift(q, 1, 0.25)
+    Q.stopRecordingQASM(q)
+    Q.pauliX(q, 0)  # not recorded
+    text = q.qasm.recorded()
+    assert text.startswith("OPENQASM 2.0;\nqreg q[3];\ncreg c[3];\n")
+    assert "h q[0];" in text
+    assert "Ctrl-x q[0],q[1];" in text
+    assert "Rz(0.5) q[2];" in text
+    assert "Rz(0.25) q[1];" in text
+    assert text.count("x q[0]") == 1  # the unrecorded pauliX is absent
+
+
+def test_qasm_unitary_zyz_and_phase_fix():
+    q = Q.createQureg(2)
+    Q.startRecordingQASM(q)
+    u = np.array([[0.5 + 0.5j, 0.5 - 0.5j], [0.5 - 0.5j, 0.5 + 0.5j]])
+    Q.controlledUnitary(q, 0, 1, u)
+    text = q.qasm.recorded()
+    assert "Ctrl-U(" in text
+    assert "Restoring the discarded global phase" in text
+
+
+def test_qasm_controlled_phase_gets_global_phase_fix():
+    q = Q.createQureg(2)
+    Q.startRecordingQASM(q)
+    Q.controlledPhaseShift(q, 0, 1, 0.7)
+    text = q.qasm.recorded()
+    assert "Ctrl-Rz(0.7) q[0],q[1];" in text
+    assert "Rz(0.35) q[1];" in text
+
+
+def test_qasm_measurement_and_init():
+    Q.seedQuEST([7])
+    q = Q.createQureg(2)
+    Q.startRecordingQASM(q)
+    Q.initZeroState(q)
+    Q.initClassicalState(q, 2)
+    Q.measure(q, 0)
+    text = q.qasm.recorded()
+    assert "reset q;" in text
+    assert "measure q[0] -> c[0];" in text
+    assert "x q[1];" in text  # from initClassicalState(2)
+
+
+def test_qasm_clear_and_write(tmp_path):
+    q = Q.createQureg(1)
+    Q.startRecordingQASM(q)
+    Q.pauliX(q, 0)
+    Q.clearRecordedQASM(q)
+    assert "x q[0]" not in q.qasm.recorded()
+    Q.pauliY(q, 0)
+    fn = tmp_path / "out.qasm"
+    Q.writeRecordedQASMToFile(q, str(fn))
+    assert "y q[0];" in fn.read_text()
+
+
+def test_multi_state_controlled_qasm():
+    q = Q.createQureg(3)
+    Q.startRecordingQASM(q)
+    u = np.eye(2, dtype=complex)
+    Q.multiStateControlledUnitary(q, [0, 1], [0, 1], 2, u)
+    text = q.qasm.recorded()
+    assert "NOTing" in text
+    assert text.count("x q[0];") == 2  # flip and unflip of the 0-controlled
+
+
+# ---------------------------------------------------------------------------
+# debug / reporting API
+# ---------------------------------------------------------------------------
+
+
+def test_report_state_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    q = Q.createQureg(3)
+    Q.initDebugState(q)
+    Q.reportState(q)
+    q2 = Q.createQureg(3)
+    assert Q.initStateFromSingleFile(q2, "state_rank_0.csv")
+    assert Q.compareStates(q, q2, 1e-9)
+
+
+def test_init_state_of_single_qubit():
+    q = Q.createQureg(3)
+    Q.initStateOfSingleQubit(q, 1, 1)
+    # uniform over the 4 basis states with bit 1 set
+    for k in range(8):
+        want = 0.5 if (k >> 1) & 1 else 0.0
+        assert Q.getRealAmp(q, k) == pytest.approx(want, abs=1e-6)
+    assert Q.calcTotalProb(q) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_environment_string_and_precision():
+    env = Q.createQuESTEnv()
+    q = Q.createQureg(2, env)
+    s = Q.getEnvironmentString(env, q)
+    assert "2qubits" in s
+    assert Q.QuESTPrecision() in (1, 2)
+
+
+def test_error_handler_override():
+    q = Q.createQureg(2)
+    with pytest.raises(QuESTError, match="Invalid target"):
+        Q.pauliX(q, 5)
+
+    calls = []
+
+    def handler(msg, func):
+        calls.append((msg, func))
+
+    Q.set_input_error_handler(handler)
+    try:
+        with pytest.raises(QuESTError):
+            Q.pauliX(q, 5)  # still halts execution after the hook
+        assert calls and "Invalid target" in calls[0][0]
+        assert calls[0][1] == "pauliX"  # the USER-called API fn, not a helper
+    finally:
+        Q.set_input_error_handler(None)
+
+
+def test_invalid_input_hook_monkeypatch(monkeypatch):
+    """Monkeypatching api.invalidQuESTInputError overrides error behavior
+    (the analogue of redefining the reference's weak symbol)."""
+    q = Q.createQureg(2)
+    seen = []
+
+    def hook(msg, func):
+        seen.append((msg, func))
+        raise Q._val.QuESTError("custom: " + msg)
+
+    monkeypatch.setattr(Q, "invalidQuESTInputError", hook)
+    with pytest.raises(QuESTError, match="custom: Invalid target"):
+        Q.hadamard(q, 9)
+    assert seen[0][1] == "hadamard"
