@@ -1,6 +1,11 @@
 """Port of the reference Bernstein--Vazirani circuit
 (examples/bernstein_vazirani_circuit.c), 1:1 through the compatible API."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 from quest_tpu.api import (
     createQuESTEnv, createQureg, destroyQureg, destroyQuESTEnv,
     initZeroState, pauliX, controlledNot, calcProbOfOutcome,

@@ -2,6 +2,11 @@
 through the compatible API: repeated amplitude damping of a |+> qubit held
 as a density matrix."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 from quest_tpu.api import (
     createQuESTEnv, createDensityQureg, destroyQureg, destroyQuESTEnv,
     initPlusState, mixDamping, reportStateToScreen,

@@ -6,6 +6,11 @@ Expected output (matches the reference binary):
   Probability of qubit 2 being in state 1: 0.749178
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import numpy as np
 
 from quest_tpu.api import (

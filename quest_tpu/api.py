@@ -808,8 +808,8 @@ def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None,
     """Print amplitudes (<=5 qubits, like the reference's guard,
     QuEST_cpu.c:1334-1357)."""
     print("Reporting state from rank 0:")
-    if qureg.state.num_state_qubits > 5:
-        print("(state too large to print)")
+    if qureg.state.num_qubits > 5:  # guard on represented qubits, like the
+        print("(state too large to print)")  # reference (QuEST_cpu.c:1337)
         return
     vec = _state.to_dense(qureg.state).reshape(-1, order="F")
     for a in vec:

@@ -1,0 +1,167 @@
+"""Calculations-group tests (mirrors reference test_calculations.cpp:
+one case per calc* function, random states, exhaustive qubit sweeps,
+amplitude-level comparison against the dense NumPy oracle)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import calculations as C
+from quest_tpu import measurement as meas
+from quest_tpu.ops import gates as G
+from quest_tpu.state import init_state_from_amps, to_dense
+from quest_tpu.validation import QuESTError
+
+from . import oracle
+from .helpers import N
+
+
+def load_sv(vec, dtype=np.complex128):
+    n = int(np.log2(len(vec)))
+    q = qt.create_qureg(n, dtype=dtype)
+    return init_state_from_amps(q, vec.real, vec.imag)
+
+
+def load_dm(rho, dtype=np.complex128):
+    n = int(np.log2(rho.shape[0]))
+    q = qt.create_density_qureg(n, dtype=dtype)
+    flat = rho.reshape(-1, order="F")
+    return init_state_from_amps(q, flat.real, flat.imag)
+
+
+def test_calc_total_prob(rng):
+    v = oracle.random_statevector(N, rng)
+    assert C.calc_total_prob(load_sv(v)) == pytest.approx(1.0, abs=1e-10)
+    rho = oracle.random_density(N, rng)
+    assert C.calc_total_prob(load_dm(rho)) == pytest.approx(1.0, abs=1e-10)
+    # unnormalized states report their actual norm/trace
+    assert C.calc_total_prob(load_sv(2.0 * v)) == pytest.approx(4.0, abs=1e-9)
+
+
+def test_calc_inner_product(rng):
+    a = oracle.random_statevector(N, rng)
+    b = oracle.random_statevector(N, rng)
+    got = C.calc_inner_product(load_sv(a), load_sv(b))
+    assert got == pytest.approx(np.vdot(a, b), abs=1e-10)
+
+
+def test_calc_inner_product_validation(rng):
+    sv = load_sv(oracle.random_statevector(N, rng))
+    dm = load_dm(oracle.random_density(N, rng))
+    with pytest.raises(QuESTError, match="state-vector"):
+        C.calc_inner_product(sv, dm)
+    small = qt.create_qureg(N - 1)
+    with pytest.raises(QuESTError, match="dimensions"):
+        C.calc_inner_product(sv, small)
+
+
+def test_calc_density_inner_product(rng):
+    r1 = oracle.random_density(N, rng)
+    r2 = oracle.random_density(N, rng)
+    got = C.calc_density_inner_product(load_dm(r1), load_dm(r2))
+    assert got == pytest.approx(np.trace(r1 @ r2).real, abs=1e-10)
+
+
+def test_calc_purity(rng):
+    rho = oracle.random_density(N, rng, rank=2)
+    assert C.calc_purity(load_dm(rho)) == pytest.approx(
+        np.trace(rho @ rho).real, abs=1e-10)
+    pure = oracle.random_statevector(N, rng)
+    rho_pure = np.outer(pure, pure.conj())
+    assert C.calc_purity(load_dm(rho_pure)) == pytest.approx(1.0, abs=1e-10)
+
+
+def test_calc_fidelity_statevec(rng):
+    a = oracle.random_statevector(N, rng)
+    b = oracle.random_statevector(N, rng)
+    got = C.calc_fidelity(load_sv(a), load_sv(b))
+    assert got == pytest.approx(abs(np.vdot(a, b)) ** 2, abs=1e-10)
+
+
+def test_calc_fidelity_density(rng):
+    rho = oracle.random_density(N, rng)
+    psi = oracle.random_statevector(N, rng)
+    got = C.calc_fidelity(load_dm(rho), load_sv(psi))
+    assert got == pytest.approx((psi.conj() @ rho @ psi).real, abs=1e-10)
+
+
+def test_calc_hilbert_schmidt_distance(rng):
+    r1 = oracle.random_density(N, rng)
+    r2 = oracle.random_density(N, rng)
+    got = C.calc_hilbert_schmidt_distance(load_dm(r1), load_dm(r2))
+    assert got == pytest.approx(np.sqrt(np.sum(np.abs(r1 - r2) ** 2)),
+                                abs=1e-10)
+    with pytest.raises(QuESTError, match="density"):
+        C.calc_hilbert_schmidt_distance(load_dm(r1),
+                                        load_sv(oracle.random_statevector(N, rng)))
+
+
+PAULI_MATS = {0: np.eye(2), 1: np.array([[0, 1], [1, 0]]),
+              2: np.array([[0, -1j], [1j, 0]]), 3: np.array([[1, 0], [0, -1]])}
+
+
+def _pauli_prod_matrix(n, targets, codes):
+    op = np.eye(1)
+    for q in reversed(range(n)):
+        local = np.eye(2)
+        for t, c in zip(targets, codes):
+            if t == q:
+                local = PAULI_MATS[int(c)]
+        op = np.kron(op, local)
+    return op
+
+
+@pytest.mark.parametrize("codes", [(1,), (2,), (3,), (1, 2), (3, 3), (1, 2, 3)])
+def test_calc_expec_pauli_prod(codes, rng):
+    targets = list(rng.choice(N, size=len(codes), replace=False))
+    v = oracle.random_statevector(N, rng)
+    op = _pauli_prod_matrix(N, targets, codes)
+    want = (v.conj() @ op @ v).real
+    got = C.calc_expec_pauli_prod(load_sv(v), targets, list(codes))
+    assert got == pytest.approx(want, abs=1e-9)
+
+    rho = oracle.random_density(N, rng)
+    want_dm = np.trace(op @ rho).real
+    got_dm = C.calc_expec_pauli_prod(load_dm(rho), targets, list(codes))
+    assert got_dm == pytest.approx(want_dm, abs=1e-9)
+
+
+def test_calc_expec_pauli_sum(rng):
+    n_terms = 4
+    codes = rng.integers(0, 4, size=(n_terms, N))
+    coeffs = rng.normal(size=n_terms)
+    v = oracle.random_statevector(N, rng)
+    want = 0.0
+    for term, c in zip(codes, coeffs):
+        op = _pauli_prod_matrix(N, list(range(N)), term)
+        want += c * (v.conj() @ op @ v).real
+    got = C.calc_expec_pauli_sum(load_sv(v), codes, coeffs)
+    assert got == pytest.approx(want, abs=1e-8)
+
+
+@pytest.mark.parametrize("qubit", range(N))
+@pytest.mark.parametrize("outcome", [0, 1])
+def test_calc_prob_of_outcome(qubit, outcome, rng):
+    v = oracle.random_statevector(N, rng)
+    mask = (np.arange(1 << N) >> qubit) & 1
+    want = float(np.sum(np.abs(v[mask == outcome]) ** 2))
+    got = meas.calc_prob_of_outcome(load_sv(v), qubit, outcome)
+    assert got == pytest.approx(want, abs=1e-10)
+
+    rho = oracle.random_density(N, rng)
+    d = np.diagonal(rho).real
+    want_dm = float(np.sum(d[mask == outcome]))
+    got_dm = meas.calc_prob_of_outcome(load_dm(rho), qubit, outcome)
+    assert got_dm == pytest.approx(want_dm, abs=1e-10)
+
+
+def test_calc_validation_errors(rng):
+    sv = load_sv(oracle.random_statevector(N, rng))
+    with pytest.raises(QuESTError, match="density"):
+        C.calc_purity(sv)
+    with pytest.raises(QuESTError, match="Invalid target"):
+        meas.calc_prob_of_outcome(sv, N, 0)
+    with pytest.raises(QuESTError, match="outcome"):
+        meas.calc_prob_of_outcome(sv, 0, 2)
+    with pytest.raises(QuESTError, match="Pauli"):
+        C.calc_expec_pauli_prod(sv, [0], [7])

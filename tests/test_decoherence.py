@@ -1,0 +1,149 @@
+"""Decoherence-group tests (mirrors reference test_decoherence.cpp: one
+case per mix* channel, exhaustive target sweeps, random density matrices,
+amplitude-level comparison against a Kraus-map NumPy oracle)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.ops import channels as ch
+from quest_tpu.state import init_state_from_amps, to_dense
+from quest_tpu.validation import QuESTError
+
+from . import oracle
+from .helpers import N
+from .test_calculations import load_dm
+
+I2 = np.eye(2)
+X = np.array([[0, 1], [1, 0]], dtype=complex)
+Y = np.array([[0, -1j], [1j, 0]])
+Z = np.array([[1, 0], [0, -1]], dtype=complex)
+
+
+def check_channel(apply_fn, kraus_ops, targets, rng, tol=1e-9):
+    rho = oracle.random_density(N, rng)
+    got = to_dense(apply_fn(load_dm(rho)))
+    want = oracle.apply_kraus_to_density(rho, N, kraus_ops, targets)
+    np.testing.assert_allclose(got, want, atol=tol, rtol=0)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_dephasing(target, rng):
+    p = 0.3
+    ops = [np.sqrt(1 - p) * I2, np.sqrt(p) * Z]
+    check_channel(lambda q: ch.mix_dephasing(q, target, p), ops, [target], rng)
+
+
+@pytest.mark.parametrize("t1,t2", [(0, 1), (1, 3), (4, 2), (0, 4)])
+def test_mix_two_qubit_dephasing(t1, t2, rng):
+    p = 0.5
+    # rho -> (1-p) rho + p/3 (Z1 rho Z1 + Z2 rho Z2 + Z1Z2 rho Z1Z2)
+    # (ref QuEST.h mixTwoQubitDephasing docs)
+    z1 = np.kron(I2, Z)   # matrix bit 0 = first target
+    z2 = np.kron(Z, I2)
+    ops = [np.sqrt(1 - p) * np.eye(4), np.sqrt(p / 3) * z1,
+           np.sqrt(p / 3) * z2, np.sqrt(p / 3) * (z2 @ z1)]
+    check_channel(lambda q: ch.mix_two_qubit_dephasing(q, t1, t2, p),
+                  ops, [t1, t2], rng)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_depolarising(target, rng):
+    p = 0.6
+    ops = [np.sqrt(1 - p) * I2, np.sqrt(p / 3) * X, np.sqrt(p / 3) * Y,
+           np.sqrt(p / 3) * Z]
+    check_channel(lambda q: ch.mix_depolarising(q, target, p), ops,
+                  [target], rng)
+
+
+@pytest.mark.parametrize("t1,t2", [(0, 1), (2, 4), (3, 0)])
+def test_mix_two_qubit_depolarising(t1, t2, rng):
+    p = 0.8
+    paulis = [I2, X, Y, Z]
+    ops = []
+    for i, p2 in enumerate(paulis):
+        for j, p1 in enumerate(paulis):
+            m = np.kron(p2, p1)
+            if i == 0 and j == 0:
+                ops.append(np.sqrt(1 - p) * m)
+            else:
+                ops.append(np.sqrt(p / 15) * m)
+    check_channel(lambda q: ch.mix_two_qubit_depolarising(q, t1, t2, p),
+                  ops, [t1, t2], rng)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_damping(target, rng):
+    p = 0.35
+    k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]])
+    k1 = np.array([[0, np.sqrt(p)], [0, 0]])
+    check_channel(lambda q: ch.mix_damping(q, target, p), [k0, k1],
+                  [target], rng)
+
+
+@pytest.mark.parametrize("target", range(N))
+def test_mix_pauli(target, rng):
+    px, py, pz = 0.1, 0.15, 0.05
+    ops = [np.sqrt(1 - px - py - pz) * I2, np.sqrt(px) * X,
+           np.sqrt(py) * Y, np.sqrt(pz) * Z]
+    check_channel(lambda q: ch.mix_pauli(q, target, px, py, pz), ops,
+                  [target], rng)
+
+
+@pytest.mark.parametrize("target", range(N))
+@pytest.mark.parametrize("num_ops", [1, 2, 4])
+def test_mix_kraus_map(target, num_ops, rng):
+    ops = oracle.random_kraus_map(1, num_ops, rng)
+    check_channel(lambda q: ch.mix_kraus_map(q, target, ops), ops,
+                  [target], rng)
+
+
+@pytest.mark.parametrize("t1,t2", [(0, 1), (3, 1), (2, 4)])
+@pytest.mark.parametrize("num_ops", [1, 4, 16])
+def test_mix_two_qubit_kraus_map(t1, t2, num_ops, rng):
+    ops = oracle.random_kraus_map(2, num_ops, rng)
+    check_channel(lambda q: ch.mix_two_qubit_kraus_map(q, t1, t2, ops), ops,
+                  [t1, t2], rng)
+
+
+@pytest.mark.parametrize("targets", [(0,), (1, 3), (0, 2, 4)])
+def test_mix_multi_qubit_kraus_map(targets, rng):
+    k = len(targets)
+    ops = oracle.random_kraus_map(k, 1 << k, rng)
+    check_channel(lambda q: ch.mix_multi_qubit_kraus_map(q, list(targets), ops),
+                  ops, list(targets), rng)
+
+
+def test_mix_density_matrix(rng):
+    r1 = oracle.random_density(N, rng)
+    r2 = oracle.random_density(N, rng)
+    p = 0.3
+    got = to_dense(ch.mix_density_matrix(load_dm(r1), p, load_dm(r2)))
+    np.testing.assert_allclose(got, (1 - p) * r1 + p * r2, atol=1e-10)
+
+
+# -- input validation (prob ceilings per channel, ref QuEST_validation.c:113-117)
+
+
+def test_channel_validation(rng):
+    rho = load_dm(oracle.random_density(2, rng))
+    sv = qt.create_qureg(2)
+    with pytest.raises(QuESTError, match="density"):
+        ch.mix_dephasing(sv, 0, 0.1)
+    with pytest.raises(QuESTError, match="probability"):
+        ch.mix_dephasing(rho, 0, 0.6)       # > 1/2
+    with pytest.raises(QuESTError, match="probability"):
+        ch.mix_two_qubit_dephasing(rho, 0, 1, 0.8)  # > 3/4
+    with pytest.raises(QuESTError, match="probability"):
+        ch.mix_depolarising(rho, 0, 0.8)    # > 3/4
+    with pytest.raises(QuESTError, match="probability"):
+        ch.mix_two_qubit_depolarising(rho, 0, 1, 0.95)  # > 15/16
+    with pytest.raises(QuESTError, match="probability"):
+        ch.mix_damping(rho, 0, 1.5)
+    with pytest.raises(QuESTError, match="probability"):
+        ch.mix_pauli(rho, 0, 0.5, 0.4, 0.3)
+    with pytest.raises(QuESTError, match="Invalid target"):
+        ch.mix_damping(rho, 5, 0.1)
+    # non-CPTP map rejected
+    with pytest.raises(QuESTError, match="trace-preserving"):
+        ch.mix_kraus_map(rho, 0, [np.eye(2) * 0.5])

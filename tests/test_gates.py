@@ -1,0 +1,109 @@
+"""Gates-group tests: measurement and collapse (mirrors reference
+test_gates.cpp — measure, measureWithStats, collapseToOutcome — with
+seeded-RNG determinism and both register kinds)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import measurement as meas
+from quest_tpu import random_ as rng_mod
+from quest_tpu.state import to_dense
+from quest_tpu.validation import QuESTError
+
+from . import oracle
+from .helpers import N
+from .test_calculations import load_sv, load_dm
+
+
+@pytest.mark.parametrize("qubit", range(N))
+def test_collapse_to_outcome_statevec(qubit, rng):
+    v = oracle.random_statevector(N, rng)
+    for outcome in (0, 1):
+        q, prob = meas.collapse_to_outcome(load_sv(v), qubit, outcome)
+        mask = ((np.arange(1 << N) >> qubit) & 1) == outcome
+        want_prob = float(np.sum(np.abs(v[mask]) ** 2))
+        assert prob == pytest.approx(want_prob, abs=1e-10)
+        want = np.where(mask, v, 0.0) / np.sqrt(want_prob)
+        np.testing.assert_allclose(to_dense(q), want, atol=1e-9)
+
+
+@pytest.mark.parametrize("qubit", range(N))
+def test_collapse_to_outcome_density(qubit, rng):
+    rho = oracle.random_density(N, rng)
+    proj0 = np.diag((((np.arange(1 << N) >> qubit) & 1) == 0).astype(float))
+    q, prob = meas.collapse_to_outcome(load_dm(rho), qubit, 0)
+    want_prob = np.trace(proj0 @ rho).real
+    assert prob == pytest.approx(want_prob, abs=1e-10)
+    want = proj0 @ rho @ proj0 / want_prob
+    np.testing.assert_allclose(to_dense(q), want, atol=1e-9)
+
+
+def test_collapse_impossible_outcome_errors():
+    q = qt.init_classical_state(qt.create_qureg(2), 0)
+    with pytest.raises(QuESTError, match="probability"):
+        meas.collapse_to_outcome(q, 0, 1)  # P(1) = 0
+
+
+def test_measure_deterministic_state():
+    q = qt.init_classical_state(qt.create_qureg(3), 0b101)
+    for qubit, want in [(0, 1), (1, 0), (2, 1)]:
+        q, outcome = meas.measure(q, qubit)
+        assert outcome == want
+
+
+def test_measure_seeded_reproducible():
+    outs1, outs2 = [], []
+    for outs in (outs1, outs2):
+        rng_mod.seed_quest([42])
+        q = qt.init_plus_state(qt.create_qureg(N))
+        for qubit in range(N):
+            q, o = meas.measure(q, qubit)
+            outs.append(o)
+    assert outs1 == outs2
+
+
+def test_measure_with_stats_probability():
+    rng_mod.seed_quest([7])
+    q = qt.init_plus_state(qt.create_qureg(2))
+    q, outcome, prob = meas.measure_with_stats(q, 0)
+    assert prob == pytest.approx(0.5, abs=1e-6)
+    # post-measurement state is an eigenstate
+    assert meas.calc_prob_of_outcome(q, 0, outcome) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_measure_density(rng):
+    rng_mod.seed_quest([3])
+    rho = oracle.random_density(N, rng)
+    q, outcome, prob = meas.measure_with_stats(load_dm(rho), 0)
+    assert 0 < prob <= 1
+    assert meas.calc_prob_of_outcome(q, 0, outcome) == pytest.approx(1.0, abs=1e-8)
+    # trace preserved after collapse
+    from quest_tpu import calculations as C
+    assert C.calc_total_prob(q) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_measure_functional_traced():
+    import jax
+    key = jax.random.PRNGKey(0)
+    q = qt.init_plus_state(qt.create_qureg(3))
+    q2, outcome, prob = meas.measure_functional(q, 1, key)
+    outcome = int(outcome)
+    assert outcome in (0, 1)
+    assert float(prob) == pytest.approx(0.5, abs=1e-6)
+    assert meas.calc_prob_of_outcome(q2, 1, outcome) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_measure_statistics():
+    """Frequency of outcomes approximates the amplitude distribution
+    (the reference checks this with many trials)."""
+    rng_mod.seed_quest([99])
+    import quest_tpu.ops.gates as G
+    ones = 0
+    trials = 200
+    for _ in range(trials):
+        q = qt.create_qureg(1)
+        q = G.rotate_y(q, 0, 2 * np.arcsin(np.sqrt(0.3)))  # P(1) = 0.3
+        q, o = meas.measure(q, 0)
+        ones += o
+    assert abs(ones / trials - 0.3) < 0.12

@@ -1,0 +1,71 @@
+"""Operators-group tests (mirrors reference test_operators.cpp:
+applyPauliSum) plus the non-unitary helpers setWeightedQureg and
+applyPauliProd."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import calculations as C
+from quest_tpu.ops import gates as G
+from quest_tpu.state import to_dense
+from quest_tpu.validation import QuESTError
+
+from . import oracle
+from .helpers import N
+from .test_calculations import load_sv, load_dm, _pauli_prod_matrix
+
+
+def test_apply_pauli_sum_statevec(rng):
+    n_terms = 3
+    codes = rng.integers(0, 4, size=(n_terms, N))
+    coeffs = rng.normal(size=n_terms)
+    v = oracle.random_statevector(N, rng)
+    want = np.zeros_like(v)
+    for term, c in zip(codes, coeffs):
+        want = want + c * (_pauli_prod_matrix(N, list(range(N)), term) @ v)
+    got = to_dense(C.apply_pauli_sum(load_sv(v), codes, coeffs))
+    np.testing.assert_allclose(got, want, atol=1e-9)
+
+
+def test_apply_pauli_sum_density(rng):
+    """On density registers the reference multiplies the ROW space only
+    (P rho, not P rho P+) — statevec_applyPauliSum on the doubled register
+    (QuEST_common.c:493-514)."""
+    codes = np.array([[1] + [0] * (N - 1)])
+    rho = oracle.random_density(N, rng)
+    x0 = _pauli_prod_matrix(N, [0], [1])
+    got = to_dense(C.apply_pauli_sum(load_dm(rho), codes, [2.0]))
+    np.testing.assert_allclose(got, 2.0 * (x0 @ rho), atol=1e-9)
+
+
+def test_apply_pauli_prod(rng):
+    v = oracle.random_statevector(N, rng)
+    got = to_dense(G.apply_pauli_prod(load_sv(v), [0, 2], [2, 3]))
+    op = _pauli_prod_matrix(N, [0, 2], [2, 3])
+    np.testing.assert_allclose(got, op @ v, atol=1e-9)
+
+
+def test_set_weighted_qureg(rng):
+    a = oracle.random_statevector(N, rng)
+    b = oracle.random_statevector(N, rng)
+    c = oracle.random_statevector(N, rng)
+    f1, f2, fo = 0.3 - 0.1j, -0.6 + 2.0j, 1.5 + 0.5j
+    out = G.set_weighted_qureg(f1, load_sv(a), f2, load_sv(b), fo, load_sv(c))
+    np.testing.assert_allclose(to_dense(out), f1 * a + f2 * b + fo * c,
+                               atol=1e-9)
+
+
+def test_set_weighted_qureg_validation(rng):
+    sv = load_sv(oracle.random_statevector(N, rng))
+    dm = load_dm(oracle.random_density(N, rng))
+    with pytest.raises(QuESTError, match="types"):
+        G.set_weighted_qureg(1, sv, 1, sv, 0, dm)
+
+
+def test_apply_pauli_sum_validation(rng):
+    sv = load_sv(oracle.random_statevector(N, rng))
+    with pytest.raises(QuESTError, match="Pauli"):
+        C.apply_pauli_sum(sv, np.full((1, N), 9), [1.0])
+    with pytest.raises(QuESTError, match="terms"):
+        C.apply_pauli_sum(sv, np.zeros((0, N), dtype=int), [])

@@ -1,0 +1,142 @@
+"""State-initialisation tests (mirrors reference
+test_state_initialisations.cpp: one case per init*/set* function, both
+register kinds, amplitude-level checks)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import state as S
+from quest_tpu.validation import QuESTError
+
+from . import oracle
+from .helpers import N
+
+
+def test_init_blank_state():
+    for make in (qt.create_qureg, qt.create_density_qureg):
+        q = S.init_blank_state(make(N))
+        assert np.all(S.to_dense(q) == 0)
+
+
+def test_init_zero_state():
+    sv = S.init_zero_state(S.init_debug_state(qt.create_qureg(N)))
+    want = np.zeros(1 << N, dtype=complex)
+    want[0] = 1
+    np.testing.assert_array_equal(S.to_dense(sv), want)
+
+    dm = S.init_zero_state(qt.create_density_qureg(N))
+    rho = S.to_dense(dm)
+    assert rho[0, 0] == 1 and np.sum(np.abs(rho)) == 1
+
+
+def test_init_plus_state():
+    sv = S.init_plus_state(qt.create_qureg(N))
+    np.testing.assert_allclose(
+        S.to_dense(sv), np.full(1 << N, 1 / np.sqrt(1 << N)), atol=1e-7)
+    dm = S.init_plus_state(qt.create_density_qureg(N))
+    np.testing.assert_allclose(
+        S.to_dense(dm), np.full((1 << N, 1 << N), 1 / (1 << N)), atol=1e-7)
+
+
+@pytest.mark.parametrize("index", [0, 1, 13, 31])
+def test_init_classical_state(index):
+    sv = S.init_classical_state(qt.create_qureg(N), index)
+    want = np.zeros(1 << N, dtype=complex)
+    want[index] = 1
+    np.testing.assert_array_equal(S.to_dense(sv), want)
+
+    dm = S.init_classical_state(qt.create_density_qureg(N), index)
+    rho = S.to_dense(dm)
+    assert rho[index, index] == 1
+    assert np.sum(np.abs(rho)) == 1
+
+
+def test_init_classical_validation():
+    with pytest.raises(QuESTError, match="state index"):
+        S.init_classical_state(qt.create_qureg(2), 4)
+
+
+def test_init_debug_state():
+    q = S.init_debug_state(qt.create_qureg(2))
+    np.testing.assert_allclose(
+        S.to_dense(q),
+        [(0 + 1j) / 10, (2 + 3j) / 10, (4 + 5j) / 10, (6 + 7j) / 10],
+        atol=1e-7)
+
+
+def test_init_pure_state(rng):
+    v = oracle.random_statevector(N, rng)
+    pure = S.init_state_from_amps(qt.create_qureg(N, dtype=np.complex128),
+                                  v.real, v.imag)
+    sv = S.init_pure_state(qt.create_qureg(N, dtype=np.complex128), pure)
+    np.testing.assert_allclose(S.to_dense(sv), v, atol=1e-12)
+
+    dm = S.init_pure_state(qt.create_density_qureg(N, dtype=np.complex128), pure)
+    np.testing.assert_allclose(S.to_dense(dm), np.outer(v, v.conj()),
+                               atol=1e-12)
+
+
+def test_init_pure_state_validation(rng):
+    dm = qt.create_density_qureg(N)
+    with pytest.raises(QuESTError, match="statevector"):
+        S.init_pure_state(qt.create_qureg(N), dm)
+
+
+def test_init_state_from_amps_and_validation(rng):
+    v = oracle.random_statevector(3, rng)
+    q = S.init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                               v.real, v.imag)
+    np.testing.assert_allclose(S.to_dense(q), v, atol=1e-12)
+    with pytest.raises(QuESTError, match="number of amplitudes"):
+        S.init_state_from_amps(qt.create_qureg(3), v.real[:4], v.imag[:4])
+    with pytest.raises(QuESTError, match="equal length"):
+        S.init_state_from_amps(qt.create_qureg(3), v.real, v.imag[:4])
+
+
+def test_set_amps(rng):
+    q = S.init_debug_state(qt.create_qureg(3, dtype=np.complex128))
+    re = [9.0, 8.0]
+    im = [-1.0, -2.0]
+    q = S.set_amps(q, 3, re, im)
+    out = S.to_dense(q)
+    assert out[3] == pytest.approx(9 - 1j)
+    assert out[4] == pytest.approx(8 - 2j)
+    assert out[2] == pytest.approx((4 + 5j) / 10)  # untouched
+    with pytest.raises(QuESTError, match="number of amplitudes"):
+        S.set_amps(q, 7, re, im)
+    with pytest.raises(QuESTError, match="statevector"):
+        S.set_amps(qt.create_density_qureg(2), 0, re, im)
+
+
+def test_set_density_amps():
+    q = qt.create_density_qureg(2, dtype=np.complex128)
+    q = S.set_density_amps(q, 1, 2, [0.5], [0.25])
+    rho = S.to_dense(q)
+    assert rho[1, 2] == pytest.approx(0.5 + 0.25j)
+    with pytest.raises(QuESTError, match="density"):
+        S.set_density_amps(qt.create_qureg(2), 0, 0, [1.0], [0.0])
+
+
+def test_clone_independent():
+    q = S.init_debug_state(qt.create_qureg(3))
+    c = S.clone(q)
+    q2 = S.init_zero_state(q)
+    np.testing.assert_allclose(S.to_dense(c),
+                               oracle.debug_state_vector(3), atol=1e-6)
+
+
+def test_amp_getters():
+    q = S.init_debug_state(qt.create_qureg(3))
+    assert S.get_amp(q, 5) == pytest.approx(1.0 + 1.1j, abs=1e-6)
+    assert S.get_real_amp(q, 5) == pytest.approx(1.0, abs=1e-6)
+    assert S.get_imag_amp(q, 5) == pytest.approx(1.1, abs=1e-6)
+    assert S.get_prob_amp(q, 5) == pytest.approx(1.0 + 1.21, abs=1e-5)
+    with pytest.raises(QuESTError, match="amplitude index"):
+        S.get_amp(q, 8)
+    rho = S.init_debug_state(qt.create_density_qureg(2))
+    assert S.get_density_amp(rho, 3, 1) == pytest.approx(1.4 + 1.5j, abs=1e-6)
+    with pytest.raises(QuESTError, match="statevector"):
+        S.get_amp(rho, 0)
+    with pytest.raises(QuESTError, match="density"):
+        S.get_density_amp(q, 0, 0)
