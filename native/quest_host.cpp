@@ -1,0 +1,150 @@
+// Native host runtime for quest_tpu.
+//
+// The reference implements its host-side services in C (RNG: mt19937ar.c;
+// state CSV IO: QuEST_common.c:215-231, QuEST_cpu.c:1593-1642). This
+// library provides the TPU build's equivalents:
+//
+//   * A Mersenne-Twister (MT19937) RNG with the classic init_genrand /
+//     init_by_array seeding and genrand_real1 output — the standard
+//     Matsumoto-Nishimura algorithm (implemented from the published
+//     recurrence), so that for identical seeds the measurement outcome
+//     stream matches the reference binary exactly.
+//   * Fast CSV state serialization (the debug checkpoint format shared
+//     with the reference: "real, imag" header + %.12f rows).
+//
+// Exposed with a plain C ABI for ctypes (no pybind11 dependency).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// MT19937 (standard algorithm: 624-word state, tempering, 1999 seeding)
+// ---------------------------------------------------------------------------
+
+static const int MT_N = 624;
+static const int MT_M = 397;
+static const uint32_t MT_MATRIX_A = 0x9908b0dfUL;
+static const uint32_t MT_UPPER_MASK = 0x80000000UL;
+static const uint32_t MT_LOWER_MASK = 0x7fffffffUL;
+
+static uint32_t mt_state[MT_N];
+static int mt_index = MT_N + 1;  // uninitialized sentinel
+
+void qh_init_genrand(uint32_t s) {
+    mt_state[0] = s;
+    for (mt_index = 1; mt_index < MT_N; mt_index++) {
+        mt_state[mt_index] = (uint32_t)(1812433253UL *
+            (mt_state[mt_index - 1] ^ (mt_state[mt_index - 1] >> 30)) +
+            (uint32_t)mt_index);
+    }
+}
+
+void qh_init_by_array(const uint32_t* init_key, int key_length) {
+    qh_init_genrand(19650218UL);
+    int i = 1, j = 0;
+    int k = (MT_N > key_length ? MT_N : key_length);
+    for (; k; k--) {
+        mt_state[i] = (mt_state[i] ^
+            ((mt_state[i - 1] ^ (mt_state[i - 1] >> 30)) * 1664525UL)) +
+            init_key[j] + (uint32_t)j;
+        i++; j++;
+        if (i >= MT_N) { mt_state[0] = mt_state[MT_N - 1]; i = 1; }
+        if (j >= key_length) j = 0;
+    }
+    for (k = MT_N - 1; k; k--) {
+        mt_state[i] = (mt_state[i] ^
+            ((mt_state[i - 1] ^ (mt_state[i - 1] >> 30)) * 1566083941UL)) -
+            (uint32_t)i;
+        i++;
+        if (i >= MT_N) { mt_state[0] = mt_state[MT_N - 1]; i = 1; }
+    }
+    mt_state[0] = 0x80000000UL;  // MSB is 1, assuring non-zero initial array
+}
+
+uint32_t qh_genrand_int32(void) {
+    uint32_t y;
+    if (mt_index >= MT_N) {
+        if (mt_index == MT_N + 1)
+            qh_init_genrand(5489UL);
+        for (int kk = 0; kk < MT_N - MT_M; kk++) {
+            y = (mt_state[kk] & MT_UPPER_MASK) | (mt_state[kk + 1] & MT_LOWER_MASK);
+            mt_state[kk] = mt_state[kk + MT_M] ^ (y >> 1) ^
+                ((y & 1UL) ? MT_MATRIX_A : 0UL);
+        }
+        for (int kk = MT_N - MT_M; kk < MT_N - 1; kk++) {
+            y = (mt_state[kk] & MT_UPPER_MASK) | (mt_state[kk + 1] & MT_LOWER_MASK);
+            mt_state[kk] = mt_state[kk + (MT_M - MT_N)] ^ (y >> 1) ^
+                ((y & 1UL) ? MT_MATRIX_A : 0UL);
+        }
+        y = (mt_state[MT_N - 1] & MT_UPPER_MASK) | (mt_state[0] & MT_LOWER_MASK);
+        mt_state[MT_N - 1] = mt_state[MT_M - 1] ^ (y >> 1) ^
+            ((y & 1UL) ? MT_MATRIX_A : 0UL);
+        mt_index = 0;
+    }
+    y = mt_state[mt_index++];
+    y ^= (y >> 11);
+    y ^= (y << 7) & 0x9d2c5680UL;
+    y ^= (y << 15) & 0xefc60000UL;
+    y ^= (y >> 18);
+    return y;
+}
+
+// real in [0, 1] inclusive (the reference's genrand_real1 semantics)
+double qh_genrand_real1(void) {
+    return qh_genrand_int32() * (1.0 / 4294967295.0);
+}
+
+// ---------------------------------------------------------------------------
+// CSV state IO (format shared with reference reportState /
+// initStateFromSingleFile: optional "real, imag" header, %.12f rows)
+// ---------------------------------------------------------------------------
+
+// returns 0 on success, nonzero on IO error
+int qh_write_state_csv(const char* path, const double* re, const double* im,
+                       long long num_amps, int write_header) {
+    FILE* f = std::fopen(path, "w");
+    if (!f) return 1;
+    if (write_header) std::fputs("real, imag\n", f);
+    for (long long i = 0; i < num_amps; i++) {
+        if (std::fprintf(f, "%.12f, %.12f\n", re[i], im[i]) < 0) {
+            std::fclose(f);
+            return 2;
+        }
+    }
+    return std::fclose(f) ? 3 : 0;
+}
+
+// reads up to num_amps rows into re/im; skips a leading header line if
+// present. Returns the number of rows read, or -1 on open failure.
+long long qh_read_state_csv(const char* path, double* re, double* im,
+                            long long num_amps) {
+    FILE* f = std::fopen(path, "r");
+    if (!f) return -1;
+    char line[256];
+    long long count = 0;
+    while (count < num_amps && std::fgets(line, sizeof line, f)) {
+        // if the buffer filled before the newline, drain the rest of the
+        // physical line so a continuation chunk can't mis-parse as a row
+        if (!std::strchr(line, '\n') && !std::feof(f)) {
+            int c;
+            while ((c = std::fgetc(f)) != '\n' && c != EOF) {}
+            continue;  // overlong line: treat as unparseable
+        }
+        double r, i;
+        if (std::sscanf(line, "%lf , %lf", &r, &i) == 2 ||
+            std::sscanf(line, "%lf %lf", &r, &i) == 2) {
+            re[count] = r;
+            im[count] = i;
+            count++;
+        }
+        // non-numeric lines (the header) are skipped
+    }
+    std::fclose(f);
+    return count;
+}
+
+}  // extern "C"

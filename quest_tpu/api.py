@@ -28,6 +28,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import os
+
 import numpy as np
 
 from quest_tpu import calculations as _calc
@@ -795,12 +797,17 @@ def copyStateFromGPU(qureg: Qureg) -> None:
 
 def reportState(qureg: Qureg) -> None:
     """Write all amplitudes to state_rank_0.csv
-    (ref reportState, QuEST_common.c:215-231)."""
-    planes = np.asarray(_state.to_dense(qureg.state)).reshape(-1, order="F")
+    (ref reportState, QuEST_common.c:215-231). Uses the native CSV writer
+    (native/quest_host.cpp) when built, else pure Python."""
+    import jax as _jax
+    from quest_tpu import native as _native
+    planes = np.asarray(_jax.device_get(qureg.state.amps), dtype=np.float64)
+    if _native.write_state_csv("state_rank_0.csv", planes[0], planes[1]):
+        return
     with open("state_rank_0.csv", "w") as f:
         f.write("real, imag\n")
-        for a in planes:
-            f.write(f"{a.real:.12f}, {a.imag:.12f}\n")
+        for r, i in zip(planes[0], planes[1]):
+            f.write(f"{r:.12f}, {i:.12f}\n")
 
 
 def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None,
@@ -808,8 +815,10 @@ def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None,
     """Print amplitudes (<=5 qubits, like the reference's guard,
     QuEST_cpu.c:1334-1357)."""
     print("Reporting state from rank 0:")
-    if qureg.state.num_qubits > 5:  # guard on represented qubits, like the
-        print("(state too large to print)")  # reference (QuEST_cpu.c:1337)
+    # the reference guards on the full state-vector qubit count, so density
+    # registers of >2 represented qubits refuse too (QuEST_cpu.c:1337)
+    if qureg.state.num_state_qubits > 5:
+        print("(state too large to print)")
         return
     vec = _state.to_dense(qureg.state).reshape(-1, order="F")
     for a in vec:
@@ -835,11 +844,21 @@ def initStateOfSingleQubit(qureg: Qureg, qubitId: int, outcome: int) -> None:
 def initStateFromSingleFile(qureg: Qureg, filename: str,
                             env: QuESTEnv = None) -> bool:
     """Read a state from a CSV of 'real, imag' lines (ref
-    statevec_initStateFromSingleFile, QuEST_cpu.c:1593-1642)."""
+    statevec_initStateFromSingleFile, QuEST_cpu.c:1593-1642). Uses the
+    native CSV reader when built."""
+    from quest_tpu import native as _native
+    pair = _native.read_state_csv(filename, qureg.state.num_amps) \
+        if os.path.exists(filename) else None
+    if pair is not None:
+        qureg._set(_state.init_state_from_amps(qureg.state, pair[0], pair[1]))
+        return True
     reals, imags = [], []
+    need = qureg.state.num_amps
     try:
         with open(filename) as f:
             for line in f:
+                if len(reals) == need:  # extra rows ignored, like the ref
+                    break
                 line = line.strip()
                 if not line or line.startswith("real"):
                     continue
@@ -850,7 +869,7 @@ def initStateFromSingleFile(qureg: Qureg, filename: str,
                 imags.append(float(parts[1]))
     except OSError:
         return False
-    if len(reals) != qureg.state.num_amps:
+    if len(reals) != need:
         return False
     qureg._set(_state.init_state_from_amps(qureg.state, reals, imags))
     return True

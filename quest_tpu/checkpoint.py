@@ -64,9 +64,13 @@ def load(directory: str, env=None, dtype=None) -> Qureg:
     cdt = dtype if dtype is not None else precision.complex_dtype_of(rdt)
     make = create_density_qureg if meta["is_density"] else create_qureg
     q = make(meta["num_qubits"], env=env, dtype=cdt)
-    amps = jax.numpy.asarray(planes.astype(q.real_dtype))
-    if q.amps.sharding is not None:
-        amps = jax.device_put(amps, q.amps.sharding)
+    if planes.shape != q.amps.shape:
+        raise validation.QuESTError(
+            f"Invalid checkpoint: planes shape {planes.shape} does not match "
+            f"a {meta['num_qubits']}-qubit register "
+            f"(expected {tuple(q.amps.shape)})")
+    amps = jax.device_put(jax.numpy.asarray(planes.astype(q.real_dtype)),
+                          q.amps.sharding)
     return q.replace_amps(amps)
 
 

@@ -1,0 +1,126 @@
+"""ctypes bindings to the native host runtime (native/quest_host.cpp).
+
+Provides the reference-exact MT19937 RNG (init_by_array seeding +
+genrand_real1 draws — for identical seeds the measurement outcome stream
+matches the reference binary bit-for-bit) and fast CSV state IO.
+
+The shared library is built lazily with the in-tree Makefile on first use;
+if no C++ toolchain is available everything degrades gracefully (callers
+check `available()` and fall back to Python implementations).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libquest_host.so")
+
+_lib = None
+_lib_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _NATIVE_DIR],
+                           capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.qh_init_genrand.argtypes = [ctypes.c_uint32]
+        lib.qh_init_by_array.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int]
+        lib.qh_genrand_int32.restype = ctypes.c_uint32
+        lib.qh_genrand_real1.restype = ctypes.c_double
+        lib.qh_write_state_csv.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong, ctypes.c_int]
+        lib.qh_write_state_csv.restype = ctypes.c_int
+        lib.qh_read_state_csv.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong]
+        lib.qh_read_state_csv.restype = ctypes.c_longlong
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# MT19937 (reference mt19937ar.c semantics)
+# ---------------------------------------------------------------------------
+
+
+def init_by_array(seeds) -> bool:
+    lib = _load()
+    if lib is None:
+        return False
+    arr = (ctypes.c_uint32 * len(seeds))(
+        *[int(s) & 0xFFFFFFFF for s in seeds])
+    lib.qh_init_by_array(arr, len(seeds))
+    return True
+
+
+def genrand_real1() -> float:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native RNG unavailable")
+    return float(lib.qh_genrand_real1())
+
+
+# ---------------------------------------------------------------------------
+# CSV state IO
+# ---------------------------------------------------------------------------
+
+
+def write_state_csv(path: str, re: np.ndarray, im: np.ndarray,
+                    header: bool = True) -> bool:
+    lib = _load()
+    if lib is None:
+        return False
+    re = np.ascontiguousarray(re, dtype=np.float64)
+    im = np.ascontiguousarray(im, dtype=np.float64)
+    rc = lib.qh_write_state_csv(
+        path.encode(), re.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        im.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), re.size,
+        1 if header else 0)
+    return rc == 0
+
+
+def read_state_csv(path: str, num_amps: int):
+    """Returns (re, im) float64 arrays, or None if the native path is
+    unavailable or the file holds fewer rows than requested."""
+    lib = _load()
+    if lib is None:
+        return None
+    re = np.empty(num_amps, dtype=np.float64)
+    im = np.empty(num_amps, dtype=np.float64)
+    got = lib.qh_read_state_csv(
+        path.encode(), re.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        im.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), num_amps)
+    if got != num_amps:
+        return None
+    return re, im

@@ -2,10 +2,15 @@
 
 The reference uses a globally-seeded Mersenne Twister (mt19937ar.c), seeded
 by time+pid by default, with the seed broadcast to all MPI ranks so every
-rank draws identical outcomes (QuEST_cpu_distributed.c:1321-1332). Here a
-module-level numpy Generator plays that role for the eager API (all devices
-see the same host, so the identical-outcome invariant is structural), and
-`jax.random` keys are used for fully-traced in-jit measurement.
+rank draws identical outcomes (QuEST_cpu_distributed.c:1321-1332).
+
+Here the native host runtime (native/quest_host.cpp, reference-exact
+init_by_array + genrand_real1) plays that role: with identical seeds the
+outcome stream matches the reference binary bit-for-bit. If no C++
+toolchain is available it falls back to numpy's MT19937 (same generator,
+different seeding schedule — still deterministic per seed, without
+cross-binary parity). `jax.random` keys serve fully-traced in-jit
+measurement instead (quest_tpu.measurement.measure_functional).
 """
 
 from __future__ import annotations
@@ -15,24 +20,32 @@ import time
 
 import numpy as np
 
-_rng = None
+from quest_tpu import native
+
+_np_rng = None
+_use_native = None
 
 
 def seed_quest(seeds) -> None:
     """Seed the measurement RNG from a list of ints (ref seedQuEST,
     QuEST_common.c:207-213)."""
-    global _rng
-    _rng = np.random.Generator(np.random.MT19937(list(np.asarray(seeds, dtype=np.uint64))))
+    global _np_rng, _use_native
+    seeds = [int(s) for s in np.asarray(seeds, dtype=np.uint64)]
+    _use_native = native.init_by_array(seeds)
+    if not _use_native:
+        _np_rng = np.random.Generator(np.random.MT19937(seeds))
 
 
 def seed_quest_default() -> None:
-    """Seed from time + pid (ref getQuESTDefaultSeedKey, QuEST_common.c:181-203)."""
+    """Seed from time + pid (ref getQuESTDefaultSeedKey,
+    QuEST_common.c:181-203)."""
     seed_quest([int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()])
 
 
 def uniform() -> float:
-    """One uniform draw in [0, 1]."""
-    global _rng
-    if _rng is None:
+    """One uniform draw in [0, 1] (ref genrand_real1)."""
+    if _use_native is None:
         seed_quest_default()
-    return float(_rng.random())
+    if _use_native:
+        return native.genrand_real1()
+    return float(_np_rng.random())
