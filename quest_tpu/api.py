@@ -799,9 +799,8 @@ def reportState(qureg: Qureg) -> None:
     """Write all amplitudes to state_rank_0.csv
     (ref reportState, QuEST_common.c:215-231). Uses the native CSV writer
     (native/quest_host.cpp) when built, else pure Python."""
-    import jax as _jax
     from quest_tpu import native as _native
-    planes = np.asarray(_jax.device_get(qureg.state.amps), dtype=np.float64)
+    planes = np.asarray(qureg.state.amps, dtype=np.float64)
     if _native.write_state_csv("state_rank_0.csv", planes[0], planes[1]):
         return
     with open("state_rank_0.csv", "w") as f:
@@ -865,8 +864,12 @@ def initStateFromSingleFile(qureg: Qureg, filename: str,
                 parts = line.replace(",", " ").split()
                 if len(parts) < 2:
                     continue
-                reals.append(float(parts[0]))
-                imags.append(float(parts[1]))
+                try:  # comment/header lines are legal, skip them
+                    r, i = float(parts[0]), float(parts[1])
+                except ValueError:
+                    continue
+                reals.append(r)
+                imags.append(i)
     except OSError:
         return False
     if len(reals) != need:

@@ -33,6 +33,12 @@ def seed_quest(seeds) -> None:
     seeds = [int(s) for s in np.asarray(seeds, dtype=np.uint64)]
     _use_native = native.init_by_array(seeds)
     if not _use_native:
+        import warnings
+        warnings.warn(
+            "quest_tpu native RNG unavailable (no C++ toolchain?): falling "
+            "back to numpy MT19937 — deterministic per seed, but outcome "
+            "streams will not match the reference binary bit-for-bit",
+            RuntimeWarning, stacklevel=2)
         _np_rng = np.random.Generator(np.random.MT19937(seeds))
 
 
