@@ -1,0 +1,12 @@
+#!/bin/bash
+# Launch the benchmark suite across a TPU pod slice — the analogue of the
+# reference's SLURM/PBS submission scripts (examples/submissionScripts/).
+#
+# Usage: ./scripts/tpu_pod_bench.sh <tpu-name> <zone>
+
+set -euo pipefail
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?zone}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command 'cd quest_tpu && python bench.py && python benchmarks/run.py'

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Run the unit suite on every host of a TPU pod slice — the analogue of
+# the reference's examples/submissionScripts/mpi_SLURM_unit_tests.sh
+# (4-node MPI ctest run). Each host runs the same suite; multi-host
+# registers shard over the full pod mesh via jax.distributed.
+#
+# Usage: ./scripts/tpu_pod_tests.sh <tpu-name> <zone>
+
+set -euo pipefail
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?zone}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command 'cd quest_tpu && QUEST_TEST_PLATFORM=tpu python -m pytest tests/ -q'
