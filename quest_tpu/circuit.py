@@ -165,6 +165,74 @@ class Circuit:
             raise ValueError("circuit/register size mismatch")
         return q.replace_amps(self.compiled(n, q.is_density, donate)(q.amps))
 
+    def compiled_fused(self, n: int, density: bool, donate: bool = True,
+                       interpret: bool = False):
+        """Compiled program using the Pallas fused-segment engine
+        (quest_tpu.ops.pallas_engine): runs of gates on in-block qubits
+        execute in ONE kernel launch / one HBM pass; the rest fall back to
+        the XLA per-gate path. `interpret=True` runs the kernels in the
+        Pallas interpreter (for CPU testing)."""
+        from quest_tpu.ops import pallas_engine as PE
+        key = ("fused", n, density, donate, interpret)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        if not PE.usable(n):
+            fn = self.compiled(n, density, donate)
+            self._compiled[key] = fn
+            return fn
+
+        # expand density duals into a flat op list (ref QuEST.c:8-10)
+        flat: List[GateOp] = []
+        s = n // 2
+        for op in self.ops:
+            flat.append(op)
+            if density:
+                if op.kind == "parity":
+                    dual = dataclasses.replace(
+                        op, targets=tuple(t + s for t in op.targets),
+                        operand=-op.operand)
+                else:
+                    dual = dataclasses.replace(
+                        op, targets=tuple(t + s for t in op.targets),
+                        controls=tuple(c + s for c in op.controls),
+                        operand=np.conj(op.operand))
+                flat.append(dual)
+
+        plan = PE.plan_ops(flat, n, PE.qmax_for(n))
+        appliers = []
+        for kind, payload in plan.items:
+            if kind == "segment":
+                appliers.append(PE.compile_segment(payload, n, interpret))
+            else:
+                op = payload
+                appliers.append(
+                    lambda amps, op=op: _apply_op(amps, n, False, op))
+
+        def run(amps):
+            # the Pallas kernels are f32-only; f64 registers keep their
+            # precision on the XLA per-gate path
+            if amps.dtype != jnp.float32:
+                for op in flat:
+                    amps = _apply_op(amps, n, False, op)
+                return amps
+            for f in appliers:
+                amps = f(amps)
+            return amps
+
+        fn = jax.jit(run, donate_argnums=(0,) if donate else ())
+        self._compiled[key] = fn
+        return fn
+
+    def apply_fused(self, q: Qureg, donate: bool = False,
+                    interpret: bool = False) -> Qureg:
+        """Apply via the Pallas fused-segment engine."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        fn = self.compiled_fused(q.num_state_qubits, q.is_density, donate,
+                                 interpret)
+        return q.replace_amps(fn(q.amps))
+
     def compiled_sharded(self, n: int, density: bool, mesh, donate: bool = True):
         """Compiled explicit-distribution program (one shard_map over the
         whole circuit, reference-style ppermute schedule — see
