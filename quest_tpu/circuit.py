@@ -33,32 +33,37 @@ class GateOp:
     operand: object = None    # matrix / diag vector / angle / phase term
 
 
-def _apply_op(amps, n, density, op: GateOp):
+def dual_of(op: GateOp, shift: int) -> GateOp:
+    """The column-space dual of a gate on a density register: conjugated
+    operand on targets/controls shifted by N (ref QuEST.c:8-10). The ONE
+    place the dual rules live — used by the XLA path, the fused-engine
+    expansion, and anything else that flattens density circuits."""
+    if op.kind == "parity":
+        return dataclasses.replace(
+            op, targets=tuple(t + shift for t in op.targets),
+            operand=-op.operand)
+    return dataclasses.replace(
+        op, targets=tuple(t + shift for t in op.targets),
+        controls=tuple(c + shift for c in op.controls),
+        operand=np.conj(op.operand))
+
+
+def _apply_one(amps, n, op: GateOp):
     operand = op.operand
     if op.kind == "parity":
-        amps = A.apply_parity_phase(amps, n, op.targets, operand)
-        if density:
-            s = n // 2
-            amps = A.apply_parity_phase(
-                amps, n, tuple(t + s for t in op.targets), -operand)
-        return amps
+        return A.apply_parity_phase(amps, n, op.targets, operand)
     if op.kind == "allones":
-        pair = cplx.pack(operand)
-        amps = A.apply_phase_on_all_ones(amps, n, op.targets, pair)
-        if density:
-            s = n // 2
-            amps = A.apply_phase_on_all_ones(
-                amps, n, tuple(t + s for t in op.targets),
-                (pair[0], -pair[1]))
-        return amps
+        return A.apply_phase_on_all_ones(amps, n, op.targets,
+                                         cplx.pack(operand))
     fn = A.apply_diagonal if op.kind == "diagonal" else A.apply_matrix
-    pair = cplx.pack(operand)
-    amps = fn(amps, n, pair, op.targets, op.controls, op.cstates)
+    return fn(amps, n, cplx.pack(operand), op.targets, op.controls,
+              op.cstates)
+
+
+def _apply_op(amps, n, density, op: GateOp):
+    amps = _apply_one(amps, n, op)
     if density:
-        s = n // 2
-        amps = fn(amps, n, (pair[0], -pair[1]),
-                  tuple(t + s for t in op.targets),
-                  tuple(c + s for c in op.controls), op.cstates)
+        amps = _apply_one(amps, n, dual_of(op, n // 2))
     return amps
 
 
@@ -184,20 +189,10 @@ class Circuit:
 
         # expand density duals into a flat op list (ref QuEST.c:8-10)
         flat: List[GateOp] = []
-        s = n // 2
         for op in self.ops:
             flat.append(op)
             if density:
-                if op.kind == "parity":
-                    dual = dataclasses.replace(
-                        op, targets=tuple(t + s for t in op.targets),
-                        operand=-op.operand)
-                else:
-                    dual = dataclasses.replace(
-                        op, targets=tuple(t + s for t in op.targets),
-                        controls=tuple(c + s for c in op.controls),
-                        operand=np.conj(op.operand))
-                flat.append(dual)
+                flat.append(dual_of(op, n // 2))
 
         plan = PE.plan_ops(flat, n, PE.qmax_for(n))
         appliers = []

@@ -132,3 +132,30 @@ def measure_functional(q: Qureg, qubit: int, key) -> Tuple[Qureg, jax.Array, jax
     amps, outcome, prob = _measure_traced(
         q.amps, key, n=q.num_state_qubits, qubit=qubit, density=q.is_density)
     return q.replace_amps(amps), outcome, prob
+
+
+@partial(jax.jit, static_argnames=("n", "density", "num_shots"))
+def _sample_traced(amps, key, *, n, density, num_shots):
+    if density:
+        dim = 1 << (n // 2)
+        probs = jnp.diagonal(amps[0].reshape((dim, dim)))
+    else:
+        probs = amps[0] * amps[0] + amps[1] * amps[1]
+    # inverse-CDF sampling: O(2^n + shots) memory (categorical would
+    # materialize a (shots, 2^n) Gumbel tensor)
+    cdf = jnp.cumsum(probs)
+    u = jax.random.uniform(key, (num_shots,), dtype=probs.dtype) * cdf[-1]
+    return jnp.searchsorted(cdf, u, side="right").astype(jnp.int32)
+
+
+def sample(q: Qureg, num_shots: int, key) -> jax.Array:
+    """Draw `num_shots` full-register computational-basis samples WITHOUT
+    collapsing the state — one device-side categorical draw over the
+    probability distribution. The reference can only sample by repeated
+    measure() calls that destroy the state (its RCS-style workloads
+    re-prepare the state per shot); batched sampling is the TPU-native
+    replacement. Returns an int array of basis-state indices."""
+    if num_shots < 1:
+        raise val.QuESTError("Invalid number of shots: must be positive.")
+    return _sample_traced(q.amps, key, n=q.num_state_qubits,
+                          density=q.is_density, num_shots=num_shots)

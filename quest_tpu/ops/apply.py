@@ -92,6 +92,10 @@ def _as_pair(op_pair, rdtype):
             False)
 
 
+_UNROLL_MAX_TARGETS = 4  # beyond this the 4^k unrolled butterfly explodes
+                         # compile time; use the gather+matmul path instead
+
+
 def apply_matrix(
     amps: jax.Array,
     n: int,
@@ -107,6 +111,9 @@ def apply_matrix(
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
     k = len(targets)
+    if k > _UNROLL_MAX_TARGETS:
+        return _apply_matrix_matmul(amps, n, op_pair, targets, controls,
+                                    control_states)
     mre, mim, concrete = _as_pair(op_pair, amps.dtype)
     mre = mre.reshape(1 << k, 1 << k)
     mim = mim.reshape(1 << k, 1 << k)
@@ -166,6 +173,61 @@ def apply_matrix(
                   for i in range(len(out_im) // 2)]
 
     return jnp.stack([out_re[0].reshape(-1), out_im[0].reshape(-1)])
+
+
+def _apply_matrix_matmul(amps, n, op_pair, targets, controls,
+                         control_states):
+    """Many-target path: move target axes minor-most, apply the operator as
+    a (rest, 2^k) @ (2^k, 2^k) matmul (MXU once 2^k is lane-sized), move
+    back. This is the analogue of the reference's general gather/matvec/
+    scatter kernel (QuEST_cpu.c:1814-1898) expressed as one contraction."""
+    k = len(targets)
+    mre, mim, concrete = _as_pair(op_pair, amps.dtype)
+    lib = np if concrete else jnp
+    m_re = mre.reshape((2,) * (2 * k))
+    m_im = mim.reshape((2,) * (2 * k))
+    # matrix row/col bit j <-> axis (k-1-j) / (2k-1-j); permute so both row
+    # and col axes run in DESCENDING target-qubit order (matching the order
+    # target axes appear in the state's segment view)
+    order = sorted(range(k), key=lambda j: -targets[j])
+    perm = [k - 1 - j for j in order] + [2 * k - 1 - j for j in order]
+    m2 = lib.transpose(m_re, perm).reshape(1 << k, 1 << k)
+    m2i = lib.transpose(m_im, perm).reshape(1 << k, 1 << k)
+
+    dims, axis_of = _split_view(n, targets, controls)
+    ndims = len(dims)
+    taxes = [axis_of[t] for t in sorted(targets, reverse=True)]
+    rest_axes = [a for a in range(ndims) if a not in taxes]
+    fwd = rest_axes + taxes
+
+    def to2d(x):
+        t = jnp.transpose(x.reshape(dims), fwd)
+        return t.reshape(-1, 1 << k)
+
+    re2 = to2d(amps[0])
+    im2 = to2d(amps[1])
+    hi = lax.Precision.HIGHEST
+    # new[r, s'] = sum_s m2[s', s] v[r, s] -> v @ m2^T
+    m2_t, m2i_t = jnp.asarray(m2).T, jnp.asarray(m2i).T
+    nre = jnp.matmul(re2, m2_t, precision=hi) - jnp.matmul(im2, m2i_t,
+                                                           precision=hi)
+    nim = jnp.matmul(re2, m2i_t, precision=hi) + jnp.matmul(im2, m2_t,
+                                                            precision=hi)
+
+    inv = [0] * ndims
+    for pos, a in enumerate(fwd):
+        inv[a] = pos
+    tshape = [dims[a] for a in fwd]
+
+    def back(x2):
+        return jnp.transpose(x2.reshape(tshape), inv)
+
+    nre_t, nim_t = back(nre), back(nim)
+    mask = control_mask(ndims, axis_of, controls, control_states)
+    if mask is not None:
+        nre_t = jnp.where(mask, nre_t, amps[0].reshape(dims))
+        nim_t = jnp.where(mask, nim_t, amps[1].reshape(dims))
+    return jnp.stack([nre_t.reshape(-1), nim_t.reshape(-1)])
 
 
 def _diag_broadcast(d, k, targets, dims, axis_of, lib):

@@ -1,0 +1,47 @@
+"""Profiling / tracing hooks.
+
+The reference has NO profiling support (SURVEY.md §5: the only
+introspection is reportQuregParams/getEnvironmentString). On TPU the
+platform tooling is first-class; this module packages it:
+
+  * `trace(dir)` — context manager capturing a profiler trace viewable in
+    TensorBoard / Perfetto (wraps jax.profiler).
+  * `annotate(name)` — named region that shows up on the trace timeline.
+  * `op_metrics(fn, *args)` — compile a function and return its XLA cost
+    analysis (flops, bytes accessed) — the quick "is this memory-bound?"
+    check used to tune the engines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace: `with profiling.trace("/tmp/trace"): ...`"""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region: `with profiling.annotate("qft"): ...`"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def op_metrics(fn, *args, **kwargs) -> dict:
+    """Lower+compile `fn(*args)` and return XLA's cost analysis
+    (flops / bytes accessed / estimated seconds where available)."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:  # backend without cost analysis
+        return {}
+    if isinstance(analysis, list):  # some versions return [dict]
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis)

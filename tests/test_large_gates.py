@@ -1,0 +1,70 @@
+"""Many-target gate tests: the gather+matmul path that replaces the
+unrolled butterfly above 4 targets (quest_tpu/ops/apply.py
+_apply_matrix_matmul; the analogue of the reference's general
+gather/matvec/scatter kernel, QuEST_cpu.c:1814-1898)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.ops import channels as ch
+from quest_tpu.ops import gates as G
+from quest_tpu.state import init_state_from_amps, to_dense
+
+from . import oracle
+from .test_calculations import load_dm
+
+
+@pytest.mark.parametrize("targets", [(0, 1, 2, 3, 4), (0, 2, 3, 5, 6),
+                                     (6, 4, 3, 2, 0)])
+def test_five_target_unitary(targets, rng):
+    n = 7
+    u = oracle.random_unitary(5, rng)
+    v = oracle.random_statevector(n, rng)
+    q = init_state_from_amps(qt.create_qureg(n, dtype=np.complex128),
+                             v.real, v.imag)
+    out = to_dense(G.multi_qubit_unitary(q, list(targets), u))
+    want = oracle.apply_to_vector(v, n, u, list(targets))
+    np.testing.assert_allclose(out, want, atol=1e-10)
+
+
+def test_controlled_five_target_unitary(rng):
+    n = 8
+    u = oracle.random_unitary(5, rng)
+    targets = [0, 2, 4, 6, 7]
+    controls = [1, 5]
+    v = oracle.random_statevector(n, rng)
+    q = init_state_from_amps(qt.create_qureg(n, dtype=np.complex128),
+                             v.real, v.imag)
+    out = to_dense(G.multi_controlled_multi_qubit_unitary(
+        q, controls, targets, u))
+    want = oracle.apply_to_vector(v, n, u, targets, controls)
+    np.testing.assert_allclose(out, want, atol=1e-10)
+
+
+def test_six_target_unitary(rng):
+    n = 6
+    u = oracle.random_unitary(6, rng)
+    v = oracle.random_statevector(n, rng)
+    q = init_state_from_amps(qt.create_qureg(n, dtype=np.complex128),
+                             v.real, v.imag)
+    out = to_dense(G.multi_qubit_unitary(q, list(range(6)), u))
+    np.testing.assert_allclose(out, u @ v, atol=1e-10)
+
+
+def test_three_qubit_kraus_map(rng):
+    """3 Kraus targets -> a 6-target superoperator apply."""
+    rho = oracle.random_density(4, rng)
+    ops = oracle.random_kraus_map(3, 4, rng)
+    out = to_dense(ch.mix_multi_qubit_kraus_map(load_dm(rho), [0, 1, 3], ops))
+    want = oracle.apply_kraus_to_density(rho, 4, ops, [0, 1, 3])
+    np.testing.assert_allclose(out, want, atol=1e-9)
+
+
+def test_five_target_density_dual(rng):
+    """Density register: U rho U+ with a 5-target U exercises the matmul
+    path twice (row and column spaces)."""
+    rho = oracle.random_density(5, rng)
+    u = oracle.random_unitary(5, rng)
+    out = to_dense(G.multi_qubit_unitary(load_dm(rho), list(range(5)), u))
+    np.testing.assert_allclose(out, u @ rho @ u.conj().T, atol=1e-9)

@@ -119,6 +119,43 @@ def test_density_fused_matches():
     check(c, n=10, density=True, tol=5e-5)
 
 
+def test_multi_block_grid(monkeypatch):
+    """Shrink the row-block cap so the kernel grid has MANY blocks: the
+    pid-dependent paths (global row ids for masks/diagonals/parity, the
+    BlockSpec index map) must agree with the single-block engine."""
+    monkeypatch.setattr(PE, "MAX_ROWS_PER_BLOCK", 8)
+    n = 12  # 32 rows -> grid of 4 blocks of 8 rows
+    c = Circuit(n)
+    c.h(0)
+    c.h(8)               # row butterfly within a block
+    c.rz(9, 0.3)         # parity on a row bit spanning blocks? (j=2 < 3)
+    c.s(7)               # row diagonal
+    c.x(1, 9)            # lane target controlled on a row qubit
+    c.cz(2, 8)
+    plan = PE.plan_ops(c.ops, n, PE.qmax_for(n))
+    assert [k for k, _ in plan.items] == ["segment"]
+    q = qt.init_debug_state(qt.create_qureg(n))
+    want = to_dense(c.apply(q))
+    got = to_dense(c.apply_fused(q, interpret=True))
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=1e-5 * scale, rtol=0)
+
+
+def test_multi_block_grid_high_row_bits(monkeypatch):
+    """Gates on row bits ABOVE the block size force rows to grow to cover
+    them; bits below still use pid-dependent global ids across blocks."""
+    monkeypatch.setattr(PE, "MAX_ROWS_PER_BLOCK", 4)
+    n = 12
+    c = Circuit(n)
+    c.ry(11, 0.7)        # j=4: needs rows=32 -> grid of 1 after growth
+    c.ry(8, 0.2)
+    q = qt.init_debug_state(qt.create_qureg(n))
+    want = to_dense(c.apply(q))
+    got = to_dense(c.apply_fused(q, interpret=True))
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=1e-5 * scale, rtol=0)
+
+
 def test_small_register_falls_back():
     c = Circuit(4)
     c.h(0)
