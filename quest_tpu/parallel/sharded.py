@@ -150,10 +150,12 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
     ctrl_slots = set(controls)
     slots.sort(key=lambda q: (q in ctrl_slots, q))  # prefer non-control slots
     if len(slots) < len(glob_targets):
-        raise ValueError(
-            f"matrix on targets {targets} needs {len(glob_targets)} local "
-            f"slots but only {len(slots)} exist "
-            "(ref E_CANNOT_FIT_MULTI_QUBIT_MATRIX, QuEST_validation.c:121)")
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid number of target qubits: the matrix cannot fit in a "
+            f"single device chunk (targets {targets} need "
+            f"{len(glob_targets)} local slots, only {len(slots)} exist; "
+            "ref E_CANNOT_FIT_MULTI_QUBIT_MATRIX, QuEST_validation.c:121)")
     relabeled = list(targets)
     new_controls = list(controls)
     swaps = []

@@ -1,0 +1,116 @@
+"""Data-structures-group tests (mirrors reference test_data_structures.cpp:
+register/environment/matrix lifecycle and field semantics)."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import api as Q
+from quest_tpu.validation import QuESTError
+
+
+def test_create_qureg_fields():
+    env = Q.createQuESTEnv()
+    q = Q.createQureg(5, env)
+    assert q.numQubitsRepresented == 5
+    assert not q.isDensityMatrix
+    assert q.numAmpsTotal == 32
+    # initialized to |00000>
+    assert Q.getProbAmp(q, 0) == pytest.approx(1.0)
+    assert Q.calcTotalProb(q) == pytest.approx(1.0)
+
+
+def test_create_density_qureg_fields():
+    q = Q.createDensityQureg(3)
+    assert q.isDensityMatrix
+    assert q.numQubitsRepresented == 3
+    assert q.numAmpsTotal == 64  # 2^(2N)
+    assert Q.getDensityAmp(q, 0, 0) == pytest.approx(1.0)
+
+
+@pytest.mark.parametrize("bad", [0, -1])
+def test_create_qureg_validation(bad):
+    with pytest.raises(QuESTError, match="number of qubits"):
+        Q.createQureg(bad)
+    with pytest.raises(QuESTError, match="number of qubits"):
+        Q.createDensityQureg(bad)
+
+
+def test_create_clone_qureg():
+    q = Q.createQureg(4)
+    Q.initDebugState(q)
+    c = Q.createCloneQureg(q)
+    assert c.numQubitsRepresented == 4
+    assert Q.compareStates(q, c, 1e-12)
+    # clone is independent
+    Q.initZeroState(q)
+    assert Q.getImagAmp(c, 1) == pytest.approx(0.3, abs=1e-6)
+
+
+def test_destroy_qureg():
+    env = Q.createQuESTEnv()
+    q = Q.createQureg(2, env)
+    Q.destroyQureg(q, env)
+    assert q.state is None
+
+
+def test_complex_matrix_n_lifecycle():
+    m = Q.createComplexMatrixN(3)
+    assert m.shape == (8, 8)
+    assert np.all(m == 0)
+    Q.initComplexMatrixN(m, np.eye(8), np.zeros((8, 8)))
+    assert m[0, 0] == 1
+    Q.destroyComplexMatrixN(m)
+    with pytest.raises(QuESTError, match="at least 1"):
+        Q.createComplexMatrixN(0)
+
+
+def test_bind_arrays_complex_matrix_n():
+    re = [[1, 0], [0, 1]]
+    im = [[0, 1], [1, 0]]
+    m = Q.bindArraysToStackComplexMatrixN(1, re, im)
+    assert m[0, 1] == 1j
+    m2 = Q.getStaticComplexMatrixN(1, re, im)
+    np.testing.assert_array_equal(m, m2)
+
+
+def test_environment_lifecycle_and_report(capsys):
+    env = Q.createQuESTEnv()
+    assert env.num_ranks >= 1
+    Q.reportQuESTEnv(env)
+    out = capsys.readouterr().out
+    assert "EXECUTION ENVIRONMENT" in out
+    Q.syncQuESTEnv(env)
+    assert Q.syncQuESTSuccess(1) == 1
+    assert Q.syncQuESTSuccess(0) == 0
+    Q.destroyQuESTEnv(env)
+
+
+def test_report_qureg_params(capsys):
+    q = Q.createDensityQureg(3)
+    Q.reportQuregParams(q)
+    out = capsys.readouterr().out
+    assert "Number of qubits is 6" in out  # state-vector qubits, like ref
+    assert "Number of amps is 64" in out
+
+
+def test_get_environment_string():
+    env = Q.createQuESTEnv()
+    q = Q.createQureg(4, env)
+    s = Q.getEnvironmentString(env, q)
+    assert "4qubits" in s
+
+
+def test_num_qubits_num_amps():
+    q = Q.createQureg(6)
+    assert Q.getNumQubits(q) == 6
+    assert Q.getNumAmps(q) == 64
+    rho = Q.createDensityQureg(2)
+    assert Q.getNumQubits(rho) == 2
+    with pytest.raises(QuESTError, match="statevector"):
+        Q.getNumAmps(rho)
+
+
+def test_qureg_too_large_rejected():
+    with pytest.raises(QuESTError, match="number of qubits"):
+        Q.createQureg(70)
