@@ -53,5 +53,7 @@ from quest_tpu import measurement
 from quest_tpu.circuit import Circuit
 from quest_tpu import qasm
 from quest_tpu import api
+from quest_tpu import checkpoint
+from quest_tpu import profiling
 
 __version__ = "0.1.0"
