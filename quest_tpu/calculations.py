@@ -154,6 +154,25 @@ def calc_expec_pauli_sum(q: Qureg, all_codes, coeffs) -> float:
     return float(total)
 
 
+@partial(jax.jit, static_argnames=("n",))
+def _probs_at(amps, samples, *, n):
+    re = amps[0][samples]
+    im = amps[1][samples]
+    return re * re + im * im
+
+
+def calc_linear_xeb(q: Qureg, samples) -> float:
+    """Linear cross-entropy benchmarking fidelity of bitstring `samples`
+    against this state: F_XEB = 2^n <p(s)> - 1 (the standard RCS quality
+    metric; 1 for perfect sampling from |amps|^2, 0 for uniform noise).
+    The reference has no analogue — its RCS workflows stop at measurement.
+    Statevector registers only."""
+    val.validate_state_vector(q)
+    samples = jnp.asarray(samples)
+    p = _probs_at(q.amps, samples, n=q.num_state_qubits)
+    return float((1 << q.num_state_qubits) * jnp.mean(p) - 1.0)
+
+
 def apply_pauli_sum(q: Qureg, all_codes, coeffs) -> Qureg:
     """Return sum_t c_t P_t |q> (or P_t rho) as a new register — the
     (generally unnormalized) Pauli-sum image (ref statevec_applyPauliSum,

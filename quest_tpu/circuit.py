@@ -145,6 +145,19 @@ class Circuit:
     def multi_rotate_z(self, targets, angle):
         return self._add("parity", tuple(targets), float(angle))
 
+    def sqrt_swap(self, q1, q2):
+        return self._add("matrix", (q1, q2), M.SQRT_SWAP)
+
+    def cu(self, matrix, target, *controls, cstates=None):
+        """Arbitrary single/multi-controlled k-qubit unitary."""
+        t = (target,) if np.isscalar(target) else tuple(target)
+        return self._add("matrix", t, np.asarray(matrix, dtype=np.complex128),
+                         controls, cstates)
+
+    def cphase(self, angle, *qubits):
+        """Symmetric controlled phase e^{i angle} on all-ones of qubits."""
+        return self._add("allones", tuple(qubits), np.exp(1j * float(angle)))
+
     # -- compilation & execution --------------------------------------------
 
     def trace(self, amps, n: int, density: bool):

@@ -71,3 +71,32 @@ def test_annotate_and_trace(tmp_path):
     # trace directory was written
     import os
     assert any(os.scandir(str(tmp_path / "trace")))
+
+
+def test_linear_xeb(rng):
+    """Samples drawn from the state give F_XEB near the theoretical value;
+    uniform samples give ~0."""
+    from quest_tpu import calculations as C
+    from quest_tpu.circuit import random_circuit
+
+    n = 8
+    circ = random_circuit(n, depth=8, seed=3)
+    q = circ.apply(qt.create_qureg(n, dtype=np.complex128))
+    key = jax.random.PRNGKey(7)
+    samples = meas.sample(q, 4000, key)
+    probs = np.abs(np.asarray(
+        qt.state.to_dense(q))) ** 2
+    # ideal sampler: E[F_XEB] = 2^n * sum p^2 - 1
+    ideal = (1 << n) * float(np.sum(probs ** 2)) - 1.0
+    got = C.calc_linear_xeb(q, samples)
+    assert got == pytest.approx(ideal, abs=0.35)
+
+    uniform = jax.random.randint(key, (4000,), 0, 1 << n)
+    assert C.calc_linear_xeb(q, uniform) == pytest.approx(0.0, abs=0.35)
+
+
+def test_linear_xeb_validation():
+    from quest_tpu import calculations as C
+    rho = qt.create_density_qureg(2)
+    with pytest.raises(QuESTError, match="state-vector"):
+        C.calc_linear_xeb(rho, np.array([0]))
