@@ -418,6 +418,7 @@ def compile_segment(stages: Sequence[Stage], n: int, interpret: bool = False):
                  + [g_spec] * num_lane,
         out_specs=pl.BlockSpec((2, rows, LANES), lambda i: (0, i, 0)),
         out_shape=jax.ShapeDtypeStruct((2, total_rows, LANES), jnp.float32),
+        input_output_aliases={0: 0},  # in-place on the state buffer
         interpret=interpret,
     )
     lane_inputs = [jnp.asarray(g) for g in lane_inputs]
