@@ -26,18 +26,21 @@ from quest_tpu.state import Qureg
 
 @dataclasses.dataclass(frozen=True)
 class GateOp:
-    kind: str                 # 'matrix' | 'diagonal' | 'parity' | 'allones'
+    kind: str                 # 'matrix' | 'diagonal' | 'parity' | 'allones' | 'superop'
     targets: Tuple[int, ...]
     controls: Tuple[int, ...] = ()
     cstates: Tuple[int, ...] = ()
     operand: object = None    # matrix / diag vector / angle / phase term
 
 
-def dual_of(op: GateOp, shift: int) -> GateOp:
+def dual_of(op: GateOp, shift: int):
     """The column-space dual of a gate on a density register: conjugated
     operand on targets/controls shifted by N (ref QuEST.c:8-10). The ONE
     place the dual rules live — used by the XLA path, the fused-engine
-    expansion, and anything else that flattens density circuits."""
+    expansion, and anything else that flattens density circuits.
+    Superoperators already act on both spaces: no dual (returns None)."""
+    if op.kind == "superop":
+        return None
     if op.kind == "parity":
         return dataclasses.replace(
             op, targets=tuple(t + shift for t in op.targets),
@@ -55,6 +58,11 @@ def _apply_one(amps, n, op: GateOp):
     if op.kind == "allones":
         return A.apply_phase_on_all_ones(amps, n, op.targets,
                                          cplx.pack(operand))
+    if op.kind == "superop":
+        # channel superoperator on [targets, targets + N] of the doubled
+        # register (ref QuEST_common.c:540-673)
+        return A.apply_matrix(amps, n, cplx.pack(operand),
+                              M.superop_targets(op.targets, n // 2))
     fn = A.apply_diagonal if op.kind == "diagonal" else A.apply_matrix
     return fn(amps, n, cplx.pack(operand), op.targets, op.controls,
               op.cstates)
@@ -63,7 +71,9 @@ def _apply_one(amps, n, op: GateOp):
 def _apply_op(amps, n, density, op: GateOp):
     amps = _apply_one(amps, n, op)
     if density:
-        amps = _apply_one(amps, n, dual_of(op, n // 2))
+        dual = dual_of(op, n // 2)
+        if dual is not None:
+            amps = _apply_one(amps, n, dual)
     return amps
 
 
@@ -148,6 +158,41 @@ class Circuit:
     def sqrt_swap(self, q1, q2):
         return self._add("matrix", (q1, q2), M.SQRT_SWAP)
 
+    # -- noise channels (density-matrix circuits only) -----------------------
+
+    def kraus(self, targets, ops):
+        """General Kraus map as a compiled circuit step (superoperator on
+        the doubled register, ref QuEST_common.c:540-673). Validated at
+        build time exactly like the eager mixKrausMap."""
+        from quest_tpu import validation as val
+        t = (targets,) if np.isscalar(targets) else tuple(targets)
+        k = len(t)
+        val.validate_kraus_ops(ops, k, max_ops=1 << (2 * k))
+        return self._add("superop", t, M.kraus_superoperator(ops))
+
+    def damping(self, target, prob):
+        from quest_tpu import validation as val
+        p = float(prob)
+        val.validate_one_qubit_damping_prob(p)
+        k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]])
+        k1 = np.array([[0, np.sqrt(p)], [0, 0]])
+        return self.kraus(target, [k0, k1])
+
+    def depolarising(self, target, prob):
+        from quest_tpu import validation as val
+        p = float(prob)
+        val.validate_one_qubit_depol_prob(p)
+        ops = [np.sqrt(1 - p) * M.PAULI_I, np.sqrt(p / 3) * M.PAULI_X,
+               np.sqrt(p / 3) * M.PAULI_Y, np.sqrt(p / 3) * M.PAULI_Z]
+        return self.kraus(target, ops)
+
+    def dephasing(self, target, prob):
+        from quest_tpu import validation as val
+        p = float(prob)
+        val.validate_one_qubit_dephase_prob(p)
+        ops = [np.sqrt(1 - p) * M.PAULI_I, np.sqrt(p) * M.PAULI_Z]
+        return self.kraus(target, ops)
+
     def cu(self, matrix, target, *controls, cstates=None):
         """Arbitrary single/multi-controlled k-qubit unitary."""
         t = (target,) if np.isscalar(target) else tuple(target)
@@ -162,6 +207,11 @@ class Circuit:
 
     def trace(self, amps, n: int, density: bool):
         """Apply all ops to raw amplitudes inside an existing trace."""
+        if not density and any(op.kind == "superop" for op in self.ops):
+            from quest_tpu.validation import QuESTError
+            raise QuESTError(
+                "Invalid operation: noise channels require a density-matrix "
+                "register")
         for op in self.ops:
             amps = _apply_op(amps, n, density, op)
         return amps
@@ -195,17 +245,30 @@ class Circuit:
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
+        if not density and any(op.kind == "superop" for op in self.ops):
+            from quest_tpu.validation import QuESTError
+            raise QuESTError(
+                "Invalid operation: noise channels require a density-matrix "
+                "register")
         if not PE.usable(n):
             fn = self.compiled(n, density, donate)
             self._compiled[key] = fn
             return fn
 
-        # expand density duals into a flat op list (ref QuEST.c:8-10)
+        # expand density duals into a flat op list (ref QuEST.c:8-10);
+        # superops become explicit matrix ops on the doubled targets
         flat: List[GateOp] = []
         for op in self.ops:
+            if op.kind == "superop":
+                flat.append(dataclasses.replace(
+                    op, kind="matrix",
+                    targets=M.superop_targets(op.targets, n // 2)))
+                continue
             flat.append(op)
             if density:
-                flat.append(dual_of(op, n // 2))
+                dual = dual_of(op, n // 2)
+                if dual is not None:
+                    flat.append(dual)
 
         plan = PE.plan_ops(flat, n, PE.qmax_for(n))
         appliers = []

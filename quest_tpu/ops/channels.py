@@ -110,7 +110,7 @@ _TWIRL2_RE, _TWIRL2_IM = cplx.pack(_pauli_twirl_matrix(2))
 
 
 def _superop_targets(targets, nq):
-    return tuple(targets) + tuple(t + nq for t in targets)
+    return M.superop_targets(targets, nq)
 
 
 @partial(jax.jit, static_argnames=("n", "targets"))

@@ -99,3 +99,10 @@ def controlled_embed(matrix: np.ndarray, num_controls: int) -> np.ndarray:
     full = np.eye(dim << num_controls, dtype=np.complex128)
     full[-dim:, -dim:] = m
     return full
+
+
+def superop_targets(targets, num_qubits):
+    """The doubled-register target list [targets, targets + N] a channel
+    superoperator acts on (ref QuEST_common.c:601-640 allTargets layout).
+    THE single definition — circuit/sharded/channel engines all use it."""
+    return tuple(targets) + tuple(t + num_qubits for t in targets)

@@ -265,6 +265,15 @@ def _apply_gateop(chunk, dev, *, D, local_n, density, op):
     n = local_n + int(math.log2(D))
     shift = n // 2 if density else 0
 
+    if op.kind == "superop":
+        # channel superoperator on [targets, targets+N]: one matrix op on
+        # the doubled register, both spaces at once (no dual)
+        from quest_tpu.ops.matrices import superop_targets
+        return _matrix_op(chunk, dev, D=D, local_n=local_n,
+                          m_pair=cplx.pack(op.operand),
+                          targets=list(superop_targets(op.targets, shift)),
+                          controls=(), cstates=())
+
     def one(chunk, targets, controls, conj):
         if op.kind == "parity":
             ang = -op.operand if conj else op.operand
@@ -301,6 +310,11 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
     local_n = n - g
     if local_n < 1:
         raise ValueError("register too small for mesh")
+    if not density and any(op.kind == "superop" for op in ops):
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid operation: noise channels require a density-matrix "
+            "register")
     ops = tuple(ops)
 
     def run(chunk):

@@ -257,3 +257,16 @@ def test_sharded_sampling(mesh, rng):
     samples = np.asarray(meas.sample(q2, 5000, jax.random.PRNGKey(4)))
     freqs = np.bincount(samples, minlength=1 << N) / 5000
     np.testing.assert_allclose(freqs, np.abs(v) ** 2, atol=0.03)
+
+
+def test_sharded_noisy_circuit(mesh):
+    """Noise channels compiled into a sharded circuit (superop targets span
+    the inner/outer halves, exercising swap-to-local for the doubled
+    targets)."""
+    c = Circuit(ND)
+    c.h(0)
+    c.cnot(0, 1)
+    c.damping(1, 0.2)
+    c.depolarising(2, 0.3)
+    c.dephasing(0, 0.25)
+    check(c, mesh, density=True)

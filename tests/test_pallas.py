@@ -163,3 +163,70 @@ def test_small_register_falls_back():
     got = to_dense(c.apply_fused(q, interpret=True))
     want = to_dense(c.apply(qt.create_qureg(4)))
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_noisy_circuit_channels():
+    """Noise channels compiled into a circuit (superop ops) match the
+    eager channel path — on both the XLA and fused engines."""
+    from quest_tpu.ops import channels as ch
+
+    c = Circuit(5)
+    c.h(0)
+    c.cnot(0, 1)
+    c.damping(1, 0.2)
+    c.depolarising(0, 0.3)
+    c.dephasing(2, 0.25)
+    c.ry(3, 0.4)
+
+    # eager reference result
+    q = qt.init_debug_state(qt.create_density_qureg(5))
+    from quest_tpu.ops import gates as G
+    e = G.hadamard(q, 0)
+    e = G.controlled_not(e, 0, 1)
+    e = ch.mix_damping(e, 1, 0.2)
+    e = ch.mix_depolarising(e, 0, 0.3)
+    e = ch.mix_dephasing(e, 2, 0.25)
+    e = G.rotate_y(e, 3, 0.4)
+    want = to_dense(e)
+
+    got_xla = to_dense(c.apply(qt.init_debug_state(qt.create_density_qureg(5))))
+    got_fused = to_dense(c.apply_fused(
+        qt.init_debug_state(qt.create_density_qureg(5)), interpret=True))
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got_xla, want, atol=1e-5 * scale, rtol=0)
+    np.testing.assert_allclose(got_fused, want, atol=1e-5 * scale, rtol=0)
+
+
+def test_channels_need_density_register():
+    from quest_tpu.validation import QuESTError
+    c = Circuit(3)
+    c.damping(0, 0.1)
+    with pytest.raises(QuESTError, match="density"):
+        c.apply(qt.create_qureg(3))
+
+
+def test_channels_need_density_register_all_engines():
+    from quest_tpu.validation import QuESTError
+    from quest_tpu.parallel.mesh import make_amp_mesh
+    c = Circuit(12)
+    c.damping(0, 0.1)
+    with pytest.raises(QuESTError, match="density"):
+        c.apply_fused(qt.create_qureg(12), interpret=True)
+    mesh = make_amp_mesh(1)
+    with pytest.raises(QuESTError, match="density"):
+        c.compiled_sharded(12, density=False, mesh=mesh)
+
+
+def test_channel_builders_validate():
+    from quest_tpu.validation import QuESTError
+    c = Circuit(3)
+    with pytest.raises(QuESTError, match="probability"):
+        c.damping(0, 1.2)
+    with pytest.raises(QuESTError, match="probability"):
+        c.depolarising(0, 0.9)
+    with pytest.raises(QuESTError, match="probability"):
+        c.dephasing(0, 0.6)
+    with pytest.raises(QuESTError):
+        c.kraus(0, [np.eye(2) * 0.5])          # non-CPTP
+    with pytest.raises(QuESTError):
+        c.kraus((0, 1), [np.eye(2)])           # dim mismatch
