@@ -1,0 +1,77 @@
+"""Build the reference QuEST CPU library and measure the BASELINE.json
+configs on this host (BASELINE.md: "all baseline numbers must be
+self-measured"). Writes benchmarks/reference_baseline.json, which bench.py
+uses as the vs_baseline denominator.
+
+Builds out-of-tree (the reference tree is read-only) via the reference's
+own CMake USER_SOURCE hook (reference CMakeLists.txt:19-22), once per
+precision: PRECISION=1 (float, comparable to the TPU engine's f32 planes)
+and PRECISION=2 (double, the reference default).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+OUT = os.path.join(REPO, "benchmarks", "reference_baseline.json")
+
+
+def build(precision: int, build_dir: str) -> str:
+    os.makedirs(build_dir, exist_ok=True)
+    subprocess.run(
+        ["cmake", "-S", REF, "-B", build_dir,
+         "-DCMAKE_BUILD_TYPE=Release",
+         f"-DUSER_SOURCE={REPO}/benchmarks/reference_bench.c",
+         "-DOUTPUT_EXE=refbench",
+         f"-DPRECISION={precision}",
+         # serial: this host has one core, and the reference's OpenMP
+         # default(none) pragmas reject modern GCC's const-sharing rules
+         "-DMULTITHREADED=0"],
+        check=True, capture_output=True, text=True)
+    subprocess.run(["cmake", "--build", build_dir, "-j"],
+                   check=True, capture_output=True, text=True)
+    return os.path.join(build_dir, "refbench")
+
+
+def run(exe: str, *args: str) -> list[dict]:
+    res = subprocess.run([exe, *args], check=True, capture_output=True,
+                         text=True, timeout=1800)
+    out = []
+    for line in res.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            out.append(json.loads(line))
+    return out
+
+
+def main():
+    gates_n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
+    results = {"host_cores": os.cpu_count()}
+    for prec, tag in ((1, "f32"), (2, "f64")):
+        exe = build(prec, f"/tmp/refbuild_p{prec}")
+        print(f"built reference (PRECISION={prec}); running...", flush=True)
+        rows = run(exe, "all", str(gates_n))
+        results[tag] = {r["config"]: r for r in rows}
+        print(json.dumps(rows, indent=1), flush=True)
+
+    # headline entry consumed by bench.py: the reference's own butterfly
+    # throughput in amps/sec, measured at float precision (apples-to-apples
+    # with the TPU engine's f32 planes) on this host
+    g = results["f32"]["gates"]
+    results["single_qubit_gates"] = {
+        "amps_per_sec": g["amps_per_sec"],
+        "gates_per_sec_at_n": g["gates_per_sec"],
+        "n": g["n"],
+        "config": f"reference CPU build, PRECISION=1, "
+                  f"{os.cpu_count()} host core(s)",
+    }
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

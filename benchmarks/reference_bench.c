@@ -1,0 +1,140 @@
+/* Benchmark driver for the REFERENCE QuEST build (BASELINE.md: "all
+ * baseline numbers must be self-measured: run the reference's CPU build on
+ * the BASELINE.json configs").
+ *
+ * Compiled against /root/reference via its own CMake (USER_SOURCE hook,
+ * reference CMakeLists.txt:19-22) by benchmarks/measure_reference.py.
+ * Prints one JSON object per config on stdout.
+ *
+ * Configs (BASELINE.json "configs"):
+ *   gates    - single-qubit gates/sec on a dense statevector (north star)
+ *   tutorial - the 3-qubit tutorial circuit (tutorial_example.c:50-105)
+ *   rcs      - random-circuit-sampling layers (rotations + CZ brick)
+ *   channels - density-matrix decoherence (mixDamping/Depolarising/Kraus)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "QuEST.h"
+
+static double now_sec(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + 1e-9 * ts.tv_nsec;
+}
+
+/* same shape as /root/repo/bench.py: 16 rotateX round-robin over qubits
+ * [1, n-1], timed over reps */
+static void bench_gates(QuESTEnv env, int n, int gates_per_step, int reps) {
+    Qureg q = createQureg(n, env);
+    initZeroState(q);
+    /* warmup one step */
+    for (int i = 0; i < gates_per_step; i++)
+        rotateX(q, 1 + i % (n - 1), 0.37 + 0.01 * i);
+    double t0 = now_sec();
+    for (int r = 0; r < reps; r++)
+        for (int i = 0; i < gates_per_step; i++)
+            rotateX(q, 1 + i % (n - 1), 0.37 + 0.01 * i);
+    double dt = now_sec() - t0;
+    double gates = (double)gates_per_step * reps;
+    double gps = gates / dt;
+    double amps_per_sec = gps * (double)(1LL << n);
+    printf("{\"config\": \"gates\", \"n\": %d, \"gates_per_sec\": %.3f, "
+           "\"amps_per_sec\": %.3e, \"precision\": %d, \"seconds\": %.3f}\n",
+           n, gps, amps_per_sec, (int)sizeof(qreal) / 4, dt);
+    destroyQureg(q, env);
+}
+
+/* tutorial_example.c:50-105 circuit, repeated */
+static void bench_tutorial(QuESTEnv env, int reps) {
+    Qureg q = createQureg(3, env);
+    double t0 = now_sec();
+    for (int r = 0; r < reps; r++) {
+        initZeroState(q);
+        hadamard(q, 0);
+        controlledNot(q, 0, 1);
+        rotateY(q, 2, .1);
+        multiControlledPhaseFlip(q, (int[]){0, 1, 2}, 3);
+        ComplexMatrix2 u = {.real = {{.5, .5}, {.5, .5}},
+                            .imag = {{.5, -.5}, {-.5, .5}}};
+        unitary(q, 0, u);
+        Complex a = {.real = .5, .imag = .5};
+        Complex b = {.real = .5, .imag = -.5};
+        compactUnitary(q, 1, a, b);
+        Vector v = {1, 0, 0};
+        rotateAroundAxis(q, 2, 3.14 / 2, v);
+        controlledCompactUnitary(q, 0, 1, a, b);
+        multiControlledUnitary(q, (int[]){0, 1}, 2, 2, u);
+        (void)calcProbOfOutcome(q, 2, 1);
+    }
+    double dt = now_sec() - t0;
+    printf("{\"config\": \"tutorial\", \"reps\": %d, \"seconds\": %.4f, "
+           "\"circuits_per_sec\": %.1f}\n", reps, dt, reps / dt);
+    destroyQureg(q, env);
+}
+
+/* RCS layers: per layer, a random rotation on every qubit then a CZ brick
+ * (same structure as quest_tpu.circuit.random_circuit) */
+static void bench_rcs(QuESTEnv env, int n, int depth) {
+    Qureg q = createQureg(n, env);
+    initZeroState(q);
+    srand(7);
+    double t0 = now_sec();
+    for (int d = 0; d < depth; d++) {
+        for (int i = 0; i < n; i++) {
+            double angle = 6.28 * rand() / (double)RAND_MAX;
+            switch (rand() % 3) {
+                case 0: rotateX(q, i, angle); break;
+                case 1: rotateY(q, i, angle); break;
+                default: rotateZ(q, i, angle); break;
+            }
+        }
+        for (int i = d % 2; i < n - 1; i += 2)
+            controlledPhaseFlip(q, i, i + 1);
+    }
+    double dt = now_sec() - t0;
+    int gates = depth * n + depth * (n - 1) / 2;
+    printf("{\"config\": \"rcs\", \"n\": %d, \"depth\": %d, "
+           "\"seconds\": %.3f, \"gates\": %d, \"gates_per_sec\": %.2f}\n",
+           n, depth, dt, gates, gates / dt);
+    destroyQureg(q, env);
+}
+
+/* density-matrix channels (BASELINE.json config 4) */
+static void bench_channels(QuESTEnv env, int n, int reps) {
+    Qureg rho = createDensityQureg(n, env);
+    initPlusState(rho);
+    ComplexMatrix2 k0 = {.real = {{1, 0}, {0, .8}}, .imag = {{0, 0}, {0, 0}}};
+    ComplexMatrix2 k1 = {.real = {{0, .6}, {0, 0}}, .imag = {{0, 0}, {0, 0}}};
+    ComplexMatrix2 kraus[2] = {k0, k1};
+    double t0 = now_sec();
+    for (int r = 0; r < reps; r++) {
+        mixDamping(rho, r % n, 0.1);
+        mixDepolarising(rho, (r + 1) % n, 0.1);
+        mixDephasing(rho, (r + 2) % n, 0.1);
+        mixKrausMap(rho, (r + 3) % n, kraus, 2);
+    }
+    double dt = now_sec() - t0;
+    double cps = 4.0 * reps / dt;
+    printf("{\"config\": \"channels\", \"n\": %d, \"seconds\": %.3f, "
+           "\"channels_per_sec\": %.2f}\n", n, dt, cps);
+    destroyQureg(rho, env);
+}
+
+int main(int argc, char **argv) {
+    QuESTEnv env = createQuESTEnv();
+    const char *cfg = argc > 1 ? argv[1] : "all";
+    int gates_n = argc > 2 ? atoi(argv[2]) : 26;
+    if (!strcmp(cfg, "gates") || !strcmp(cfg, "all"))
+        bench_gates(env, gates_n, 16, 4);
+    if (!strcmp(cfg, "tutorial") || !strcmp(cfg, "all"))
+        bench_tutorial(env, 2000);
+    if (!strcmp(cfg, "rcs") || !strcmp(cfg, "all"))
+        bench_rcs(env, 22, 4);
+    if (!strcmp(cfg, "channels") || !strcmp(cfg, "all"))
+        bench_channels(env, 11, 8);
+    destroyQuESTEnv(env);
+    return 0;
+}

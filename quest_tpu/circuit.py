@@ -233,30 +233,14 @@ class Circuit:
             raise ValueError("circuit/register size mismatch")
         return q.replace_amps(self.compiled(n, q.is_density, donate)(q.amps))
 
-    def compiled_fused(self, n: int, density: bool, donate: bool = True,
-                       interpret: bool = False):
-        """Compiled program using the Pallas fused-segment engine
-        (quest_tpu.ops.pallas_engine): runs of gates on in-block qubits
-        execute in ONE kernel launch / one HBM pass; the rest fall back to
-        the XLA per-gate path. `interpret=True` runs the kernels in the
-        Pallas interpreter (for CPU testing)."""
-        from quest_tpu.ops import pallas_engine as PE
-        key = ("fused", n, density, donate, interpret)
-        fn = self._compiled.get(key)
-        if fn is not None:
-            return fn
+    def _flat_ops(self, n: int, density: bool) -> List[GateOp]:
+        """Expand density duals into a flat op list (ref QuEST.c:8-10);
+        superops become explicit matrix ops on the doubled targets."""
         if not density and any(op.kind == "superop" for op in self.ops):
             from quest_tpu.validation import QuESTError
             raise QuESTError(
                 "Invalid operation: noise channels require a density-matrix "
                 "register")
-        if not PE.usable(n):
-            fn = self.compiled(n, density, donate)
-            self._compiled[key] = fn
-            return fn
-
-        # expand density duals into a flat op list (ref QuEST.c:8-10);
-        # superops become explicit matrix ops on the doubled targets
         flat: List[GateOp] = []
         for op in self.ops:
             if op.kind == "superop":
@@ -269,7 +253,63 @@ class Circuit:
                 dual = dual_of(op, n // 2)
                 if dual is not None:
                     flat.append(dual)
+        return flat
 
+    def compiled_banded(self, n: int, density: bool, donate: bool = True):
+        """Compiled program using the band-fusion engine
+        (quest_tpu.ops.fusion): runs of commuting gates compose into one
+        operator per 7-qubit band, each applied as a single MXU axis
+        contraction (apply_band). Diagonal/parity ops stay elementwise and
+        XLA fuses them into the neighbouring passes. A layer of n
+        single-qubit gates costs ~ceil(n/7) memory passes instead of n."""
+        from quest_tpu.ops import fusion as F
+        key = ("banded", n, density, donate)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        items = F.plan(self._flat_ops(n, density), n)
+
+        def run(amps):
+            for it in items:
+                if isinstance(it, F.BandOp):
+                    amps = A.apply_band(amps, n, (it.gre, it.gim), it.ql,
+                                        it.w, it.preds)
+                elif isinstance(it, F.DiagItem):
+                    amps = _apply_one(amps, n, it.op)
+                else:
+                    amps = _apply_op(amps, n, False, it.op)
+            return amps
+
+        fn = jax.jit(run, donate_argnums=(0,) if donate else ())
+        self._compiled[key] = fn
+        return fn
+
+    def apply_banded(self, q: Qureg, donate: bool = False) -> Qureg:
+        """Apply via the band-fusion engine."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        fn = self.compiled_banded(q.num_state_qubits, q.is_density, donate)
+        return q.replace_amps(fn(q.amps))
+
+    def compiled_fused(self, n: int, density: bool, donate: bool = True,
+                       interpret: bool = False):
+        """Compiled program using the Pallas fused-segment engine
+        (quest_tpu.ops.pallas_engine): runs of gates on in-block qubits
+        execute in ONE kernel launch / one HBM pass; the rest fall back to
+        the XLA per-gate path. `interpret=True` runs the kernels in the
+        Pallas interpreter (for CPU testing)."""
+        from quest_tpu.ops import pallas_engine as PE
+        key = ("fused", n, density, donate, interpret)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        if not PE.usable(n):
+            self._flat_ops(n, density)  # raises on statevec noise channels
+            fn = self.compiled(n, density, donate)
+            self._compiled[key] = fn
+            return fn
+
+        flat = self._flat_ops(n, density)
         plan = PE.plan_ops(flat, n, PE.qmax_for(n))
         appliers = []
         for kind, payload in plan.items:

@@ -14,13 +14,23 @@ fused as large segments. A k-target gate with c controls therefore works on
 a rank-(2(k+c)+1) tensor regardless of n — large contiguous dims that XLA
 tiles well.
 
-A k-qubit gate is applied as an unrolled butterfly: slice the 2^k target
-blocks (keepdims), form the 2^k output blocks as weighted sums (explicit
-complex arithmetic on the planes), and reassemble with concatenations along
-the target axes. For CONCRETE numpy operands, zero matrix entries are
-skipped at trace time — an X gate emits pure data movement, no arithmetic
-(the analogue of the reference's dedicated pauliX kernel vs its general
-unitary kernel, QuEST_cpu.c:2464 vs 1656).
+A k-qubit gate is applied as a FLIP-FORM butterfly:
+
+    out = sum over d in {0,1}^k of  C_d * rev_d(x)
+
+where rev_d reverses the target axes selected by bit-pattern d and C_d is
+the coefficient tensor C_d[b] = m[b, b XOR d], broadcast along the
+non-target axes. Every term is elementwise (multiply-accumulate against an
+axis-reversed read of the SAME input buffer), so XLA fuses the whole gate
+into one memory pass with exactly two live full-state buffers — the
+in-place discipline of the reference's kernels (QuEST_cpu.c:1656-1713).
+[The earlier slice/concat reassembly made XLA materialize a fresh
+full-state temp per concat and OOMed a 16 GB chip at 26 qubits.]
+
+For CONCRETE numpy operands, zero C_d terms are skipped at trace time — an
+X gate emits a pure axis reversal, no arithmetic (the analogue of the
+reference's dedicated pauliX kernel vs its general unitary kernel,
+QuEST_cpu.c:2464 vs 1656).
 
 Index conventions (identical to the reference, QuEST.h little-endian):
   - flat amplitude index i; qubit q is bit q of i
@@ -92,7 +102,7 @@ def _as_pair(op_pair, rdtype):
             False)
 
 
-_UNROLL_MAX_TARGETS = 4  # beyond this the 4^k unrolled butterfly explodes
+_UNROLL_MAX_TARGETS = 4  # beyond this the 2^k-term flip butterfly explodes
                          # compile time; use the gather+matmul path instead
 
 
@@ -122,57 +132,96 @@ def apply_matrix(
     re = amps[0].reshape(dims)
     im = amps[1].reshape(dims)
     taxes = [axis_of[t] for t in targets]
+    lib = np if concrete else jnp
+    rows = np.arange(1 << k)
 
-    def block(x, combo):
-        idx = [slice(None)] * ndims
-        for j, ax in enumerate(taxes):
-            b = (combo >> j) & 1
-            idx[ax] = slice(b, b + 1)
-        return x[tuple(idx)]
-
-    rbs = [block(re, c) for c in range(1 << k)]
-    ibs = [block(im, c) for c in range(1 << k)]
-    mask = control_mask(ndims, axis_of, controls, control_states)
-
-    out_re = [None] * (1 << k)
-    out_im = [None] * (1 << k)
-    for r in range(1 << k):
-        nr = None
-        ni = None
-        for c in range(1 << k):
-            wr, wi = mre[r, c], mim[r, c]
-            if concrete and wr == 0.0 and wi == 0.0:
-                continue
-            if concrete and wi == 0.0:
-                tr = rbs[c] if wr == 1.0 else wr * rbs[c]
-                ti = ibs[c] if wr == 1.0 else wr * ibs[c]
-            elif concrete and wr == 0.0:
-                tr = -wi * ibs[c]
-                ti = wi * rbs[c]
+    nre = None
+    nim = None
+    for d in range(1 << k):
+        # coefficient vector c[b] = m[b, b ^ d], laid out along target axes
+        cre = mre[rows, rows ^ d]
+        cim = mim[rows, rows ^ d]
+        if concrete and np.all(cre == 0.0) and np.all(cim == 0.0):
+            continue
+        rev = [taxes[j] for j in range(k) if (d >> j) & 1]
+        xr = jnp.flip(re, rev) if rev else re
+        xi = jnp.flip(im, rev) if rev else im
+        fre = _diag_broadcast(cre, k, targets, dims, axis_of, lib)
+        fim = _diag_broadcast(cim, k, targets, dims, axis_of, lib)
+        if concrete and np.all(cim == 0.0):
+            if np.all(cre == 1.0):
+                tr, ti = xr, xi       # pure amplitude permutation (X-like)
             else:
-                tr = wr * rbs[c] - wi * ibs[c]
-                ti = wr * ibs[c] + wi * rbs[c]
-            nr = tr if nr is None else nr + tr
-            ni = ti if ni is None else ni + ti
-        if nr is None:  # all-zero matrix row
-            nr = jnp.zeros_like(rbs[r])
-            ni = jnp.zeros_like(ibs[r])
-        if mask is not None:
-            nr = jnp.where(mask, nr, rbs[r])
-            ni = jnp.where(mask, ni, ibs[r])
-        out_re[r] = nr
-        out_im[r] = ni
+                tr, ti = fre * xr, fre * xi
+        elif concrete and np.all(cre == 0.0):
+            tr, ti = -fim * xi, fim * xr
+        else:
+            tr = fre * xr - fim * xi
+            ti = fre * xi + fim * xr
+        nre = tr if nre is None else nre + tr
+        nim = ti if nim is None else nim + ti
 
-    # reassemble along each target axis: after each merge the list halves
-    # and its low index bit always corresponds to the next original bit j
-    for j in range(k):
-        ax = taxes[j]
-        out_re = [jnp.concatenate([out_re[2 * i], out_re[2 * i + 1]], axis=ax)
-                  for i in range(len(out_re) // 2)]
-        out_im = [jnp.concatenate([out_im[2 * i], out_im[2 * i + 1]], axis=ax)
-                  for i in range(len(out_im) // 2)]
+    if nre is None:  # all-zero matrix
+        nre = jnp.zeros_like(re)
+        nim = jnp.zeros_like(im)
+    mask = control_mask(ndims, axis_of, controls, control_states)
+    if mask is not None:
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
 
-    return jnp.stack([out_re[0].reshape(-1), out_im[0].reshape(-1)])
+
+def apply_band(
+    amps: jax.Array,
+    n: int,
+    op_pair,
+    ql: int,
+    w: int,
+    preds: Sequence[Tuple[int, int]] = (),
+) -> jax.Array:
+    """Apply a composed (2^w, 2^w) band operator to qubits [ql, ql+w) of
+    the n-qubit state `amps` (2, 2^n), optionally masked by out-of-band
+    (qubit, want) control predicates.
+
+    The band occupies one contiguous bit-range of the amplitude index, so
+    the state reshapes to (pre, 2^w, post) and the operator applies as ONE
+    axis contraction — a batched matmul on the MXU (out[p,a,q] =
+    sum_b G[a,b] x[p,b,q]). This is how every single-qubit gate reaches
+    the matrix unit; see quest_tpu/ops/fusion.py for the planner."""
+    gre, gim, concrete = _as_pair(op_pair, amps.dtype)
+    band = 1 << w
+    post = 1 << ql
+    pre = (1 << n) >> (ql + w)
+    re = amps[0].reshape(pre, band, post)
+    im = amps[1].reshape(pre, band, post)
+    real_only = concrete and np.all(gim == 0.0)
+    gre = jnp.asarray(gre).reshape(band, band)
+    gim = jnp.asarray(gim).reshape(band, band)
+    hi = lax.Precision.HIGHEST
+
+    def contract(g, x):
+        return jnp.einsum("ab,pbq->paq", g, x, precision=hi)
+
+    if real_only:
+        nre = contract(gre, re)
+        nim = contract(gre, im)
+    else:
+        nre = contract(gre, re) - contract(gim, im)
+        nim = contract(gre, im) + contract(gim, re)
+
+    if preds:
+        mask = None
+        for q, s in preds:
+            if q < ql:
+                ids = jnp.arange(post).reshape(1, 1, post)
+            else:
+                ids = jnp.arange(pre).reshape(pre, 1, 1)
+                q = q - (ql + w)
+            bit = ((ids >> q) & 1) == s
+            mask = bit if mask is None else (mask & bit)
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
 
 
 def _apply_matrix_matmul(amps, n, op_pair, targets, controls,

@@ -1,0 +1,218 @@
+"""Band-fusion planner: compose gate runs into per-band operators.
+
+THE central TPU kernel-engineering idea of this framework (SURVEY.md §7
+"hard parts"): strided 2-element butterflies map terribly onto the TPU's
+(8, 128) tiles and the 128x128 MXU, but a 7-qubit-aligned BAND of the
+amplitude index is exactly one hardware axis:
+
+    band 0 = qubits 0..6    the 128-lane axis
+    band 1 = qubits 7..13   the sublane axis (rows within a 128-row tile)
+    band 2 = qubits 14..20  the tile index
+    band 3 = qubits 21..27  ... and so on, 7 bits per axis.
+
+Any single-qubit gate (with controls anywhere) therefore becomes a
+128x128 operator acting on ONE axis of the reshaped state — a batched
+matmul the MXU executes natively. Consecutive commuting gates in the same
+band compose into a single operator at trace time (numpy), so a whole
+layer of single-qubit rotations costs ceil(n/7) memory passes instead of
+n, each pass a dense contraction.
+
+This is the role the reference's per-gate kernel zoo plays on CPU/GPU
+(QuEST_cpu.c:1656-3620, QuEST_gpu.cu) — re-thought for the MXU instead of
+translated.
+
+Fused item kinds produced by `plan`:
+  BandOp      composed 2^w x 2^w operator on one band, with optional
+              out-of-band control predicates (masked matmul)
+  DiagItem    diagonal / parity / all-ones phase GateOp — elementwise,
+              any qubits; XLA fuses these into neighbouring passes for
+              free (the reference's "diagonals never communicate" insight,
+              QuEST_cpu.c:2940-3109, taken one step further)
+  PassOp      anything else (cross-band multi-target unitaries, Kraus
+              superoperators) — falls through to the general apply path.
+
+Commutation rule used when merging across intervening items: two ops
+commute if on every shared qubit BOTH act diagonally (controls and
+diagonal/parity ops act diagonally; matrix targets do not). This is a
+sufficient condition, checked structurally — no numerics involved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+BAND_W = 7  # qubits per hardware axis: 2^7 = 128 lanes / sublanes / tiles
+
+
+# ---------------------------------------------------------------------------
+# plan items
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BandOp:
+    ql: int                     # first qubit of the band
+    w: int                      # band width in qubits (<= BAND_W)
+    gre: np.ndarray             # (2^w, 2^w) composed operator, real part
+    gim: np.ndarray
+    preds: Tuple[Tuple[int, int], ...]  # out-of-band (qubit, want) controls
+    nondiag: frozenset          # qubits the operator genuinely mixes
+    touched: frozenset          # all qubits involved (targets + controls)
+
+    def qubits(self):
+        return self.touched | {q for q, _ in self.preds}
+
+
+@dataclasses.dataclass
+class DiagItem:
+    op: object                  # the original GateOp (diag/parity/allones)
+    qubits_: frozenset
+
+    def qubits(self):
+        return self.qubits_
+
+
+@dataclasses.dataclass
+class PassOp:
+    op: object
+    nondiag: frozenset
+    qubits_: frozenset
+
+    def qubits(self):
+        return self.qubits_
+
+
+# ---------------------------------------------------------------------------
+# operator embedding (band-local)
+# ---------------------------------------------------------------------------
+
+
+def embed_operator(matrix: np.ndarray, targets_rel: Sequence[int],
+                   controls_rel: Sequence[int], cstates: Sequence[int],
+                   width: int) -> np.ndarray:
+    """Embed a k-qubit operator with in-band controls into the full
+    2^width-dim band space (the full-operator construction the reference's
+    test oracle uses, tests/utilities.hpp getFullOperatorMatrix — here it
+    runs at trace time to build composed band operators)."""
+    matrix = np.asarray(matrix, dtype=np.complex128)
+    k = len(targets_rel)
+    dim = 1 << width
+    op = np.zeros((dim, dim), dtype=np.complex128)
+    for col in range(dim):
+        if any(((col >> c) & 1) != s for c, s in zip(controls_rel, cstates)):
+            op[col, col] = 1.0
+            continue
+        sub = 0
+        for bit, t in enumerate(targets_rel):
+            sub |= ((col >> t) & 1) << bit
+        rest = col
+        for t in targets_rel:
+            rest &= ~(1 << t)
+        for sub_out in range(1 << k):
+            row = rest
+            for bit, t in enumerate(targets_rel):
+                if (sub_out >> bit) & 1:
+                    row |= 1 << t
+            op[row, col] = matrix[sub_out, sub]
+    return op
+
+
+def _diag_to_matrix(operand, kind) -> np.ndarray:
+    if kind == "diagonal":
+        return np.diag(np.asarray(operand, dtype=np.complex128).reshape(-1))
+    raise ValueError(kind)
+
+
+# ---------------------------------------------------------------------------
+# the planner
+# ---------------------------------------------------------------------------
+
+
+def _commutes(a_nondiag, a_all, b_nondiag, b_all) -> bool:
+    """Structural commutation: every shared qubit must be diagonal-acting
+    on both sides."""
+    shared = a_all & b_all
+    if not shared:
+        return True
+    return not (shared & (a_nondiag | b_nondiag))
+
+
+def _band_of(q: int) -> int:
+    return q // BAND_W
+
+
+def band_range(n: int, b: int) -> Tuple[int, int]:
+    """(first qubit, width) of band b for an n-qubit register."""
+    ql = b * BAND_W
+    return ql, min(BAND_W, n - ql)
+
+
+def plan(ops: Sequence, n: int) -> List:
+    """Fuse a GateOp sequence into [BandOp | DiagItem | PassOp], preserving
+    semantics. Gate operands must be concrete (numpy) to compose; ops with
+    traced operands become PassOps."""
+    items: List = []
+
+    def try_merge(band: int, emb: np.ndarray, preds, nondiag, touched):
+        """Merge emb into an existing BandOp for `band` if every item in
+        between commutes with the new op. Returns True on success."""
+        new_all = frozenset(touched) | {q for q, _ in preds}
+        for i in range(len(items) - 1, -1, -1):
+            g = items[i]
+            if (isinstance(g, BandOp) and _band_of(g.ql) == band
+                    and g.preds == preds):
+                comp = emb @ (g.gre.astype(np.complex128) + 1j * g.gim)
+                items[i] = BandOp(g.ql, g.w, comp.real, comp.imag, preds,
+                                  g.nondiag | nondiag, g.touched | touched)
+                return True
+            g_nondiag = getattr(g, "nondiag", frozenset())
+            if not _commutes(nondiag, new_all, g_nondiag, g.qubits()):
+                return False
+        return False
+
+    for op in ops:
+        targets = tuple(op.targets)
+        controls = tuple(op.controls)
+        cstates = tuple(op.cstates) if op.cstates else (1,) * len(controls)
+
+        if op.kind in ("parity", "allones"):
+            items.append(DiagItem(op, frozenset(targets) | frozenset(controls)))
+            continue
+
+        operand = op.operand
+        if not isinstance(operand, np.ndarray):
+            operand = np.asarray(operand)
+        if operand.dtype == object or not np.issubdtype(
+                operand.dtype, np.number):
+            items.append(PassOp(op, frozenset(targets),
+                                frozenset(targets) | frozenset(controls)))
+            continue
+
+        bands = {_band_of(t) for t in targets}
+        if len(bands) != 1:
+            # cross-band multi-target unitary (superop targets, swaps across
+            # bands, ...) — general apply path
+            items.append(PassOp(op, frozenset(targets),
+                                frozenset(targets) | frozenset(controls)))
+            continue
+
+        b = bands.pop()
+        ql, w = band_range(n, b)
+        in_c = [c for c in controls if _band_of(c) == b]
+        in_s = [s for c, s in zip(controls, cstates) if _band_of(c) == b]
+        preds = tuple(sorted((c, s) for c, s in zip(controls, cstates)
+                             if _band_of(c) != b))
+        mat = (_diag_to_matrix(operand, "diagonal")
+               if op.kind == "diagonal" else np.asarray(operand))
+        emb = embed_operator(mat, [t - ql for t in targets],
+                             [c - ql for c in in_c], in_s, w)
+        nondiag = (frozenset() if op.kind == "diagonal"
+                   else frozenset(targets))
+        touched = frozenset(targets) | frozenset(controls)
+        if not try_merge(b, emb, preds, nondiag, touched):
+            items.append(BandOp(ql, w, emb.real, emb.imag, preds, nondiag,
+                                touched))
+    return items

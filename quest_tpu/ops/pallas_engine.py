@@ -48,7 +48,12 @@ from jax.experimental import pallas as pl
 
 LANE_QUBITS = 7           # qubits 0..6 live in the 128-lane axis
 LANES = 1 << LANE_QUBITS
-MAX_ROWS_PER_BLOCK = 4096  # (2, 4096, 128) f32 = 4 MiB per buffer in VMEM
+MAX_ROWS_PER_BLOCK = 2048  # (2, 2048, 128) f32 = 2 MiB per block buffer.
+# Sized for the default 16 MiB scoped-VMEM limit on v5e: Pallas double-
+# buffers the grid pipeline, so in+out cost 2*(2+2) = 8 MiB, leaving
+# headroom for lane-operator blocks. 4096-row blocks hit exactly 16.04 MiB
+# and fail to compile on the real chip (measured; the axon terminal
+# overrides client XLA_FLAGS, so the limit cannot be raised).
 
 
 # ---------------------------------------------------------------------------

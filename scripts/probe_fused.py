@@ -1,0 +1,65 @@
+"""Probe: does the Pallas fused engine compile+run on the real chip, and
+how fast is it vs the XLA per-gate path? Prints full tracebacks instead of
+swallowing them (bench.py's except Exception hid the round-1 failure)."""
+import sys
+import time
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def build(n, gates):
+    from quest_tpu.circuit import Circuit
+    rng = np.random.default_rng(42)
+    c = Circuit(n)
+    for i in range(gates):
+        q = 1 + i % (n - 1)
+        c.rx(q, float(rng.uniform(0, 2 * np.pi)))
+    return c
+
+
+def timed(step, state, reps, label, gates):
+    t0 = time.perf_counter()
+    state = step(state)
+    _ = np.asarray(state[0, :4])
+    print(f"  {label}: first call (compile) {time.perf_counter()-t0:.1f}s",
+          flush=True)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = step(state)
+    _ = np.asarray(state[0, :4])
+    dt = time.perf_counter() - t0
+    gps = gates * reps / dt
+    bw = gps * 2 * (1 << n) * 4 * 2  # read+write both planes, f32
+    print(f"  {label}: {gps:.1f} gates/s  ({bw/1e9:.1f} GB/s effective)",
+          flush=True)
+    return state
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    gates = 16
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    print("devices:", jax.devices(), flush=True)
+    circ = build(n, gates)
+
+    engines = sys.argv[3].split(",") if len(sys.argv) > 3 else \
+        ["banded", "fused", "xla"]
+    for name in engines:
+        print(f"n={n} {name} engine:", flush=True)
+        try:
+            circ = build(n, gates)
+            if name == "banded":
+                step = circ.compiled_banded(n, density=False, donate=True)
+            elif name == "fused":
+                step = circ.compiled_fused(n, density=False, donate=True)
+            else:
+                step = circ.compiled(n, density=False, donate=True)
+            state = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+            timed(step, state, reps, name, gates)
+        except Exception:
+            traceback.print_exc()
