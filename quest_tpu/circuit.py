@@ -51,6 +51,25 @@ def dual_of(op: GateOp, shift: int):
         operand=np.conj(op.operand))
 
 
+_LOOP_UNROLL_MAX = 32
+
+
+def _loop(body, amps, iters: int):
+    """Apply `body` to the state `iters` times inside one program, so deep
+    repetition costs ONE dispatch (dispatch through the TPU tunnel costs
+    ~5 ms; see scripts/probe_dispatch.py). Small counts unroll — measured
+    ~5 ms/iteration cheaper than lax.fori_loop's carry handling; large
+    counts use fori_loop to bound program size."""
+    if iters == 1:
+        return body(amps)
+    if iters <= _LOOP_UNROLL_MAX:
+        for _ in range(iters):
+            amps = body(amps)
+        return amps
+    from jax import lax
+    return lax.fori_loop(0, iters, lambda _, a: body(a), amps)
+
+
 def _apply_one(amps, n, op: GateOp):
     operand = op.operand
     if op.kind == "parity":
@@ -66,6 +85,21 @@ def _apply_one(amps, n, op: GateOp):
     fn = A.apply_diagonal if op.kind == "diagonal" else A.apply_matrix
     return fn(amps, n, cplx.pack(operand), op.targets, op.controls,
               op.cstates)
+
+
+def _apply_banded_items(amps, n, items):
+    """Apply an already-computed band-fusion plan (loop-invariant: callers
+    hoist the planning out of repeated bodies)."""
+    from quest_tpu.ops import fusion as F
+    for it in items:
+        if isinstance(it, F.BandOp):
+            amps = A.apply_band(amps, n, (it.gre, it.gim), it.ql, it.w,
+                                it.preds)
+        elif isinstance(it, F.DiagItem):
+            amps = _apply_one(amps, n, it.op)
+        else:
+            amps = _apply_op(amps, n, False, it.op)
+    return amps
 
 
 def _apply_op(amps, n, density, op: GateOp):
@@ -216,12 +250,13 @@ class Circuit:
             amps = _apply_op(amps, n, density, op)
         return amps
 
-    def compiled(self, n: int, density: bool, donate: bool = True):
-        key = (n, density, donate)
+    def compiled(self, n: int, density: bool, donate: bool = True,
+                 iters: int = 1):
+        key = (n, density, donate, iters)
         fn = self._compiled.get(key)
         if fn is None:
             def run(amps):
-                return self.trace(amps, n, density)
+                return _loop(lambda a: self.trace(a, n, density), amps, iters)
             fn = jax.jit(run, donate_argnums=(0,) if donate else ())
             self._compiled[key] = fn
         return fn
@@ -255,34 +290,36 @@ class Circuit:
                     flat.append(dual)
         return flat
 
-    def compiled_banded(self, n: int, density: bool, donate: bool = True):
+    def compiled_banded(self, n: int, density: bool, donate: bool = True,
+                        iters: int = 1):
         """Compiled program using the band-fusion engine
         (quest_tpu.ops.fusion): runs of commuting gates compose into one
         operator per 7-qubit band, each applied as a single MXU axis
         contraction (apply_band). Diagonal/parity ops stay elementwise and
         XLA fuses them into the neighbouring passes. A layer of n
         single-qubit gates costs ~ceil(n/7) memory passes instead of n."""
-        from quest_tpu.ops import fusion as F
-        key = ("banded", n, density, donate)
+        key = ("banded", n, density, donate, iters)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
+
+        from quest_tpu.ops import fusion as F
         items = F.plan(self._flat_ops(n, density), n)
 
         def run(amps):
-            for it in items:
-                if isinstance(it, F.BandOp):
-                    amps = A.apply_band(amps, n, (it.gre, it.gim), it.ql,
-                                        it.w, it.preds)
-                elif isinstance(it, F.DiagItem):
-                    amps = _apply_one(amps, n, it.op)
-                else:
-                    amps = _apply_op(amps, n, False, it.op)
-            return amps
+            return _loop(lambda a: _apply_banded_items(a, n, items), amps,
+                         iters)
 
         fn = jax.jit(run, donate_argnums=(0,) if donate else ())
         self._compiled[key] = fn
         return fn
+
+    def banded_trace(self, amps, n: int, density: bool):
+        """Apply the band-fusion plan to raw amplitudes inside an existing
+        trace (the un-jitted core of compiled_banded)."""
+        from quest_tpu.ops import fusion as F
+        items = F.plan(self._flat_ops(n, density), n)
+        return _apply_banded_items(amps, n, items)
 
     def apply_banded(self, q: Qureg, donate: bool = False) -> Qureg:
         """Apply via the band-fusion engine."""
@@ -292,44 +329,60 @@ class Circuit:
         return q.replace_amps(fn(q.amps))
 
     def compiled_fused(self, n: int, density: bool, donate: bool = True,
-                       interpret: bool = False):
-        """Compiled program using the Pallas fused-segment engine
-        (quest_tpu.ops.pallas_engine): runs of gates on in-block qubits
-        execute in ONE kernel launch / one HBM pass; the rest fall back to
-        the XLA per-gate path. `interpret=True` runs the kernels in the
-        Pallas interpreter (for CPU testing)."""
-        from quest_tpu.ops import pallas_engine as PE
-        key = ("fused", n, density, donate, interpret)
+                       interpret: bool = False, iters: int = 1):
+        """Compiled program using the Pallas band-segment engine
+        (quest_tpu.ops.pallas_band): each segment of band operators,
+        diagonals and parity phases executes in ONE kernel launch / one
+        HBM pass; band ops above the block top and cross-band unitaries
+        run through the XLA band path between segments. `interpret=True`
+        runs the kernels in the Pallas interpreter (for CPU testing)."""
+        from quest_tpu.ops import fusion as F
+        from quest_tpu.ops import pallas_band as PB
+        key = ("fused", n, density, donate, interpret, iters)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
-        if not PE.usable(n):
-            self._flat_ops(n, density)  # raises on statevec noise channels
-            fn = self.compiled(n, density, donate)
+        if not PB.usable(n):
+            fn = self.compiled_banded(n, density, donate, iters=iters)
             self._compiled[key] = fn
             return fn
 
         flat = self._flat_ops(n, density)
-        plan = PE.plan_ops(flat, n, PE.qmax_for(n))
+        brb = min(PB.DEFAULT_BLOCK_ROW_BITS, n - PB.LANE_QUBITS)
+        items = F.plan(flat, n, bands=PB.plan_bands(n, brb))
+        parts = PB.segment_plan(items, n, brb)
         appliers = []
-        for kind, payload in plan.items:
-            if kind == "segment":
-                appliers.append(PE.compile_segment(payload, n, interpret))
-            else:
-                op = payload
+        for part in parts:
+            if part[0] == "segment":
+                _, stages, arrays = part
+                seg = PB.compile_segment(stages, n, brb, interpret)
                 appliers.append(
-                    lambda amps, op=op: _apply_op(amps, n, False, op))
+                    lambda amps, seg=seg, arrays=arrays: seg(amps, arrays))
+            else:
+                it = part[1]
+                if isinstance(it, F.BandOp):
+                    appliers.append(
+                        lambda amps, it=it: A.apply_band(
+                            amps, n, (it.gre, it.gim), it.ql, it.w, it.preds))
+                elif isinstance(it, F.DiagItem):
+                    appliers.append(
+                        lambda amps, it=it: _apply_one(amps, n, it.op))
+                else:
+                    appliers.append(
+                        lambda amps, it=it: _apply_op(amps, n, False, it.op))
 
         def run(amps):
             # the Pallas kernels are f32-only; f64 registers keep their
-            # precision on the XLA per-gate path
+            # precision on the XLA band path
             if amps.dtype != jnp.float32:
-                for op in flat:
-                    amps = _apply_op(amps, n, False, op)
-                return amps
-            for f in appliers:
-                amps = f(amps)
-            return amps
+                return _loop(lambda a: _apply_banded_items(a, n, items),
+                             amps, iters)
+
+            def body(a):
+                for f in appliers:
+                    a = f(a)
+                return a
+            return _loop(body, amps, iters)
 
         fn = jax.jit(run, donate_argnums=(0,) if donate else ())
         self._compiled[key] = fn

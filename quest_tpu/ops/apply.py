@@ -189,12 +189,12 @@ def apply_band(
     sum_b G[a,b] x[p,b,q]). This is how every single-qubit gate reaches
     the matrix unit; see quest_tpu/ops/fusion.py for the planner."""
     gre, gim, concrete = _as_pair(op_pair, amps.dtype)
+    real_only = concrete and np.all(gim == 0.0)
     band = 1 << w
     post = 1 << ql
     pre = (1 << n) >> (ql + w)
     re = amps[0].reshape(pre, band, post)
     im = amps[1].reshape(pre, band, post)
-    real_only = concrete and np.all(gim == 0.0)
     gre = jnp.asarray(gre).reshape(band, band)
     gim = jnp.asarray(gim).reshape(band, band)
     hi = lax.Precision.HIGHEST
@@ -206,8 +206,12 @@ def apply_band(
         nre = contract(gre, re)
         nim = contract(gre, im)
     else:
-        nre = contract(gre, re) - contract(gim, im)
-        nim = contract(gre, im) + contract(gim, re)
+        # Gauss 3-multiplication complex matmul (25% fewer MXU passes)
+        t1 = contract(gre, re)
+        t2 = contract(gim, im)
+        t3 = contract(gre + gim, re + im)
+        nre = t1 - t2
+        nim = t3 - t1 - t2
 
     if preds:
         mask = None

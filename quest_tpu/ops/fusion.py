@@ -150,10 +150,27 @@ def band_range(n: int, b: int) -> Tuple[int, int]:
     return ql, min(BAND_W, n - ql)
 
 
-def plan(ops: Sequence, n: int) -> List:
+def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List:
     """Fuse a GateOp sequence into [BandOp | DiagItem | PassOp], preserving
     semantics. Gate operands must be concrete (numpy) to compose; ops with
-    traced operands become PassOps."""
+    traced operands become PassOps.
+
+    `bands` optionally overrides the default 7-wide band layout with a
+    list of (ql, w) ranges covering [0, n) — the Pallas engine uses this
+    to align the tile band with its block top (pallas_band.plan_bands)."""
+    if bands is None:
+        band_of = _band_of
+        band_rng = lambda b: band_range(n, b)  # noqa: E731
+    else:
+        starts = [ql for ql, _ in bands]
+
+        def band_of(q):
+            import bisect
+            return bisect.bisect_right(starts, q) - 1
+
+        def band_rng(b):
+            return bands[b]
+
     items: List = []
 
     def try_merge(band: int, emb: np.ndarray, preds, nondiag, touched):
@@ -162,7 +179,7 @@ def plan(ops: Sequence, n: int) -> List:
         new_all = frozenset(touched) | {q for q, _ in preds}
         for i in range(len(items) - 1, -1, -1):
             g = items[i]
-            if (isinstance(g, BandOp) and _band_of(g.ql) == band
+            if (isinstance(g, BandOp) and band_of(g.ql) == band
                     and g.preds == preds):
                 comp = emb @ (g.gre.astype(np.complex128) + 1j * g.gim)
                 items[i] = BandOp(g.ql, g.w, comp.real, comp.imag, preds,
@@ -179,6 +196,34 @@ def plan(ops: Sequence, n: int) -> List:
         cstates = tuple(op.cstates) if op.cstates else (1,) * len(controls)
 
         if op.kind in ("parity", "allones"):
+            # single-band phase ops fold into the band operator as diagonal
+            # embeddings (an rz or a neighbour CZ costs nothing once the
+            # band matmul runs anyway); cross-band ones stay elementwise
+            opbands = {band_of(q) for q in targets + controls}
+            if len(opbands) == 1 and isinstance(op.operand,
+                                                (int, float, complex)):
+                b = opbands.pop()
+                ql, w = band_rng(b)
+                if op.kind == "parity":
+                    half = float(op.operand) / 2.0
+                    diag = np.ones(1 << len(targets), dtype=np.complex128)
+                    for i in range(diag.size):
+                        parity = bin(i).count("1") & 1
+                        diag[i] = np.exp(-1j * half * (-1.0) ** parity)
+                    mat = np.diag(diag)
+                    emb = embed_operator(mat, [t - ql for t in targets],
+                                         [], [], w)
+                else:  # allones: phase `term` where all listed qubits are 1
+                    mat = np.diag([1.0, complex(op.operand)])
+                    emb = embed_operator(
+                        mat, [targets[0] - ql],
+                        [q - ql for q in targets[1:] + controls],
+                        [1] * (len(targets) - 1 + len(controls)), w)
+                touched = frozenset(targets) | frozenset(controls)
+                # fold ONLY into an existing band matmul (then it is free);
+                # a phase op alone is cheaper elementwise than as a matmul
+                if try_merge(b, emb, (), frozenset(), touched):
+                    continue
             items.append(DiagItem(op, frozenset(targets) | frozenset(controls)))
             continue
 
@@ -191,20 +236,20 @@ def plan(ops: Sequence, n: int) -> List:
                                 frozenset(targets) | frozenset(controls)))
             continue
 
-        bands = {_band_of(t) for t in targets}
-        if len(bands) != 1:
+        tbands = {band_of(t) for t in targets}
+        if len(tbands) != 1:
             # cross-band multi-target unitary (superop targets, swaps across
             # bands, ...) — general apply path
             items.append(PassOp(op, frozenset(targets),
                                 frozenset(targets) | frozenset(controls)))
             continue
 
-        b = bands.pop()
-        ql, w = band_range(n, b)
-        in_c = [c for c in controls if _band_of(c) == b]
-        in_s = [s for c, s in zip(controls, cstates) if _band_of(c) == b]
+        b = tbands.pop()
+        ql, w = band_rng(b)
+        in_c = [c for c in controls if band_of(c) == b]
+        in_s = [s for c, s in zip(controls, cstates) if band_of(c) == b]
         preds = tuple(sorted((c, s) for c, s in zip(controls, cstates)
-                             if _band_of(c) != b))
+                             if band_of(c) != b))
         mat = (_diag_to_matrix(operand, "diagonal")
                if op.kind == "diagonal" else np.asarray(operand))
         emb = embed_operator(mat, [t - ql for t in targets],
@@ -212,7 +257,13 @@ def plan(ops: Sequence, n: int) -> List:
         nondiag = (frozenset() if op.kind == "diagonal"
                    else frozenset(targets))
         touched = frozenset(targets) | frozenset(controls)
-        if not try_merge(b, emb, preds, nondiag, touched):
-            items.append(BandOp(ql, w, emb.real, emb.imag, preds, nondiag,
-                                touched))
+        if try_merge(b, emb, preds, nondiag, touched):
+            continue
+        if op.kind == "diagonal":
+            # same policy as parity/allones: a diagonal alone is cheaper
+            # elementwise than as a band matmul
+            items.append(DiagItem(op, touched))
+            continue
+        items.append(BandOp(ql, w, emb.real, emb.imag, preds, nondiag,
+                            touched))
     return items

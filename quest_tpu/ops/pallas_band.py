@@ -1,0 +1,408 @@
+"""Pallas TPU mega-kernel over band-fusion plans: many bands per HBM pass.
+
+The XLA band engine (quest_tpu/ops/fusion.py + apply_band) costs one full
+memory pass per band contraction — and for bands whose bits are not the
+minor axis, XLA inserts full-state transposes around the matmul (measured:
+bands 1/2 access 1.6-2x the state bytes; see scripts/probe_band_hlo.py).
+This kernel runs a whole SEGMENT of band operators in one pass: each grid
+step holds a (2, rows, 128) block of the split re/im planes in VMEM and
+applies every stage there, where relayout costs VPU/XLU shuffles instead
+of HBM traffic. It is the TPU-native analogue of the reference's
+single-pass OpenMP/CUDA per-gate kernels (QuEST_cpu.c:1656-3620,
+QuEST_gpu.cu) — except one pass covers MANY gates.
+
+In-block geometry (block_row_bits = log2 rows, lanes = 128):
+  band 0   qubits 0..6          lane axis: X @ G^T on the MXU
+  band 1   qubits 7..13         sublane axis: cheap (T,s,l)->(s,T,l)
+                                relayout, one (128, T*128) MXU dot, undo
+  band 2   qubits 14..7+brb-1   tile axis: (D,D) @ (D, rows*128/D) dot
+  diagonals / parity / controls on ANY qubit (including grid bits beyond
+  the block): elementwise factors from lane iota x global row id
+  (pid * rows + iota) — they never break a segment.
+
+Band operators ride along as (2, D, D) kernel INPUTS, not baked
+constants, so segments with identical structure but different angles
+compile to the same kernel (layer reuse across RCS depth).
+
+Gates that MIX grid bits (non-diagonal targets above the block top) are
+not expressible in one contiguous-block pass; the circuit layer splits
+the plan into segments at those ops and applies them through the XLA
+band path (quest_tpu/circuit.py compiled_fused).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from quest_tpu.ops import fusion as F
+
+LANE_QUBITS = 7
+LANES = 1 << LANE_QUBITS
+DEFAULT_BLOCK_ROW_BITS = 11   # 2048-row blocks: 1 MiB per plane per block
+VMEM_LIMIT_BYTES = 100 * (1 << 20)  # v5e has 128 MiB VMEM; the default
+# 16 MiB scoped limit rejects multi-stage kernels (measured round 1/2)
+
+
+def plan_bands(n: int, block_row_bits: int) -> List[Tuple[int, int]]:
+    """Band layout matching the kernel's reach: 7-qubit lane and sublane
+    bands, a tile band up to the block top, then 7-wide grid bands (those
+    compose too — they just run through the XLA path)."""
+    inner_top = LANE_QUBITS + block_row_bits
+    bands = []
+    ql = 0
+    while ql < n:
+        if ql < inner_top:
+            w = min(LANE_QUBITS, n - ql, inner_top - ql)
+        else:
+            w = min(LANE_QUBITS, n - ql)
+        bands.append((ql, w))
+        ql += w
+    return bands
+
+
+# ---------------------------------------------------------------------------
+# stage descriptors (structure only — matrices are kernel inputs)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MatStage:
+    kind: str                  # 'b0' | 'b1' | 'b2'
+    dim: int                   # operator dimension D
+    real_only: bool
+    lane_preds: Tuple[Tuple[int, int], ...]   # (lane bit, want)
+    row_preds: Tuple[Tuple[int, int], ...]    # (GLOBAL row bit, want)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseStage:
+    """allones phase: multiply amplitudes whose listed bits are all `want`
+    by (tre + i*tim)."""
+    lane_bits: Tuple[Tuple[int, int], ...]
+    row_bits: Tuple[Tuple[int, int], ...]     # GLOBAL row bits
+    tre: float
+    tim: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ParityStage:
+    lane_targets: Tuple[int, ...]
+    row_targets: Tuple[int, ...]              # GLOBAL row bits
+    angle: float
+
+
+@dataclasses.dataclass(frozen=True)
+class DiagVecStage:
+    """General k-qubit diagonal: multiply each amplitude by the entry
+    selected by its target-bit pattern (identity where controls unmet).
+    Entry index bit j corresponds to targets[j]."""
+    targets: Tuple[int, ...]                  # GLOBAL qubits
+    dre: Tuple[float, ...]                    # 2^k entries
+    dim_: Tuple[float, ...]
+    lane_preds: Tuple[Tuple[int, int], ...]
+    row_preds: Tuple[Tuple[int, int], ...]
+
+
+# ---------------------------------------------------------------------------
+# segmentation of a fusion plan
+# ---------------------------------------------------------------------------
+
+
+def _split_preds(preds, n):
+    lane_p, row_p = [], []
+    for q, s in preds:
+        if q < LANE_QUBITS:
+            lane_p.append((q, s))
+        else:
+            row_p.append((q - LANE_QUBITS, s))
+    return tuple(lane_p), tuple(row_p)
+
+
+def segment_plan(items: Sequence, n: int, block_row_bits: int):
+    """Split fusion-plan items into kernel segments and XLA passthroughs.
+    Returns a list of ("segment", [stages], [op_arrays]) and
+    ("xla", item) entries, in program order."""
+    inner_top = LANE_QUBITS + block_row_bits
+    parts: List = []
+    stages: List = []
+    arrays: List = []
+
+    def flush():
+        nonlocal stages, arrays
+        if stages:
+            parts.append(("segment", stages, arrays))
+            stages, arrays = [], []
+
+    for it in items:
+        if isinstance(it, F.BandOp):
+            if it.ql + it.w <= inner_top:
+                real_only = bool(np.all(it.gim == 0.0))
+                lane_p, row_p = _split_preds(it.preds, n)
+                if it.ql == 0:
+                    kind = "b0"
+                    g = it.gre.T + 1j * it.gim.T       # X @ G^T form
+                elif it.ql == LANE_QUBITS:
+                    kind = "b1"
+                    g = it.gre + 1j * it.gim
+                else:
+                    kind = "b2"
+                    g = it.gre + 1j * it.gim
+                d = 1 << it.w
+                stages.append(MatStage(kind, d, real_only, lane_p, row_p))
+                arr = np.stack([g.real, g.imag]).astype(np.float32)
+                arrays.append(jnp.asarray(arr))
+                continue
+            flush()
+            parts.append(("xla", it))
+            continue
+        if isinstance(it, F.DiagItem):
+            op = it.op
+            targets = tuple(op.targets)
+            if op.kind == "parity":
+                stages.append(ParityStage(
+                    tuple(q for q in targets if q < LANE_QUBITS),
+                    tuple(q - LANE_QUBITS for q in targets
+                          if q >= LANE_QUBITS),
+                    float(op.operand)))
+                continue
+            if op.kind == "diagonal":
+                d = np.asarray(op.operand, dtype=np.complex128).reshape(-1)
+                lane_p, row_p = _split_preds(
+                    tuple(zip(op.controls, op.cstates or
+                              (1,) * len(op.controls))), n)
+                stages.append(DiagVecStage(
+                    targets, tuple(d.real), tuple(d.imag), lane_p, row_p))
+                continue
+            if op.kind == "allones" and isinstance(
+                    op.operand, (int, float, complex)):
+                bits = targets + tuple(op.controls)
+                want = (1,) * len(targets) + (tuple(op.cstates) or
+                                              (1,) * len(op.controls))
+                lane_b = tuple((q, s) for q, s in zip(bits, want)
+                               if q < LANE_QUBITS)
+                row_b = tuple((q - LANE_QUBITS, s) for q, s in
+                              zip(bits, want) if q >= LANE_QUBITS)
+                t = complex(op.operand)
+                stages.append(PhaseStage(lane_b, row_b, t.real, t.imag))
+                continue
+            flush()
+            parts.append(("xla", it))
+            continue
+        flush()
+        parts.append(("xla", it))
+    flush()
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+
+def _lane_iota():
+    return jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+
+def _row_iota(rows, pid):
+    base = pid * rows
+    return base + jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+
+
+def _mask_of(rows, pid, lane_preds, row_preds):
+    mask = None
+    if lane_preds:
+        ids = _lane_iota()
+        for bit, want in lane_preds:
+            m = ((ids >> bit) & 1) == want
+            mask = m if mask is None else (mask & m)
+    if row_preds:
+        ids = _row_iota(rows, pid)
+        for bit, want in row_preds:
+            m = ((ids >> bit) & 1) == want
+            mask = m if mask is None else (mask & m)
+    return mask
+
+
+def _cdot(contract, re, im, gre, gim, real_only):
+    """Complex 'contract' of state planes with operator planes, via the
+    Gauss 3-multiplication identity (3 MXU passes instead of 4):
+      t1 = Gre x_re, t2 = Gim x_im, t3 = (Gre+Gim)(x_re+x_im)
+      out_re = t1 - t2, out_im = t3 - t1 - t2."""
+    if real_only:
+        return contract(gre, re), contract(gre, im)
+    t1 = contract(gre, re)
+    t2 = contract(gim, im)
+    t3 = contract(gre + gim, re + im)
+    return t1 - t2, t3 - t1 - t2
+
+
+def _apply_mat_stage(re, im, st: MatStage, gref, rows, pid):
+    g = gref[...]
+    gre, gim = g[0], g[1]
+    f32 = jnp.float32
+
+    hi = jax.lax.Precision.HIGHEST  # TPU dots default to bf16 passes;
+    # f32 amplitudes need full-precision passes (norm drifts ~1e-3 else)
+
+    if st.kind == "b0":
+        def contract(gg, x):     # x (rows, LANES) @ G^T (LANES, LANES)
+            return jnp.dot(x, gg, preferred_element_type=f32, precision=hi)
+        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+    elif st.kind == "b1":
+        d = st.dim               # sublane band: row bits [0, log2 d)
+        a = rows // d
+
+        def contract(gg, x):
+            xt = x.reshape(a, d, LANES).transpose(1, 0, 2)
+            xt = xt.reshape(d, a * LANES)
+            out = jax.lax.dot_general(
+                gg, xt, (((1,), (0,)), ((), ())),
+                preferred_element_type=f32, precision=hi)
+            return out.reshape(d, a, LANES).transpose(1, 0, 2) \
+                      .reshape(rows, LANES)
+        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+    else:  # b2: tile-axis contraction
+        d = st.dim
+
+        def contract(gg, x):
+            x2 = x.reshape(d, (rows // d) * LANES)
+            out = jax.lax.dot_general(
+                gg, x2, (((1,), (0,)), ((), ())),
+                preferred_element_type=f32, precision=hi)
+            return out.reshape(rows, LANES)
+        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+
+    mask = _mask_of(rows, pid, st.lane_preds, st.row_preds)
+    if mask is not None:
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return nre, nim
+
+
+def _apply_phase_stage(re, im, st: PhaseStage, rows, pid):
+    mask = _mask_of(rows, pid, st.lane_bits, st.row_bits)
+    tre, tim = np.float32(st.tre), np.float32(st.tim)
+    nre = re * tre - im * tim
+    nim = re * tim + im * tre
+    if mask is None:            # global phase
+        return nre, nim
+    return jnp.where(mask, nre, re), jnp.where(mask, nim, im)
+
+
+def _apply_parity_stage(re, im, st: ParityStage, rows, pid):
+    sign = None
+    if st.lane_targets:
+        ids = _lane_iota()
+        s = jnp.ones((1, LANES), dtype=jnp.float32)
+        for q in st.lane_targets:
+            s = s * (1.0 - 2.0 * ((ids >> q) & 1).astype(jnp.float32))
+        sign = s
+    if st.row_targets:
+        ids = _row_iota(rows, pid)
+        s = jnp.ones((rows, 1), dtype=jnp.float32)
+        for j in st.row_targets:
+            s = s * (1.0 - 2.0 * ((ids >> j) & 1).astype(jnp.float32))
+        sign = s if sign is None else sign * s
+    half = st.angle / 2.0
+    cosf = np.float32(np.cos(half))
+    sinf = np.float32(np.sin(half)) * sign
+    nre = re * cosf + im * sinf
+    nim = im * cosf - re * sinf
+    return nre, nim
+
+
+def _bit_of(q, rows, pid):
+    """(broadcastable) value of bit `q` of each amplitude's global index."""
+    if q < LANE_QUBITS:
+        return (_lane_iota() >> q) & 1
+    return (_row_iota(rows, pid) >> (q - LANE_QUBITS)) & 1
+
+
+def _apply_diagvec_stage(re, im, st: DiagVecStage, rows, pid):
+    k = len(st.targets)
+    fre = jnp.full((1, 1), np.float32(st.dre[0]))
+    fim = jnp.full((1, 1), np.float32(st.dim_[0]))
+    for b in range(1, 1 << k):
+        sel = None
+        for j, q in enumerate(st.targets):
+            m = _bit_of(q, rows, pid) == ((b >> j) & 1)
+            sel = m if sel is None else (sel & m)
+        fre = jnp.where(sel, np.float32(st.dre[b]), fre)
+        fim = jnp.where(sel, np.float32(st.dim_[b]), fim)
+    nre = re * fre - im * fim
+    nim = re * fim + im * fre
+    mask = _mask_of(rows, pid, st.lane_preds, st.row_preds)
+    if mask is not None:
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return nre, nim
+
+
+def _segment_kernel(in_ref, *rest, stages, rows):
+    num_mats = sum(isinstance(s, MatStage) for s in stages)
+    mat_refs = rest[:num_mats]
+    out_ref = rest[num_mats]
+    pid = pl.program_id(0)
+    blk = in_ref[...]
+    re, im = blk[0], blk[1]
+    mi = 0
+    for st in stages:
+        if isinstance(st, MatStage):
+            re, im = _apply_mat_stage(re, im, st, mat_refs[mi], rows, pid)
+            mi += 1
+        elif isinstance(st, PhaseStage):
+            re, im = _apply_phase_stage(re, im, st, rows, pid)
+        elif isinstance(st, DiagVecStage):
+            re, im = _apply_diagvec_stage(re, im, st, rows, pid)
+        else:
+            re, im = _apply_parity_stage(re, im, st, rows, pid)
+    out_ref[0] = re
+    out_ref[1] = im
+
+
+def compile_segment(stages: Sequence, n: int,
+                    block_row_bits: int = DEFAULT_BLOCK_ROW_BITS,
+                    interpret: bool = False):
+    """Build fn(amps, mat_arrays) -> amps applying `stages` in one kernel
+    launch (grid over contiguous row blocks)."""
+    total_rows = 1 << (n - LANE_QUBITS)
+    rows = min(1 << block_row_bits, total_rows)
+    grid = (total_rows // rows,)
+
+    mat_stages = [s for s in stages if isinstance(s, MatStage)]
+    kernel = functools.partial(_segment_kernel, stages=tuple(stages),
+                               rows=rows)
+    in_specs = [pl.BlockSpec((2, rows, LANES), lambda i: (0, i, 0))]
+    for st in mat_stages:
+        in_specs.append(pl.BlockSpec((2, st.dim, st.dim),
+                                     lambda i: (0, 0, 0)))
+    fn = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((2, rows, LANES), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, total_rows, LANES), jnp.float32),
+        input_output_aliases={0: 0},  # in-place on the state buffer
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
+        interpret=interpret,
+    )
+
+    def apply(amps, mat_arrays):
+        out = fn(amps.reshape(2, total_rows, LANES), *mat_arrays)
+        return out.reshape(2, -1)
+
+    return apply
+
+
+def usable(n: int) -> bool:
+    """Need at least one (8, 128) f32 tile per block."""
+    return n >= LANE_QUBITS + 3

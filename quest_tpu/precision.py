@@ -43,6 +43,17 @@ def get_default_dtype():
     return _default_dtype
 
 
+def enable_compile_cache(path: str = "/tmp/jax_cache_quest_tpu",
+                         min_compile_secs: float = 1.0) -> None:
+    """Turn on JAX's persistent compile cache (one shared location for the
+    test suite, bench, probes and the driver entry points — circuit
+    programs are compile-dominated on first run)."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+
+
 def real_eps(dtype) -> float:
     """Numerical tolerance for the given amplitude dtype."""
     return _REAL_EPS[np.dtype(dtype)]

@@ -1,0 +1,57 @@
+"""Per-band microbenchmark + HLO inspection on the real chip: times one
+band contraction per band index and counts transpose/copy fusions in the
+optimized HLO (the suspected bandwidth thief)."""
+import re
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from quest_tpu.ops import apply as A
+from quest_tpu.ops import fusion as F
+
+
+def one_band(n, b, reps=10):
+    ql, w = F.band_range(n, b)
+    rng = np.random.default_rng(b)
+    m = rng.standard_normal((1 << w, 1 << w))
+    q_, _ = np.linalg.qr(m)          # real orthogonal -> real_only path
+    gre, gim = q_.astype(np.float32), np.zeros_like(q_, dtype=np.float32)
+
+    def run(amps):
+        return A.apply_band(amps, n, (gre, gim), ql, w)
+
+    jit = jax.jit(run, donate_argnums=(0,))
+    lowered = jit.lower(jax.ShapeDtypeStruct((2, 1 << n), jnp.float32))
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    n_tr = len(re.findall(r"transpose", txt))
+    n_copy = len(re.findall(r"\bcopy", txt))
+    fusions = len(re.findall(r"kLoop|kInput|kOutput", txt))
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    bytes_acc = ca.get("bytes accessed", float("nan")) if ca else float("nan")
+
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    out = jit(amps)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jit(out)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    state_bytes = 2 * (1 << n) * 4
+    print(f"band {b} (ql={ql},w={w}): {dt*1e3:7.2f} ms/pass  "
+          f"{2*state_bytes/dt/1e9:6.1f} GB/s r+w  "
+          f"hlo: transpose={n_tr} copy={n_copy} fusions={fusions} "
+          f"bytes_accessed={bytes_acc:.3g}", flush=True)
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    print("devices:", jax.devices(), flush=True)
+    for b in range((n + 6) // 7):
+        one_band(n, b)

@@ -1,0 +1,66 @@
+"""Stage-level Pallas kernel timing on the real chip: which stage type is
+slow? Compiles tiny segments (b0 / b1 / b2 / parity / combinations) and
+times each at the given size."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from quest_tpu.precision import enable_compile_cache
+enable_compile_cache()
+
+from quest_tpu.ops import pallas_band as PB
+
+
+def seg(stages, arrays, n, brb, reps=20):
+    fn = PB.compile_segment(stages, n, brb)
+    jfn = jax.jit(lambda a: fn(a, arrays), donate_argnums=(0,))
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    amps = jfn(amps)
+    _ = np.asarray(amps[0, :4])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        amps = jfn(amps)
+    _ = np.asarray(amps[0, :4])
+    dt = (time.perf_counter() - t0) / reps
+    bw = 2 * 2 * (1 << n) * 4 / dt
+    return dt * 1e3, bw / 1e9
+
+
+def g_input(d, real=False):
+    rng = np.random.default_rng(d)
+    q, _ = np.linalg.qr(rng.standard_normal((d, d)))
+    gim = np.zeros_like(q) if real else q * 0.1
+    return jnp.asarray(np.stack([q, gim]).astype(np.float32))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
+    brb = 11
+    print("devices:", jax.devices(), flush=True)
+    d2 = 1 << (brb - 7)
+    cases = {
+        "b0 (complex)": ([PB.MatStage("b0", 128, False, (), ())],
+                          [g_input(128)]),
+        "b0 (real)": ([PB.MatStage("b0", 128, True, (), ())],
+                       [g_input(128, real=True)]),
+        "b1": ([PB.MatStage("b1", 128, False, (), ())], [g_input(128)]),
+        "b2": ([PB.MatStage("b2", d2, False, (), ())], [g_input(d2)]),
+        "parity": ([PB.ParityStage((1, 3), (2, 12), 0.3)], []),
+        "b0+b1+b2": ([PB.MatStage("b0", 128, False, (), ()),
+                      PB.MatStage("b1", 128, False, (), ()),
+                      PB.MatStage("b2", d2, False, (), ())],
+                     [g_input(128), g_input(128), g_input(d2)]),
+        "b0 x3": ([PB.MatStage("b0", 128, False, (), ())] * 3,
+                  [g_input(128)] * 3),
+    }
+    for name, (stages, arrays) in cases.items():
+        ms, bw = seg(stages, arrays, n, brb)
+        print(f"{name:14s}: {ms:7.2f} ms/pass   {bw:6.1f} GB/s r+w", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,8 +24,8 @@ jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 jax.config.update("jax_enable_x64", True)
 # persistent compile cache: the suite is compile-dominated (hundreds of
 # distinct (gate, targets, n) programs); repeated runs hit the disk cache
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_quest_tpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from quest_tpu.precision import enable_compile_cache
+enable_compile_cache(min_compile_secs=0.5)
 
 
 NUM_QUBITS = 5  # matches the reference's test scale (tests/utilities.hpp:36)

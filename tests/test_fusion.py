@@ -82,12 +82,31 @@ def test_non_commuting_blocks_merge():
     assert len(bandops) == 3
 
 
-def test_diagonals_stay_elementwise():
+def test_cross_band_diagonals_stay_elementwise():
     n = 9
     c = Circuit(n)
-    c.rz(8, 0.7)
-    c.cz(0, 8)
-    c.multi_rotate_z((0, 4, 8), 0.2)
+    c.cz(0, 8)                       # cross-band phase
+    c.multi_rotate_z((0, 4, 8), 0.2)  # cross-band parity
+    items = F.plan(c.ops, n)
+    assert all(isinstance(it, F.DiagItem) for it in items)
+
+
+def test_single_band_phases_fold_into_existing_bandop():
+    n = 9
+    c = Circuit(n)
+    c.h(5)                           # creates the band-0 op
+    c.rz(3, 0.7)                     # 1q parity, band 0 -> folds
+    c.cz(1, 2)                       # in-band all-ones phase -> folds
+    c.multi_rotate_z((0, 4), 0.2)    # in-band parity -> folds
+    items = F.plan(c.ops, n)
+    assert len(items) == 1 and isinstance(items[0], F.BandOp)
+
+
+def test_phase_without_bandop_stays_elementwise():
+    n = 9
+    c = Circuit(n)
+    c.rz(3, 0.7)
+    c.cz(1, 2)
     items = F.plan(c.ops, n)
     assert all(isinstance(it, F.DiagItem) for it in items)
 

@@ -1,18 +1,26 @@
-"""Pallas fused-segment engine tests (quest_tpu/ops/pallas_engine.py),
-run in the Pallas interpreter on CPU: fused execution must match the XLA
-per-gate path exactly across every stage type — lane-matmul fusion, row
-butterflies, row diagonals, parity phases, controls in every position,
-segment breaks, and density duals."""
+"""Pallas band-segment engine tests (quest_tpu/ops/pallas_band.py), run
+in the Pallas interpreter on CPU: fused execution must match the XLA
+per-gate path across every stage type — band-0/1/2 matmuls, diagonal and
+parity phases, controls in every position, segment breaks, multi-block
+grids, and density duals."""
 
 import numpy as np
 import pytest
 
 import quest_tpu as qt
 from quest_tpu.circuit import Circuit, random_circuit, qft_circuit
-from quest_tpu.ops import pallas_engine as PE
+from quest_tpu.ops import fusion as F
+from quest_tpu.ops import pallas_band as PB
 from quest_tpu.state import to_dense
 
 N = 10  # 8 rows x 128 lanes — the smallest cleanly-tiled register
+
+
+def parts_of(c: Circuit, n=N, brb=None):
+    if brb is None:
+        brb = min(PB.DEFAULT_BLOCK_ROW_BITS, n - PB.LANE_QUBITS)
+    items = F.plan(c.ops, n, bands=PB.plan_bands(n, brb))
+    return PB.segment_plan(items, n, brb)
 
 
 def check(circ: Circuit, n=N, density=False, tol=1e-5):
@@ -25,28 +33,29 @@ def check(circ: Circuit, n=N, density=False, tol=1e-5):
     np.testing.assert_allclose(got, want, atol=tol * scale, rtol=0)
 
 
-def test_lane_gates_fuse():
+def test_band0_gates_fuse_to_one_stage():
     c = Circuit(N)
-    for q in range(PE.LANE_QUBITS):
+    for q in range(PB.LANE_QUBITS):
         c.h(q)
     c.cnot(0, 1)
     c.z(2)
     c.s(3)
     c.t(4)
-    plan = PE.plan_ops(c.ops, N, PE.qmax_for(N))
-    # everything merges into ONE lane segment with ONE stage
-    assert len(plan.items) == 1
-    kind, stages = plan.items[0]
+    parts = parts_of(c)
+    assert len(parts) == 1
+    kind, stages, arrays = parts[0]
     assert kind == "segment" and len(stages) == 1
-    assert isinstance(stages[0], PE.LaneStage)
+    assert stages[0].kind == "b0" and len(arrays) == 1
     check(c)
 
 
 @pytest.mark.parametrize("q", range(7, N))
-def test_row_butterfly(q):
+def test_row_qubit_gates(q):
     c = Circuit(N)
     c.h(q)
     c.ry(q, 0.37)
+    parts = parts_of(c)
+    assert [p[0] for p in parts] == ["segment"]
     check(c)
 
 
@@ -80,24 +89,56 @@ def test_controls_every_position():
     c.x(1, 8)            # lane target, row control
     c.x(9, 2)            # row target, lane control
     c.x(7, 9)            # row target, row control
-    plan = PE.plan_ops(c.ops, N, PE.qmax_for(N))
-    # all four fuse into one segment — none falls through to the XLA path
-    assert [k for k, _ in plan.items] == ["segment"]
+    parts = parts_of(c)
+    # all four fuse — none falls through to the XLA path
+    assert [p[0] for p in parts] == ["segment"]
     check(c)
 
 
-def test_segment_break_on_multi_target_row_gate():
+def test_segment_break_on_cross_band_gate():
     rng = np.random.default_rng(3)
     z = rng.normal(size=(4, 4)) + 1j * rng.normal(size=(4, 4))
     u, _ = np.linalg.qr(z)
     c = Circuit(N)
     c.h(0)
-    c.gate(u, (3, 8))     # row target in a 2q gate -> passthrough
+    c.gate(u, (3, 8))     # cross-band 2q unitary -> XLA passthrough
     c.h(9)
-    plan = PE.plan_ops(c.ops, N, PE.qmax_for(N))
-    kinds = [k for k, _ in plan.items]
-    assert "op" in kinds  # the 2q row gate broke the segment
+    parts = parts_of(c)
+    kinds = [p[0] for p in parts]
+    assert "xla" in kinds
     check(c)
+
+
+def test_band_above_block_top_goes_xla():
+    n = 12
+    brb = 2               # block top = qubit 9
+    c = Circuit(n)
+    c.h(0)
+    c.h(10)               # band above the block top
+    parts = parts_of(c, n=n, brb=brb)
+    kinds = [p[0] for p in parts]
+    assert kinds.count("xla") == 1 and kinds.count("segment") == 1
+    # numerics via a custom-brb compile
+    items = F.plan(c.ops, n, bands=PB.plan_bands(n, brb))
+    parts = PB.segment_plan(items, n, brb)
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as A
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    for part in parts:
+        if part[0] == "segment":
+            amps = PB.compile_segment(part[1], n, brb, interpret=True)(
+                amps, part[2])
+        else:
+            it = part[1]
+            amps = A.apply_band(amps, n, (it.gre, it.gim), it.ql, it.w,
+                                it.preds)
+    c2 = Circuit(n)
+    c2.h(0)
+    c2.h(10)
+    want = c2.compiled(n, density=False, donate=False)(
+        jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0))
+    np.testing.assert_allclose(np.asarray(amps), np.asarray(want),
+                               atol=1e-5, rtol=0)
 
 
 def test_random_circuit_fused_matches():
@@ -119,41 +160,31 @@ def test_density_fused_matches():
     check(c, n=10, density=True, tol=5e-5)
 
 
-def test_multi_block_grid(monkeypatch):
-    """Shrink the row-block cap so the kernel grid has MANY blocks: the
-    pid-dependent paths (global row ids for masks/diagonals/parity, the
-    BlockSpec index map) must agree with the single-block engine."""
-    monkeypatch.setattr(PE, "MAX_ROWS_PER_BLOCK", 8)
-    n = 12  # 32 rows -> grid of 4 blocks of 8 rows
+def test_multi_block_grid():
+    """Small block size -> many grid blocks: pid-dependent paths (global
+    row ids for masks/diagonals/parity, BlockSpec index maps) must agree
+    with the XLA engine."""
+    n = 12  # 32 rows; brb=3 -> grid of 4 blocks of 8 rows
+    brb = 3
     c = Circuit(n)
     c.h(0)
-    c.h(8)               # row butterfly within a block
-    c.rz(9, 0.3)         # parity on a row bit spanning blocks? (j=2 < 3)
-    c.s(7)               # row diagonal
-    c.x(1, 9)            # lane target controlled on a row qubit
-    c.cz(2, 8)
-    plan = PE.plan_ops(c.ops, n, PE.qmax_for(n))
-    assert [k for k, _ in plan.items] == ["segment"]
-    q = qt.init_debug_state(qt.create_qureg(n))
-    want = to_dense(c.apply(q))
-    got = to_dense(c.apply_fused(q, interpret=True))
-    scale = max(1.0, float(np.max(np.abs(want))))
-    np.testing.assert_allclose(got, want, atol=1e-5 * scale, rtol=0)
-
-
-def test_multi_block_grid_high_row_bits(monkeypatch):
-    """Gates on row bits ABOVE the block size force rows to grow to cover
-    them; bits below still use pid-dependent global ids across blocks."""
-    monkeypatch.setattr(PE, "MAX_ROWS_PER_BLOCK", 4)
-    n = 12
-    c = Circuit(n)
-    c.ry(11, 0.7)        # j=4: needs rows=32 -> grid of 1 after growth
-    c.ry(8, 0.2)
-    q = qt.init_debug_state(qt.create_qureg(n))
-    want = to_dense(c.apply(q))
-    got = to_dense(c.apply_fused(q, interpret=True))
-    scale = max(1.0, float(np.max(np.abs(want))))
-    np.testing.assert_allclose(got, want, atol=1e-5 * scale, rtol=0)
+    c.h(8)               # sublane butterfly within a block
+    c.rz(11, 0.3)        # parity on a grid row bit
+    c.s(7)
+    c.x(1, 11)           # lane target controlled on a GRID row qubit
+    c.cz(2, 10)          # phase with a grid row bit
+    items = F.plan(c.ops, n, bands=PB.plan_bands(n, brb))
+    parts = PB.segment_plan(items, n, brb)
+    assert [p[0] for p in parts] == ["segment"]
+    import jax.numpy as jnp
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    for part in parts:
+        amps = PB.compile_segment(part[1], n, brb, interpret=True)(
+            amps, part[2])
+    want = c.compiled(n, density=False, donate=False)(
+        jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0))
+    np.testing.assert_allclose(np.asarray(amps), np.asarray(want),
+                               atol=1e-5, rtol=0)
 
 
 def test_small_register_falls_back():
@@ -212,6 +243,8 @@ def test_channels_need_density_register_all_engines():
     c.damping(0, 0.1)
     with pytest.raises(QuESTError, match="density"):
         c.apply_fused(qt.create_qureg(12), interpret=True)
+    with pytest.raises(QuESTError, match="density"):
+        c.apply_banded(qt.create_qureg(12))
     mesh = make_amp_mesh(1)
     with pytest.raises(QuESTError, match="density"):
         c.compiled_sharded(12, density=False, mesh=mesh)
