@@ -100,8 +100,7 @@ def _fidelity_density(rho_amps, psi_amps, *, dim):
 def calc_fidelity(q: Qureg, pure: Qureg) -> float:
     """|<psi|phi>|^2 for statevectors; <psi|rho|psi> for a density q
     (ref QuEST_common.c:376-381, densmatr_calcFidelity)."""
-    val.validate_state_vector(pure)
-    val.validate_match(q, pure)
+    val.validate_pure_state_args(q, pure)
     if q.is_density:
         return float(_fidelity_density(q.amps, pure.amps.astype(q.real_dtype),
                                        dim=1 << q.num_qubits))
@@ -141,17 +140,51 @@ def calc_expec_pauli_prod(q: Qureg, targets: Sequence[int],
     return float(_inner(work.amps, q.amps)[0])
 
 
+def _pauli_prod_amps(amps, n, term):
+    """Apply a Pauli string (one code per row-space qubit) to raw planes
+    inside an existing trace. X/Y are concrete flip-form permutations, Z a
+    sign — XLA fuses the whole string into one pass."""
+    from quest_tpu import cplx
+    from quest_tpu.ops import apply as A
+    from quest_tpu.ops import matrices as M
+    for t, p in enumerate(term):
+        if p:
+            amps = A.apply_matrix(amps, n, cplx.pack(M.PAULIS[p]), (t,))
+    return amps
+
+
+@partial(jax.jit, static_argnames=("codes", "n", "density"))
+def _expec_pauli_sum(amps, coeffs, *, codes, n, density):
+    """sum_t c_t <P_t> as ONE program: every term's Pauli string, overlap
+    and the weighted sum compile into a single dispatch (the reference
+    loops clone+apply+innerProduct per term, QuEST_common.c:479-491 — one
+    workspace pass per term is kept, but without per-term dispatch)."""
+    total = jnp.zeros((), dtype=amps.dtype)
+    for i, term in enumerate(codes):
+        w = _pauli_prod_amps(amps, n, term)
+        if density:
+            dim = 1 << (n // 2)
+            term_val = jnp.sum(jnp.diagonal(w[0].reshape((dim, dim))))
+        else:
+            term_val = jnp.sum(amps[0] * w[0] + amps[1] * w[1])  # Re<q|w>
+        total = total + coeffs[i] * term_val
+    return total
+
+
 def calc_expec_pauli_sum(q: Qureg, all_codes, coeffs) -> float:
     """sum_t c_t <P_t>; codes is (numTerms, numQubits) of Pauli codes."""
     codes = np.asarray(all_codes, dtype=np.int32).reshape(-1, q.num_qubits)
     coeffs = np.asarray(coeffs, dtype=np.float64).reshape(-1)
     val.validate_num_pauli_sum_terms(len(coeffs))
     val.validate_pauli_codes(codes)
-    targets = list(range(q.num_qubits))
-    total = 0.0
-    for term, c in zip(codes, coeffs):
-        total += c * calc_expec_pauli_prod(q, targets, list(term))
-    return float(total)
+    if len(coeffs) != codes.shape[0]:
+        raise val.QuESTError("Invalid Pauli sum: must give exactly one "
+                             "coefficient per term.")
+    codes_key = tuple(tuple(int(c) for c in term) for term in codes)
+    cf = jnp.asarray(coeffs, dtype=q.real_dtype)
+    return float(_expec_pauli_sum(q.amps, cf, codes=codes_key,
+                                  n=q.num_state_qubits,
+                                  density=q.is_density))
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -173,17 +206,26 @@ def calc_linear_xeb(q: Qureg, samples) -> float:
     return float((1 << q.num_state_qubits) * jnp.mean(p) - 1.0)
 
 
+@partial(jax.jit, static_argnames=("codes", "n"))
+def _apply_pauli_sum(amps, coeffs, *, codes, n):
+    acc = jnp.zeros_like(amps)
+    for i, term in enumerate(codes):
+        acc = acc + coeffs[i] * _pauli_prod_amps(amps, n, term)
+    return acc
+
+
 def apply_pauli_sum(q: Qureg, all_codes, coeffs) -> Qureg:
     """Return sum_t c_t P_t |q> (or P_t rho) as a new register — the
     (generally unnormalized) Pauli-sum image (ref statevec_applyPauliSum,
-    QuEST_common.c:493-514)."""
+    QuEST_common.c:493-514) — all terms in ONE traced program."""
     codes = np.asarray(all_codes, dtype=np.int32).reshape(-1, q.num_qubits)
     coeffs = np.asarray(coeffs, dtype=np.float64).reshape(-1)
     val.validate_num_pauli_sum_terms(len(coeffs))
     val.validate_pauli_codes(codes)
-    targets = list(range(q.num_qubits))
-    acc = jnp.zeros((2, q.num_amps), dtype=q.real_dtype)
-    for term, c in zip(codes, coeffs):
-        fac = jnp.asarray(float(c), dtype=q.real_dtype)  # termCoeffs are real
-        acc = acc + fac * gates.apply_pauli_prod(q, targets, list(term)).amps
-    return q.replace_amps(acc)
+    if len(coeffs) != codes.shape[0]:
+        raise val.QuESTError("Invalid Pauli sum: must give exactly one "
+                             "coefficient per term.")
+    codes_key = tuple(tuple(int(c) for c in term) for term in codes)
+    cf = jnp.asarray(coeffs, dtype=q.real_dtype)  # termCoeffs are real
+    return q.replace_amps(_apply_pauli_sum(q.amps, cf, codes=codes_key,
+                                           n=q.num_state_qubits))

@@ -187,32 +187,32 @@ def _compact_params(alpha, beta):
 
 def compact_unitary(q: Qureg, target: int, alpha, beta) -> Qureg:
     val.validate_target(q, target)
-    val.validate_unitary_complex_pair(alpha, beta)
+    val.validate_unitary_complex_pair(alpha, beta, eps=val.eps_for(q))
     return _run(q, _compact_params(alpha, beta), (target,), builder=_build_compact)
 
 
 def controlled_compact_unitary(q: Qureg, control: int, target: int, alpha, beta) -> Qureg:
     val.validate_control_target(q, control, target)
-    val.validate_unitary_complex_pair(alpha, beta)
+    val.validate_unitary_complex_pair(alpha, beta, eps=val.eps_for(q))
     return _run(q, _compact_params(alpha, beta), (target,), (control,),
                 builder=_build_compact)
 
 
 def unitary(q: Qureg, target: int, matrix) -> Qureg:
     val.validate_target(q, target)
-    val.validate_unitary(matrix, 1)
+    val.validate_unitary(matrix, 1, eps=val.eps_for(q))
     return _run(q, matrix, (target,))
 
 
 def controlled_unitary(q: Qureg, control: int, target: int, matrix) -> Qureg:
     val.validate_control_target(q, control, target)
-    val.validate_unitary(matrix, 1)
+    val.validate_unitary(matrix, 1, eps=val.eps_for(q))
     return _run(q, matrix, (target,), (control,))
 
 
 def multi_controlled_unitary(q: Qureg, controls: Sequence[int], target: int, matrix) -> Qureg:
     val.validate_multi_controls_targets(q, controls, (target,))
-    val.validate_unitary(matrix, 1)
+    val.validate_unitary(matrix, 1, eps=val.eps_for(q))
     return _run(q, matrix, (target,), tuple(controls))
 
 
@@ -221,7 +221,7 @@ def multi_state_controlled_unitary(
         target: int, matrix) -> Qureg:
     val.validate_multi_controls_targets(q, controls, (target,))
     val.validate_control_states(controls, control_states)
-    val.validate_unitary(matrix, 1)
+    val.validate_unitary(matrix, 1, eps=val.eps_for(q))
     return _run(q, matrix, (target,), tuple(controls), tuple(control_states))
 
 
@@ -399,41 +399,41 @@ def sqrt_swap_gate(q: Qureg, qubit1: int, qubit2: int) -> Qureg:
 
 def two_qubit_unitary(q: Qureg, target1: int, target2: int, matrix) -> Qureg:
     val.validate_multi_targets(q, (target1, target2))
-    val.validate_unitary(matrix, 2)
+    val.validate_unitary(matrix, 2, eps=val.eps_for(q))
     return _run(q, matrix, (target1, target2))
 
 
 def controlled_two_qubit_unitary(q: Qureg, control: int, target1: int,
                                  target2: int, matrix) -> Qureg:
     val.validate_multi_controls_targets(q, (control,), (target1, target2))
-    val.validate_unitary(matrix, 2)
+    val.validate_unitary(matrix, 2, eps=val.eps_for(q))
     return _run(q, matrix, (target1, target2), (control,))
 
 
 def multi_controlled_two_qubit_unitary(q: Qureg, controls: Sequence[int],
                                        target1: int, target2: int, matrix) -> Qureg:
     val.validate_multi_controls_targets(q, controls, (target1, target2))
-    val.validate_unitary(matrix, 2)
+    val.validate_unitary(matrix, 2, eps=val.eps_for(q))
     return _run(q, matrix, (target1, target2), tuple(controls))
 
 
 def multi_qubit_unitary(q: Qureg, targets: Sequence[int], matrix) -> Qureg:
     val.validate_multi_targets(q, targets)
-    val.validate_unitary(matrix, len(tuple(targets)))
+    val.validate_unitary(matrix, len(tuple(targets)), eps=val.eps_for(q))
     return _run(q, matrix, tuple(targets))
 
 
 def controlled_multi_qubit_unitary(q: Qureg, control: int,
                                    targets: Sequence[int], matrix) -> Qureg:
     val.validate_multi_controls_targets(q, (control,), targets)
-    val.validate_unitary(matrix, len(tuple(targets)))
+    val.validate_unitary(matrix, len(tuple(targets)), eps=val.eps_for(q))
     return _run(q, matrix, tuple(targets), (control,))
 
 
 def multi_controlled_multi_qubit_unitary(q: Qureg, controls: Sequence[int],
                                          targets: Sequence[int], matrix) -> Qureg:
     val.validate_multi_controls_targets(q, controls, targets)
-    val.validate_unitary(matrix, len(tuple(targets)))
+    val.validate_unitary(matrix, len(tuple(targets)), eps=val.eps_for(q))
     return _run(q, matrix, tuple(targets), tuple(controls))
 
 
@@ -467,8 +467,8 @@ def set_weighted_qureg(fac1, q1: Qureg, fac2, q2: Qureg, fac_out, out: Qureg) ->
     """out = fac1*q1 + fac2*q2 + facOut*out (ref QuEST_cpu.c:3579-3620)."""
     val.validate_match(q1, q2)
     val.validate_match(q1, out)
-    if not (q1.is_density == q2.is_density == out.is_density):
-        raise val.QuESTError("Invalid Qureg pair: types must match.")
+    val.validate_matching_types(q1, q2)
+    val.validate_matching_types(q1, out)
     rdt = out.real_dtype
     f1, f2, fo = complex(fac1), complex(fac2), complex(fac_out)
     facs = jnp.asarray([f1.real, f1.imag, f2.real, f2.imag, fo.real, fo.imag],

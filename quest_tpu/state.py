@@ -198,9 +198,7 @@ def init_state_from_amps(qureg: Qureg, reals, imags) -> Qureg:
 
 def set_amps(qureg: Qureg, start_index: int, reals, imags) -> Qureg:
     """Overwrite a contiguous slice of amplitudes (ref QuEST.c:779-786)."""
-    if qureg.is_density:
-        raise validation.QuESTError(
-            "Invalid operation: setAmps requires a statevector")
+    validation.validate_state_vector(qureg)
     reals = np.asarray(reals).reshape(-1)
     imags = np.asarray(imags).reshape(-1)
     validation.validate_equal_lengths(reals, imags)
@@ -244,9 +242,7 @@ def _fetch_amp(qureg: Qureg, flat: int) -> complex:
 
 def get_amp(qureg: Qureg, index: int) -> complex:
     validation.validate_amp_index(qureg, index)
-    if qureg.is_density:
-        raise validation.QuESTError(
-            "Invalid operation: getAmp requires a statevector")
+    validation.validate_state_vector(qureg)
     return _fetch_amp(qureg, index)
 
 
@@ -278,9 +274,7 @@ def get_num_qubits(qureg: Qureg) -> int:
 
 def get_num_amps(qureg: Qureg) -> int:
     """Statevector amplitude count (ref getNumAmps requires a statevector)."""
-    if qureg.is_density:
-        raise validation.QuESTError(
-            "Invalid operation: getNumAmps requires a statevector")
+    validation.validate_state_vector(qureg)
     return qureg.num_amps
 
 

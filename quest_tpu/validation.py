@@ -1,23 +1,157 @@
-"""Eager, host-side input validation.
+"""Eager, host-side input validation with the reference's error table.
 
-The reference funnels every user error through a 47-code table and an
-overridable `invalidQuESTInputError` hook that defaults to exit(1)
-(QuEST/src/QuEST_validation.c:26-148); its test suite overrides the hook to
-throw. Here the natural design is simply a Python exception, raised eagerly
-before any tracing/compilation happens, so bad inputs never reach XLA.
+The reference funnels every user error through a 47-code enum + message
+table and an overridable `invalidQuESTInputError` hook that defaults to
+exit(1) (QuEST/src/QuEST_validation.c:26-148); its test suite overrides
+the hook to throw and asserts on the exact message strings
+(tests/test_unitaries.cpp:74-88). Here the codes and messages are
+reproduced VERBATIM (ErrorCode / MESSAGES below) so message-matching
+tests port 1:1, and the natural Python design raises an exception
+eagerly, before any tracing/compilation, so bad inputs never reach XLA.
 
-Error message prefixes intentionally mirror the reference's phrasing
-("Invalid target qubit", "Invalid number of control qubits", ...) so that
-message-matching tests carry over conceptually.
+Numeric tolerances follow the reference's REAL_EPS discipline
+(QuEST_precision.h:35,48: 1e-5 single / 1e-13 double): validators take an
+optional `eps`; call sites that know the register's dtype pass
+`eps_for(qureg)` and standalone calls default to the single-precision
+REAL_EPS (the loosest precision the reference ships).
 """
 
 from __future__ import annotations
 
+import enum
+
 import numpy as np
+
+
+class ErrorCode(enum.Enum):
+    """Verbatim reference error codes (QuEST_validation.c:26-79)."""
+    E_SUCCESS = 0
+    E_INVALID_NUM_RANKS = enum.auto()
+    E_INVALID_NUM_CREATE_QUBITS = enum.auto()
+    E_INVALID_QUBIT_INDEX = enum.auto()
+    E_INVALID_TARGET_QUBIT = enum.auto()
+    E_INVALID_CONTROL_QUBIT = enum.auto()
+    E_INVALID_STATE_INDEX = enum.auto()
+    E_INVALID_AMP_INDEX = enum.auto()
+    E_INVALID_NUM_AMPS = enum.auto()
+    E_INVALID_OFFSET_NUM_AMPS = enum.auto()
+    E_TARGET_IS_CONTROL = enum.auto()
+    E_TARGET_IN_CONTROLS = enum.auto()
+    E_CONTROL_TARGET_COLLISION = enum.auto()
+    E_QUBITS_NOT_UNIQUE = enum.auto()
+    E_TARGETS_NOT_UNIQUE = enum.auto()
+    E_CONTROLS_NOT_UNIQUE = enum.auto()
+    E_INVALID_NUM_QUBITS = enum.auto()
+    E_INVALID_NUM_TARGETS = enum.auto()
+    E_INVALID_NUM_CONTROLS = enum.auto()
+    E_NON_UNITARY_MATRIX = enum.auto()
+    E_NON_UNITARY_COMPLEX_PAIR = enum.auto()
+    E_ZERO_VECTOR = enum.auto()
+    E_SYS_TOO_BIG_TO_PRINT = enum.auto()
+    E_COLLAPSE_STATE_ZERO_PROB = enum.auto()
+    E_INVALID_QUBIT_OUTCOME = enum.auto()
+    E_CANNOT_OPEN_FILE = enum.auto()
+    E_SECOND_ARG_MUST_BE_STATEVEC = enum.auto()
+    E_MISMATCHING_QUREG_DIMENSIONS = enum.auto()
+    E_MISMATCHING_QUREG_TYPES = enum.auto()
+    E_DEFINED_ONLY_FOR_STATEVECS = enum.auto()
+    E_DEFINED_ONLY_FOR_DENSMATRS = enum.auto()
+    E_INVALID_PROB = enum.auto()
+    E_UNNORM_PROBS = enum.auto()
+    E_INVALID_ONE_QUBIT_DEPHASE_PROB = enum.auto()
+    E_INVALID_TWO_QUBIT_DEPHASE_PROB = enum.auto()
+    E_INVALID_ONE_QUBIT_DEPOL_PROB = enum.auto()
+    E_INVALID_TWO_QUBIT_DEPOL_PROB = enum.auto()
+    E_INVALID_ONE_QUBIT_PAULI_PROBS = enum.auto()
+    E_INVALID_CONTROLS_BIT_STATE = enum.auto()
+    E_INVALID_PAULI_CODE = enum.auto()
+    E_INVALID_NUM_SUM_TERMS = enum.auto()
+    E_CANNOT_FIT_MULTI_QUBIT_MATRIX = enum.auto()
+    E_INVALID_UNITARY_SIZE = enum.auto()
+    E_COMPLEX_MATRIX_NOT_INIT = enum.auto()
+    E_INVALID_NUM_ONE_QUBIT_KRAUS_OPS = enum.auto()
+    E_INVALID_NUM_TWO_QUBIT_KRAUS_OPS = enum.auto()
+    E_INVALID_NUM_N_QUBIT_KRAUS_OPS = enum.auto()
+    E_INVALID_KRAUS_OPS = enum.auto()
+    E_MISMATCHING_NUM_TARGS_KRAUS_SIZE = enum.auto()
+    E_DISTRIB_QUREG_TOO_SMALL = enum.auto()
+    E_NUM_AMPS_EXCEED_TYPE = enum.auto()
+
+
+E = ErrorCode
+
+# Verbatim reference message table (QuEST_validation.c:81-131).
+MESSAGES = {
+    E.E_INVALID_NUM_RANKS: "Invalid number of nodes. Distributed simulation can only make use of a power-of-2 number of node.",
+    E.E_INVALID_NUM_CREATE_QUBITS: "Invalid number of qubits. Must create >0.",
+    E.E_INVALID_QUBIT_INDEX: "Invalid qubit index. Must be >=0 and <numQubits.",
+    E.E_INVALID_TARGET_QUBIT: "Invalid target qubit. Must be >=0 and <numQubits.",
+    E.E_INVALID_CONTROL_QUBIT: "Invalid control qubit. Must be >=0 and <numQubits.",
+    E.E_INVALID_STATE_INDEX: "Invalid state index. Must be >=0 and <2^numQubits.",
+    E.E_INVALID_AMP_INDEX: "Invalid amplitude index. Must be >=0 and <2^numQubits.",
+    E.E_INVALID_NUM_AMPS: "Invalid number of amplitudes. Must be >=0 and <=2^numQubits.",
+    E.E_INVALID_OFFSET_NUM_AMPS: "More amplitudes given than exist in the statevector from the given starting index.",
+    E.E_TARGET_IS_CONTROL: "Control qubit cannot equal target qubit.",
+    E.E_TARGET_IN_CONTROLS: "Control qubits cannot include target qubit.",
+    E.E_CONTROL_TARGET_COLLISION: "Control and target qubits must be disjoint.",
+    E.E_QUBITS_NOT_UNIQUE: "The qubits must be unique.",
+    E.E_TARGETS_NOT_UNIQUE: "The target qubits must be unique.",
+    E.E_CONTROLS_NOT_UNIQUE: "The control qubits should be unique.",
+    E.E_INVALID_NUM_QUBITS: "Invalid number of qubits. Must be >0 and <=numQubits.",
+    E.E_INVALID_NUM_TARGETS: "Invalid number of target qubits. Must be >0 and <=numQubits.",
+    E.E_INVALID_NUM_CONTROLS: "Invalid number of control qubits. Must be >0 and <numQubits.",
+    E.E_NON_UNITARY_MATRIX: "Matrix is not unitary.",
+    E.E_NON_UNITARY_COMPLEX_PAIR: "Compact matrix formed by given complex numbers is not unitary.",
+    E.E_ZERO_VECTOR: "Invalid axis vector. Must be non-zero.",
+    E.E_SYS_TOO_BIG_TO_PRINT: "Invalid system size. Cannot print output for systems greater than 5 qubits.",
+    E.E_COLLAPSE_STATE_ZERO_PROB: "Can't collapse to state with zero probability.",
+    E.E_INVALID_QUBIT_OUTCOME: "Invalid measurement outcome -- must be either 0 or 1.",
+    E.E_CANNOT_OPEN_FILE: "Could not open file.",
+    E.E_SECOND_ARG_MUST_BE_STATEVEC: "Second argument must be a state-vector.",
+    E.E_MISMATCHING_QUREG_DIMENSIONS: "Dimensions of the qubit registers don't match.",
+    E.E_MISMATCHING_QUREG_TYPES: "Registers must both be state-vectors or both be density matrices.",
+    E.E_DEFINED_ONLY_FOR_STATEVECS: "Operation valid only for state-vectors.",
+    E.E_DEFINED_ONLY_FOR_DENSMATRS: "Operation valid only for density matrices.",
+    E.E_INVALID_PROB: "Probabilities must be in [0, 1].",
+    E.E_UNNORM_PROBS: "Probabilities must sum to ~1.",
+    E.E_INVALID_ONE_QUBIT_DEPHASE_PROB: "The probability of a single qubit dephase error cannot exceed 1/2, which maximally mixes.",
+    E.E_INVALID_TWO_QUBIT_DEPHASE_PROB: "The probability of a two-qubit qubit dephase error cannot exceed 3/4, which maximally mixes.",
+    E.E_INVALID_ONE_QUBIT_DEPOL_PROB: "The probability of a single qubit depolarising error cannot exceed 3/4, which maximally mixes.",
+    E.E_INVALID_TWO_QUBIT_DEPOL_PROB: "The probability of a two-qubit depolarising error cannot exceed 15/16, which maximally mixes.",
+    E.E_INVALID_ONE_QUBIT_PAULI_PROBS: "The probability of any X, Y or Z error cannot exceed the probability of no error.",
+    E.E_INVALID_CONTROLS_BIT_STATE: "The state of the control qubits must be a bit sequence (0s and 1s).",
+    E.E_INVALID_PAULI_CODE: "Invalid Pauli code. Codes must be 0 (or PAULI_I), 1 (PAULI_X), 2 (PAULI_Y) or 3 (PAULI_Z) to indicate the identity, X, Y and Z gates respectively.",
+    E.E_INVALID_NUM_SUM_TERMS: "Invalid number of terms in the Pauli sum. The number of terms must be >0.",
+    E.E_CANNOT_FIT_MULTI_QUBIT_MATRIX: "The specified matrix targets too many qubits; the batches of amplitudes to modify cannot all fit in a single distributed node's memory allocation.",
+    E.E_INVALID_UNITARY_SIZE: "The matrix size does not match the number of target qubits.",
+    E.E_COMPLEX_MATRIX_NOT_INIT: "The ComplexMatrixN was not successfully created (possibly insufficient memory available).",
+    E.E_INVALID_NUM_ONE_QUBIT_KRAUS_OPS: "At least 1 and at most 4 single qubit Kraus operators may be specified.",
+    E.E_INVALID_NUM_TWO_QUBIT_KRAUS_OPS: "At least 1 and at most 16 two-qubit Kraus operators may be specified.",
+    E.E_INVALID_NUM_N_QUBIT_KRAUS_OPS: "At least 1 and at most 4*N^2 of N-qubit Kraus operators may be specified.",
+    E.E_INVALID_KRAUS_OPS: "The specified Kraus map is not a completely positive, trace preserving map.",
+    E.E_MISMATCHING_NUM_TARGS_KRAUS_SIZE: "Every Kraus operator must be of the same number of qubits as the number of targets.",
+    E.E_DISTRIB_QUREG_TOO_SMALL: "Too few qubits. The created qureg must have at least one amplitude per node used in distributed simulation.",
+    E.E_NUM_AMPS_EXCEED_TYPE: "Too many qubits (max of log2(SIZE_MAX)). Cannot store the number of amplitudes per-node in the size_t type.",
+}
+
+# reference REAL_EPS, per precision (QuEST_precision.h:35,48)
+REAL_EPS_SINGLE = 1e-5
+REAL_EPS_DOUBLE = 1e-13
+
+
+def eps_for(qureg_or_dtype) -> float:
+    """REAL_EPS for a register's (or dtype's) precision."""
+    from quest_tpu import precision
+    dtype = getattr(qureg_or_dtype, "dtype", qureg_or_dtype)
+    return precision.real_eps(dtype)
 
 
 class QuESTError(ValueError):
     """Raised for any invalid user input (analogue of invalidQuESTInputError)."""
+
+    def __init__(self, msg, code: ErrorCode = None):
+        super().__init__(msg)
+        self.code = code
 
 
 def _default_handler(msg: str, func: str = ""):
@@ -43,7 +177,14 @@ def set_error_handler(handler) -> None:
     _error_handler = handler if handler is not None else _default_handler
 
 
-def _err(msg: str):
+def _err(code, msg: str = None):
+    """Report an invalid input: `code` is an ErrorCode (message looked up
+    in the verbatim table) or a bare string for checks with no reference
+    counterpart."""
+    if isinstance(code, ErrorCode):
+        msg = MESSAGES[code]
+    else:
+        code, msg = None, code
     import inspect
     # report the outermost quest_tpu function the USER called (the
     # reference hands __func__ of the public API fn to the hook) — walk
@@ -62,33 +203,38 @@ def _err(msg: str):
         del frame
     _error_handler(msg, func)
     # a non-raising handler must not let execution continue into the op
-    raise QuESTError(msg)
+    raise QuESTError(msg, code)
 
 
 # -- register construction ---------------------------------------------------
 
 def validate_num_qubits(num_qubits: int):
     if not isinstance(num_qubits, (int, np.integer)) or num_qubits < 1:
-        _err("Invalid number of qubits: must be a positive integer.")
+        _err(E.E_INVALID_NUM_CREATE_QUBITS)
     if num_qubits > 60:
-        _err("Invalid number of qubits: state would overflow the index type.")
+        _err(E.E_NUM_AMPS_EXCEED_TYPE)
 
 
 def validate_state_index(qureg, index: int):
     dim = 1 << qureg.num_qubits
     if not (0 <= index < dim):
-        _err("Invalid state index: must be in [0, 2^numQubits).")
+        _err(E.E_INVALID_STATE_INDEX)
 
 
 def validate_amp_index(qureg, index: int, dim=None):
     dim = dim if dim is not None else qureg.num_amps
     if not (0 <= index < dim):
-        _err("Invalid amplitude index: must be in [0, numAmps).")
+        _err(E.E_INVALID_AMP_INDEX)
 
 
 def validate_num_amps(qureg, start: int, num: int):
-    if start < 0 or num < 0 or start + num > qureg.num_amps:
-        _err("Invalid number of amplitudes: slice exceeds the register.")
+    # reference validateNumAmps checks the start index FIRST
+    # (QuEST_validation.c validateAmpIndex then the offset sum)
+    validate_amp_index(qureg, start)
+    if num < 0 or num > qureg.num_amps:
+        _err(E.E_INVALID_NUM_AMPS)
+    if start + num > qureg.num_amps:
+        _err(E.E_INVALID_OFFSET_NUM_AMPS)
 
 
 def validate_equal_lengths(reals, imags):
@@ -99,77 +245,87 @@ def validate_equal_lengths(reals, imags):
 
 def validate_match(a, b):
     if a.num_qubits != b.num_qubits:
-        _err("Invalid Qureg pair: dimensions must match.")
+        _err(E.E_MISMATCHING_QUREG_DIMENSIONS)
+
+
+def validate_matching_types(a, b):
+    if a.is_density != b.is_density:
+        _err(E.E_MISMATCHING_QUREG_TYPES)
 
 
 def validate_pure_state_args(qureg, pure):
     if pure.is_density:
-        _err("Invalid operation: second argument must be a statevector.")
+        _err(E.E_SECOND_ARG_MUST_BE_STATEVEC)
     if qureg.num_qubits != pure.num_qubits:
-        _err("Invalid Qureg pair: dimensions must match.")
+        _err(E.E_MISMATCHING_QUREG_DIMENSIONS)
 
 
 # -- qubit indices -----------------------------------------------------------
 
 def validate_target(qureg, target: int):
     if not (0 <= target < qureg.num_qubits):
-        _err("Invalid target qubit. Must be >=0 and <numQubits.")
+        _err(E.E_INVALID_TARGET_QUBIT)
+
+
+def validate_control(qureg, control: int):
+    if not (0 <= control < qureg.num_qubits):
+        _err(E.E_INVALID_CONTROL_QUBIT)
 
 
 def validate_control_target(qureg, control: int, target: int):
     validate_target(qureg, target)
-    validate_target(qureg, control)
+    validate_control(qureg, control)
     if control == target:
-        _err("Control qubit cannot equal target qubit.")
+        _err(E.E_TARGET_IS_CONTROL)
 
 
 def validate_unique_targets(qureg, qubit1: int, qubit2: int):
     validate_target(qureg, qubit1)
     validate_target(qureg, qubit2)
     if qubit1 == qubit2:
-        _err("Qubits must be unique.")
+        _err(E.E_QUBITS_NOT_UNIQUE)
 
 
 def validate_multi_targets(qureg, targets, num_targets=None):
     targets = list(targets)
     n = len(targets) if num_targets is None else num_targets
     if n < 1 or n > qureg.num_qubits:
-        _err("Invalid number of target qubits.")
+        _err(E.E_INVALID_NUM_TARGETS)
     for t in targets:
         validate_target(qureg, t)
     if len(set(targets)) != len(targets):
-        _err("Qubits must be unique.")
+        _err(E.E_TARGETS_NOT_UNIQUE)
 
 
 def validate_multi_controls(qureg, controls):
     controls = list(controls)
     if len(controls) >= qureg.num_qubits:
-        _err("Invalid number of control qubits.")
+        _err(E.E_INVALID_NUM_CONTROLS)
     for c in controls:
-        validate_target(qureg, c)
+        validate_control(qureg, c)
     if len(set(controls)) != len(controls):
-        _err("Qubits must be unique.")
+        _err(E.E_CONTROLS_NOT_UNIQUE)
 
 
 def validate_multi_controls_targets(qureg, controls, targets):
     validate_multi_controls(qureg, controls)
     validate_multi_targets(qureg, targets)
     if set(controls) & set(targets):
-        _err("Control and target qubits must be disjoint.")
+        _err(E.E_CONTROL_TARGET_COLLISION)
 
 
 def validate_control_states(controls, states):
     states = list(states)
     if len(states) != len(list(controls)):
-        _err("Invalid control state: must give one state per control qubit.")
+        _err(E.E_INVALID_CONTROLS_BIT_STATE)
     for s in states:
         if s not in (0, 1):
-            _err("Invalid control state: each must be 0 or 1.")
+            _err(E.E_INVALID_CONTROLS_BIT_STATE)
 
 
 def validate_outcome(outcome: int):
     if outcome not in (0, 1):
-        _err("Invalid measurement outcome. Must be 0 or 1.")
+        _err(E.E_INVALID_QUBIT_OUTCOME)
 
 
 # -- numeric operator checks -------------------------------------------------
@@ -177,12 +333,12 @@ def validate_outcome(outcome: int):
 def _as_matrix(m, num_targets=None) -> np.ndarray:
     m = np.asarray(m)
     if m.ndim != 2 or m.shape[0] != m.shape[1]:
-        _err("Invalid matrix: must be square.")
+        _err(E.E_INVALID_UNITARY_SIZE)
     dim = m.shape[0]
     if dim & (dim - 1) or dim < 2:
-        _err("Invalid matrix: dimension must be a power of 2.")
+        _err(E.E_INVALID_UNITARY_SIZE)
     if num_targets is not None and dim != (1 << num_targets):
-        _err("Invalid matrix: dimension must be 2^numTargets.")
+        _err(E.E_INVALID_UNITARY_SIZE)
     return m.astype(np.complex128)
 
 
@@ -190,73 +346,84 @@ def validate_matrix_size(m, num_targets):
     _as_matrix(m, num_targets)
 
 
-def validate_unitary(m, num_targets=None, eps=1e-4):
-    """||U U+ - I|| elementwise < eps (ref QuEST_validation.c:166-210)."""
+def validate_unitary(m, num_targets=None, eps=REAL_EPS_SINGLE):
+    """max |U U+ - I| < eps (ref QuEST_validation.c:166-210; eps is
+    REAL_EPS of the register's precision — pass eps_for(qureg))."""
     u = _as_matrix(m, num_targets)
     dev = np.abs(u @ u.conj().T - np.eye(u.shape[0])).max()
     if dev > eps:
-        _err("Invalid unitary matrix: U U† deviates from the identity.")
+        _err(E.E_NON_UNITARY_MATRIX)
 
 
-def validate_unitary_complex_pair(alpha, beta, eps=1e-4):
+def validate_unitary_complex_pair(alpha, beta, eps=REAL_EPS_SINGLE):
     """|alpha|^2+|beta|^2 == 1 (ref validateUnitaryComplexPair)."""
     mag = abs(complex(alpha)) ** 2 + abs(complex(beta)) ** 2
     if abs(mag - 1) > eps:
-        _err("Invalid alpha/beta pair: |alpha|^2 + |beta|^2 must equal 1.")
+        _err(E.E_NON_UNITARY_COMPLEX_PAIR)
 
 
 def validate_vector(v):
     x, y, z = float(v[0]), float(v[1]), float(v[2])
-    if x * x + y * y + z * z < 1e-24:
-        _err("Invalid axis vector: must have non-zero magnitude.")
+    if x * x + y * y + z * z < REAL_EPS_SINGLE ** 2:
+        _err(E.E_ZERO_VECTOR)
 
 
-def validate_kraus_ops(ops, num_targets, eps=1e-4, max_ops=None):
+def validate_kraus_ops(ops, num_targets, eps=REAL_EPS_SINGLE, max_ops=None):
     """Sum_k K+ K == I, i.e. the map is trace-preserving (CPTP)
     (ref QuEST_validation.c:212-239)."""
-    ops = [(_as_matrix(op, num_targets)) for op in ops]
-    if len(ops) < 1:
-        _err("Invalid number of Kraus operators: must give at least one.")
-    if max_ops is not None and len(ops) > max_ops:
-        _err("Invalid number of Kraus operators: too many for this map size.")
+    ops = list(ops)
+    if max_ops is None:
+        max_ops = 1 << (2 * num_targets)
+    if len(ops) < 1 or len(ops) > max_ops:
+        if num_targets == 1:
+            _err(E.E_INVALID_NUM_ONE_QUBIT_KRAUS_OPS)
+        elif num_targets == 2:
+            _err(E.E_INVALID_NUM_TWO_QUBIT_KRAUS_OPS)
+        _err(E.E_INVALID_NUM_N_QUBIT_KRAUS_OPS)
+    mats = []
+    for op in ops:
+        m = np.asarray(op)
+        if m.ndim != 2 or m.shape[0] != m.shape[1] or \
+                m.shape[0] != (1 << num_targets):
+            _err(E.E_MISMATCHING_NUM_TARGS_KRAUS_SIZE)
+        mats.append(m.astype(np.complex128))
     dim = 1 << num_targets
     acc = np.zeros((dim, dim), dtype=np.complex128)
-    for op in ops:
+    for op in mats:
         acc += op.conj().T @ op
     if np.abs(acc - np.eye(dim)).max() > eps:
-        _err("Invalid Kraus map: operators do not form a completely "
-             "positive trace-preserving map.")
+        _err(E.E_INVALID_KRAUS_OPS)
 
 
 # -- probabilities -----------------------------------------------------------
 
 def validate_prob(p: float):
     if not (0 <= p <= 1):
-        _err("Invalid probability: must be in [0, 1].")
+        _err(E.E_INVALID_PROB)
 
 
 def validate_one_qubit_dephase_prob(p: float):
     validate_prob(p)
     if p > 0.5:
-        _err("Invalid probability: one-qubit dephasing cannot exceed 1/2.")
+        _err(E.E_INVALID_ONE_QUBIT_DEPHASE_PROB)
 
 
 def validate_two_qubit_dephase_prob(p: float):
     validate_prob(p)
     if p > 3.0 / 4.0:
-        _err("Invalid probability: two-qubit dephasing cannot exceed 3/4.")
+        _err(E.E_INVALID_TWO_QUBIT_DEPHASE_PROB)
 
 
 def validate_one_qubit_depol_prob(p: float):
     validate_prob(p)
     if p > 3.0 / 4.0:
-        _err("Invalid probability: one-qubit depolarising cannot exceed 3/4.")
+        _err(E.E_INVALID_ONE_QUBIT_DEPOL_PROB)
 
 
 def validate_two_qubit_depol_prob(p: float):
     validate_prob(p)
     if p > 15.0 / 16.0:
-        _err("Invalid probability: two-qubit depolarising cannot exceed 15/16.")
+        _err(E.E_INVALID_TWO_QUBIT_DEPOL_PROB)
 
 
 def validate_one_qubit_damping_prob(p: float):
@@ -270,36 +437,35 @@ def validate_pauli_probs(px: float, py: float, pz: float):
         validate_prob(p)
     prob_no_error = 1 - px - py - pz
     if px > prob_no_error or py > prob_no_error or pz > prob_no_error:
-        _err("Invalid probability: the probability of any X, Y or Z error "
-             "cannot exceed the probability of no error.")
+        _err(E.E_INVALID_ONE_QUBIT_PAULI_PROBS)
 
 
 def validate_measurement_prob(p: float, eps: float):
     if p < eps:
-        _err("Invalid collapse: outcome probability is zero.")
+        _err(E.E_COLLAPSE_STATE_ZERO_PROB)
 
 
 def validate_density_matr(qureg):
     if not qureg.is_density:
-        _err("Invalid operation: a density matrix is required.")
+        _err(E.E_DEFINED_ONLY_FOR_DENSMATRS)
 
 
 def validate_state_vector(qureg):
     if qureg.is_density:
-        _err("Invalid operation: a state-vector is required.")
+        _err(E.E_DEFINED_ONLY_FOR_STATEVECS)
 
 
 def validate_num_pauli_sum_terms(n: int):
     if n < 1:
-        _err("Invalid number of terms in the Pauli sum.")
+        _err(E.E_INVALID_NUM_SUM_TERMS)
 
 
 def validate_pauli_targets(targets, paulis):
     if len(list(targets)) != len(list(paulis)):
-        _err("Invalid Pauli code list: must give one code per target qubit.")
+        _err(E.E_INVALID_PAULI_CODE)
 
 
 def validate_pauli_codes(codes):
     for c in np.asarray(codes).reshape(-1):
         if int(c) not in (0, 1, 2, 3):
-            _err("Invalid Pauli code: must be 0 (I), 1 (X), 2 (Y) or 3 (Z).")
+            _err(E.E_INVALID_PAULI_CODE)

@@ -51,7 +51,7 @@ def test_calc_inner_product_validation(rng):
     with pytest.raises(QuESTError, match="state-vector"):
         C.calc_inner_product(sv, dm)
     small = qt.create_qureg(N - 1)
-    with pytest.raises(QuESTError, match="dimensions"):
+    with pytest.raises(QuESTError, match="[Dd]imensions"):
         C.calc_inner_product(sv, small)
 
 

@@ -107,10 +107,10 @@ def test_num_qubits_num_amps():
     assert Q.getNumAmps(q) == 64
     rho = Q.createDensityQureg(2)
     assert Q.getNumQubits(rho) == 2
-    with pytest.raises(QuESTError, match="statevector"):
+    with pytest.raises(QuESTError, match="state-vector"):
         Q.getNumAmps(rho)
 
 
 def test_qureg_too_large_rejected():
-    with pytest.raises(QuESTError, match="number of qubits"):
+    with pytest.raises(QuESTError, match="Too many qubits"):
         Q.createQureg(70)

@@ -130,20 +130,20 @@ def test_channel_validation(rng):
     sv = qt.create_qureg(2)
     with pytest.raises(QuESTError, match="density"):
         ch.mix_dephasing(sv, 0, 0.1)
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         ch.mix_dephasing(rho, 0, 0.6)       # > 1/2
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         ch.mix_two_qubit_dephasing(rho, 0, 1, 0.8)  # > 3/4
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         ch.mix_depolarising(rho, 0, 0.8)    # > 3/4
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         ch.mix_two_qubit_depolarising(rho, 0, 1, 0.95)  # > 15/16
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         ch.mix_damping(rho, 0, 1.5)
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         ch.mix_pauli(rho, 0, 0.5, 0.4, 0.3)
     with pytest.raises(QuESTError, match="Invalid target"):
         ch.mix_damping(rho, 5, 0.1)
     # non-CPTP map rejected
-    with pytest.raises(QuESTError, match="trace-preserving"):
+    with pytest.raises(QuESTError, match="trace preserving"):
         ch.mix_kraus_map(rho, 0, [np.eye(2) * 0.5])

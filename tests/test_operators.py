@@ -59,7 +59,7 @@ def test_set_weighted_qureg(rng):
 def test_set_weighted_qureg_validation(rng):
     sv = load_sv(oracle.random_statevector(N, rng))
     dm = load_dm(oracle.random_density(N, rng))
-    with pytest.raises(QuESTError, match="types"):
+    with pytest.raises(QuESTError, match="both be state-vectors"):
         G.set_weighted_qureg(1, sv, 1, sv, 0, dm)
 
 

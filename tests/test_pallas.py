@@ -253,11 +253,11 @@ def test_channels_need_density_register_all_engines():
 def test_channel_builders_validate():
     from quest_tpu.validation import QuESTError
     c = Circuit(3)
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         c.damping(0, 1.2)
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         c.depolarising(0, 0.9)
-    with pytest.raises(QuESTError, match="probability"):
+    with pytest.raises(QuESTError, match="[Pp]robabilit"):
         c.dephasing(0, 0.6)
     with pytest.raises(QuESTError):
         c.kraus(0, [np.eye(2) * 0.5])          # non-CPTP

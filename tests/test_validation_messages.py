@@ -1,0 +1,119 @@
+"""Exact error-message parity with the reference's table
+(QuEST_validation.c:81-131). The reference's tests assert on message
+substrings via REQUIRE_THROWS_WITH(..., Contains(...)),
+test_unitaries.cpp:74-88 — these tests assert the full verbatim string."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import api as Q
+from quest_tpu import validation as val
+from quest_tpu.validation import ErrorCode as E
+from quest_tpu.validation import MESSAGES, QuESTError
+from quest_tpu.ops import gates as G
+from quest_tpu.ops import channels as ch
+
+
+def raises_exact(code):
+    return pytest.raises(QuESTError, match=r".*" +
+                         __import__("re").escape(MESSAGES[code]) + r"$")
+
+
+def test_target_qubit_message():
+    q = qt.create_qureg(3)
+    with raises_exact(E.E_INVALID_TARGET_QUBIT):
+        G.hadamard(q, 5)
+
+
+def test_control_qubit_messages():
+    q = qt.create_qureg(3)
+    with raises_exact(E.E_INVALID_CONTROL_QUBIT):
+        G.controlled_not(q, 7, 1)
+    with raises_exact(E.E_TARGET_IS_CONTROL):
+        G.controlled_not(q, 1, 1)
+
+
+def test_unitarity_messages():
+    q = qt.create_qureg(2)
+    with raises_exact(E.E_NON_UNITARY_MATRIX):
+        G.unitary(q, 0, np.array([[1, 0], [0, 0.5]]))
+    with raises_exact(E.E_NON_UNITARY_COMPLEX_PAIR):
+        G.compact_unitary(q, 0, 0.9, 0.1)
+
+
+def test_channel_probability_messages():
+    rho = qt.create_density_qureg(2)
+    with raises_exact(E.E_INVALID_ONE_QUBIT_DEPHASE_PROB):
+        ch.mix_dephasing(rho, 0, 0.6)
+    with raises_exact(E.E_INVALID_TWO_QUBIT_DEPHASE_PROB):
+        ch.mix_two_qubit_dephasing(rho, 0, 1, 0.8)
+    with raises_exact(E.E_INVALID_ONE_QUBIT_DEPOL_PROB):
+        ch.mix_depolarising(rho, 0, 0.8)
+    with raises_exact(E.E_INVALID_TWO_QUBIT_DEPOL_PROB):
+        ch.mix_two_qubit_depolarising(rho, 0, 1, 0.95)
+    with raises_exact(E.E_INVALID_PROB):
+        ch.mix_damping(rho, 0, 1.2)
+
+
+def test_kraus_messages():
+    rho = qt.create_density_qureg(2)
+    with raises_exact(E.E_INVALID_KRAUS_OPS):
+        ch.mix_kraus_map(rho, 0, [np.eye(2) * 0.5])
+    with raises_exact(E.E_INVALID_NUM_ONE_QUBIT_KRAUS_OPS):
+        ch.mix_kraus_map(rho, 0, [np.eye(2) / 2] * 5)
+
+
+def test_register_type_messages():
+    q = qt.create_qureg(2)
+    rho = qt.create_density_qureg(2)
+    from quest_tpu import calculations as C
+    from quest_tpu import state as S
+    with raises_exact(E.E_DEFINED_ONLY_FOR_DENSMATRS):
+        C.calc_purity(q)
+    with raises_exact(E.E_DEFINED_ONLY_FOR_STATEVECS):
+        S.get_amp(rho, 0)
+    with raises_exact(E.E_SECOND_ARG_MUST_BE_STATEVEC):
+        C.calc_fidelity(q, rho)
+
+
+def test_pauli_and_outcome_messages():
+    q = qt.create_qureg(2)
+    from quest_tpu import calculations as C
+    with raises_exact(E.E_INVALID_PAULI_CODE):
+        C.calc_expec_pauli_sum(q, [[4, 0]], [1.0])
+    with raises_exact(E.E_INVALID_NUM_SUM_TERMS):
+        C.calc_expec_pauli_sum(q, np.zeros((0, 2)), [])
+    from quest_tpu import measurement as meas
+    with raises_exact(E.E_INVALID_QUBIT_OUTCOME):
+        meas.collapse_to_outcome(q, 0, 2)
+
+
+def test_create_qureg_messages():
+    env = Q.createQuESTEnv()
+    with raises_exact(E.E_INVALID_NUM_CREATE_QUBITS):
+        Q.createQureg(0, env)
+    with raises_exact(E.E_NUM_AMPS_EXCEED_TYPE):
+        Q.createQureg(70, env)
+
+
+def test_real_eps_scaled_unitarity():
+    """Unitarity tolerance follows the register precision (REAL_EPS 1e-5
+    single / 1e-13 double, QuEST_precision.h:35,48): a matrix off by 1e-7
+    passes a complex64 register but fails a complex128 one."""
+    u = np.eye(2, dtype=np.complex128)
+    u[0, 0] = 1.0 + 3e-7
+    q32 = qt.create_qureg(2, dtype=np.complex64)
+    G.unitary(q32, 0, u)  # within single-precision REAL_EPS
+    q64 = qt.create_qureg(2, dtype=np.complex128)
+    with raises_exact(E.E_NON_UNITARY_MATRIX):
+        G.unitary(q64, 0, u)
+
+
+def test_error_code_attached():
+    q = qt.create_qureg(2)
+    with pytest.raises(QuESTError) as ei:
+        G.hadamard(q, 9)
+    # raised via the api hook wrapper; the inner code survives on the
+    # validation-layer exception chain or directly
+    assert "Invalid target qubit" in str(ei.value)
