@@ -140,9 +140,8 @@ def destroyQureg(qureg: Qureg, env: Optional[QuESTEnv] = None) -> None:
 def cloneQureg(targetQureg: Qureg, copyQureg: Qureg) -> None:
     """Overwrite targetQureg's state with a copy of copyQureg's
     (ref cloneQureg, QuEST.c works on matching-dimension registers)."""
+    _val.validate_matching_types(targetQureg.state, copyQureg.state)
     _val.validate_match(targetQureg.state, copyQureg.state)
-    if targetQureg.state.is_density != copyQureg.state.is_density:
-        _val._err("Invalid Qureg pair: types must match.")
     targetQureg._set(_state.clone(copyQureg.state))
 
 

@@ -178,8 +178,8 @@ def calc_expec_pauli_sum(q: Qureg, all_codes, coeffs) -> float:
     val.validate_num_pauli_sum_terms(len(coeffs))
     val.validate_pauli_codes(codes)
     if len(coeffs) != codes.shape[0]:
-        raise val.QuESTError("Invalid Pauli sum: must give exactly one "
-                             "coefficient per term.")
+        val._err("Invalid Pauli sum: must give exactly one coefficient "
+                 "per term.")
     codes_key = tuple(tuple(int(c) for c in term) for term in codes)
     cf = jnp.asarray(coeffs, dtype=q.real_dtype)
     return float(_expec_pauli_sum(q.amps, cf, codes=codes_key,
@@ -223,8 +223,8 @@ def apply_pauli_sum(q: Qureg, all_codes, coeffs) -> Qureg:
     val.validate_num_pauli_sum_terms(len(coeffs))
     val.validate_pauli_codes(codes)
     if len(coeffs) != codes.shape[0]:
-        raise val.QuESTError("Invalid Pauli sum: must give exactly one "
-                             "coefficient per term.")
+        val._err("Invalid Pauli sum: must give exactly one coefficient "
+                 "per term.")
     codes_key = tuple(tuple(int(c) for c in term) for term in codes)
     cf = jnp.asarray(coeffs, dtype=q.real_dtype)  # termCoeffs are real
     return q.replace_amps(_apply_pauli_sum(q.amps, cf, codes=codes_key,

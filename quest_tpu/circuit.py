@@ -348,41 +348,45 @@ class Circuit:
             return fn
 
         flat = self._flat_ops(n, density)
-        brb = min(PB.DEFAULT_BLOCK_ROW_BITS, n - PB.LANE_QUBITS)
-        items = F.plan(flat, n, bands=PB.plan_bands(n, brb))
-        parts = PB.segment_plan(items, n, brb)
-        appliers = []
+        items = F.plan(flat, n, bands=PB.plan_bands(n))
+        parts = PB.segment_plan(items, n)
+        appliers = []   # segment appliers work on (2, rows, 128); XLA
+        # passthroughs flatten and restore around their op
         for part in parts:
             if part[0] == "segment":
                 _, stages, arrays = part
-                seg = PB.compile_segment(stages, n, brb, interpret)
+                seg = PB.compile_segment(stages, n, interpret=interpret)
                 appliers.append(
                     lambda amps, seg=seg, arrays=arrays: seg(amps, arrays))
             else:
                 it = part[1]
                 if isinstance(it, F.BandOp):
-                    appliers.append(
-                        lambda amps, it=it: A.apply_band(
-                            amps, n, (it.gre, it.gim), it.ql, it.w, it.preds))
+                    xla_fn = (lambda a, it=it: A.apply_band(
+                        a, n, (it.gre, it.gim), it.ql, it.w, it.preds))
                 elif isinstance(it, F.DiagItem):
-                    appliers.append(
-                        lambda amps, it=it: _apply_one(amps, n, it.op))
+                    xla_fn = lambda a, it=it: _apply_one(a, n, it.op)
                 else:
-                    appliers.append(
-                        lambda amps, it=it: _apply_op(amps, n, False, it.op))
+                    xla_fn = lambda a, it=it: _apply_op(a, n, False, it.op)
+                appliers.append(
+                    lambda amps, f=xla_fn: f(amps.reshape(2, -1))
+                    .reshape(amps.shape))
 
         def run(amps):
             # the Pallas kernels are f32-only; f64 registers keep their
             # precision on the XLA band path
             if amps.dtype != jnp.float32:
-                return _loop(lambda a: _apply_banded_items(a, n, items),
-                             amps, iters)
+                flat_in = amps.reshape(2, -1)
+                out = _loop(lambda a: _apply_banded_items(a, n, items),
+                            flat_in, iters)
+                return out.reshape(amps.shape)
+            shape = amps.shape
 
             def body(a):
                 for f in appliers:
                     a = f(a)
                 return a
-            return _loop(body, amps, iters)
+            out = _loop(body, amps.reshape(2, -1, PB.LANES), iters)
+            return out.reshape(shape)
 
         fn = jax.jit(run, donate_argnums=(0,) if donate else ())
         self._compiled[key] = fn

@@ -20,7 +20,10 @@ from typing import Optional
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libquest_host.so")
+# QUEST_NATIVE_LIB overrides the library (e.g. libquest_host_asan.so in
+# the ASan CI job, run with LD_PRELOAD=libasan)
+_LIB_PATH = os.environ.get(
+    "QUEST_NATIVE_LIB", os.path.join(_NATIVE_DIR, "libquest_host.so"))
 
 _lib = None
 _lib_tried = False

@@ -207,14 +207,14 @@ def mix_pauli(q: Qureg, target: int, prob_x, prob_y, prob_z) -> Qureg:
 def mix_kraus_map(q: Qureg, target: int, ops: Sequence) -> Qureg:
     val.validate_density_matr(q)
     val.validate_target(q, target)
-    val.validate_kraus_ops(ops, 1, max_ops=4)
+    val.validate_kraus_ops(ops, 1, eps=val.eps_for(q), max_ops=4)
     return _mix_packed(q, (target,), M.kraus_superoperator(ops))
 
 
 def mix_two_qubit_kraus_map(q: Qureg, t1: int, t2: int, ops: Sequence) -> Qureg:
     val.validate_density_matr(q)
     val.validate_multi_targets(q, (t1, t2))
-    val.validate_kraus_ops(ops, 2, max_ops=16)
+    val.validate_kraus_ops(ops, 2, eps=val.eps_for(q), max_ops=16)
     return _mix_packed(q, (t1, t2), M.kraus_superoperator(ops))
 
 
@@ -222,7 +222,7 @@ def mix_multi_qubit_kraus_map(q: Qureg, targets: Sequence[int], ops: Sequence) -
     val.validate_density_matr(q)
     val.validate_multi_targets(q, targets)
     k = len(tuple(targets))
-    val.validate_kraus_ops(ops, k, max_ops=(1 << (2 * k)))
+    val.validate_kraus_ops(ops, k, eps=val.eps_for(q), max_ops=(1 << (2 * k)))
     return _mix_packed(q, tuple(targets), M.kraus_superoperator(ops))
 
 

@@ -1,33 +1,45 @@
-"""Pallas TPU mega-kernel over band-fusion plans: many bands per HBM pass.
+"""Pallas TPU mega-kernel over band-fusion plans: many gates per HBM pass.
 
 The XLA band engine (quest_tpu/ops/fusion.py + apply_band) costs one full
 memory pass per band contraction — and for bands whose bits are not the
 minor axis, XLA inserts full-state transposes around the matmul (measured:
-bands 1/2 access 1.6-2x the state bytes; see scripts/probe_band_hlo.py).
-This kernel runs a whole SEGMENT of band operators in one pass: each grid
-step holds a (2, rows, 128) block of the split re/im planes in VMEM and
-applies every stage there, where relayout costs VPU/XLU shuffles instead
-of HBM traffic. It is the TPU-native analogue of the reference's
-single-pass OpenMP/CUDA per-gate kernels (QuEST_cpu.c:1656-3620,
-QuEST_gpu.cu) — except one pass covers MANY gates.
+those bands access 1.6-2x the state bytes; scripts/probe_band_hlo.py).
+This kernel runs a whole SEGMENT of operators in one pass; relayout inside
+the block costs VPU/XLU shuffles instead of HBM traffic. It is the
+TPU-native analogue of the reference's single-pass OpenMP/CUDA per-gate
+kernels (QuEST_cpu.c:1656-3620, QuEST_gpu.cu) — except one pass covers
+MANY gates.
 
-In-block geometry (block_row_bits = log2 rows, lanes = 128):
-  band 0   qubits 0..6          lane axis: X @ G^T on the MXU
-  band 1   qubits 7..13         sublane axis: cheap (T,s,l)->(s,T,l)
-                                relayout, one (128, T*128) MXU dot, undo
-  band 2   qubits 14..7+brb-1   tile axis: (D,D) @ (D, rows*128/D) dot
-  diagonals / parity / controls on ANY qubit (including grid bits beyond
-  the block): elementwise factors from lane iota x global row id
-  (pid * rows + iota) — they never break a segment.
+Block geometry. The (2, 2^n) split re/im planes are viewed as
+(2, ...row axes..., 128): qubits 0..6 are the lane axis; row bits make up
+the rest. Each grid step's block holds:
 
-Band operators ride along as (2, D, D) kernel INPUTS, not baked
-constants, so segments with identical structure but different angles
-compile to the same kernel (layer reuse across RCS depth).
+  inner rows   the lowest `inner_bits` row bits, contiguous —
+               qubits 7..7+inner_bits-1
+  scattered    up to SCATTER_MAX individual HIGH row bits, each exposed
+               as its own size-2 axis of the view so the block contains
+               BOTH butterfly halves of that qubit (the BlockSpec gathers
+               the strips in one DMA) — this is how gates on ARBITRARY
+               high qubits stay fused, the on-chip analogue of the
+               reference's pair-rank exchange (getChunkPairId,
+               QuEST_cpu_distributed.c:303-312)
 
-Gates that MIX grid bits (non-diagonal targets above the block top) are
-not expressible in one contiguous-block pass; the circuit layer splits
-the plan into segments at those ops and applies them through the XLA
-band path (quest_tpu/circuit.py compiled_fused).
+Stages inside the block:
+  b0   composed 128x128 operator on the lane band: X @ G^T on the MXU
+  b1   composed operator on the sublane band (qubits 7..13): cheap
+       (A,d,l)->(d,A,l) relayout, one MXU dot, undo
+  sc   composed 2x2 on one scattered qubit: elementwise butterfly
+  diagonal / all-ones / parity phases on ANY qubits (global row ids from
+       the grid indices) — these never break a segment
+  controls anywhere become lane/global-row-id masks
+
+Operator matrices ride along as kernel INPUTS, not baked constants, so
+segments with identical structure but different angles compile to the
+same kernel (layer reuse across RCS depth).
+
+A segment ends when it would need more than SCATTER_MAX scattered qubits,
+or at a cross-band multi-target unitary (XLA passthrough between
+segments; quest_tpu/circuit.py compiled_fused).
 """
 
 from __future__ import annotations
@@ -46,25 +58,28 @@ from quest_tpu.ops import fusion as F
 
 LANE_QUBITS = 7
 LANES = 1 << LANE_QUBITS
-DEFAULT_BLOCK_ROW_BITS = 11   # 2048-row blocks: 1 MiB per plane per block
+ROWS_EFF_BITS = 12    # log2 of rows held per block (scattered x inner):
+# (2, 4096, 128) f32 = 4 MiB per block buffer; with Pallas double-buffering
+# and stage temporaries this stays within VMEM_LIMIT_BYTES
+SCATTER_MAX = 5       # scattered qubits per segment (keeps inner_bits >= 7
+# so the full sublane band stays in-block)
 VMEM_LIMIT_BYTES = 100 * (1 << 20)  # v5e has 128 MiB VMEM; the default
 # 16 MiB scoped limit rejects multi-stage kernels (measured round 1/2)
 
 
-def plan_bands(n: int, block_row_bits: int) -> List[Tuple[int, int]]:
+def plan_bands(n: int) -> List[Tuple[int, int]]:
     """Band layout matching the kernel's reach: 7-qubit lane and sublane
-    bands, a tile band up to the block top, then 7-wide grid bands (those
-    compose too — they just run through the XLA path)."""
-    inner_top = LANE_QUBITS + block_row_bits
+    bands, then width-1 bands — each high qubit composes its own 2x2 run,
+    applied in-kernel as a scattered-axis butterfly (or via a cheap D=2
+    XLA contraction when a segment overflows)."""
     bands = []
     ql = 0
-    while ql < n:
-        if ql < inner_top:
-            w = min(LANE_QUBITS, n - ql, inner_top - ql)
-        else:
-            w = min(LANE_QUBITS, n - ql)
+    while ql < min(n, 14):
+        w = min(LANE_QUBITS, n - ql)
         bands.append((ql, w))
         ql += w
+    for q in range(ql, n):
+        bands.append((q, 1))
     return bands
 
 
@@ -75,11 +90,12 @@ def plan_bands(n: int, block_row_bits: int) -> List[Tuple[int, int]]:
 
 @dataclasses.dataclass(frozen=True)
 class MatStage:
-    kind: str                  # 'b0' | 'b1' | 'b2'
+    kind: str                  # 'b0' | 'b1' | 'sc'
     dim: int                   # operator dimension D
     real_only: bool
     lane_preds: Tuple[Tuple[int, int], ...]   # (lane bit, want)
     row_preds: Tuple[Tuple[int, int], ...]    # (GLOBAL row bit, want)
+    bit: int = -1              # 'sc': the GLOBAL row bit this acts on
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,7 +132,7 @@ class DiagVecStage:
 # ---------------------------------------------------------------------------
 
 
-def _split_preds(preds, n):
+def _split_preds(preds):
     lane_p, row_p = [], []
     for q, s in preds:
         if q < LANE_QUBITS:
@@ -126,42 +142,49 @@ def _split_preds(preds, n):
     return tuple(lane_p), tuple(row_p)
 
 
-def segment_plan(items: Sequence, n: int, block_row_bits: int):
+def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
     """Split fusion-plan items into kernel segments and XLA passthroughs.
     Returns a list of ("segment", [stages], [op_arrays]) and
     ("xla", item) entries, in program order."""
-    inner_top = LANE_QUBITS + block_row_bits
     parts: List = []
     stages: List = []
     arrays: List = []
+    scat_bits: set = set()
 
     def flush():
-        nonlocal stages, arrays
+        nonlocal stages, arrays, scat_bits
         if stages:
             parts.append(("segment", stages, arrays))
             stages, arrays = [], []
+        scat_bits = set()
 
     for it in items:
         if isinstance(it, F.BandOp):
-            if it.ql + it.w <= inner_top:
-                real_only = bool(np.all(it.gim == 0.0))
-                lane_p, row_p = _split_preds(it.preds, n)
-                if it.ql == 0:
-                    kind = "b0"
-                    g = it.gre.T + 1j * it.gim.T       # X @ G^T form
-                elif it.ql == LANE_QUBITS:
-                    kind = "b1"
-                    g = it.gre + 1j * it.gim
-                else:
-                    kind = "b2"
-                    g = it.gre + 1j * it.gim
-                d = 1 << it.w
-                stages.append(MatStage(kind, d, real_only, lane_p, row_p))
-                arr = np.stack([g.real, g.imag]).astype(np.float32)
-                arrays.append(jnp.asarray(arr))
+            lane_p, row_p = _split_preds(it.preds)
+            real_only = bool(np.all(it.gim == 0.0))
+            if it.ql == 0:
+                kind, bit = "b0", -1
+                g = it.gre.T + 1j * it.gim.T       # X @ G^T form
+            elif it.ql == LANE_QUBITS:
+                kind, bit = "b1", -1
+                g = it.gre + 1j * it.gim
+            elif it.w == 1:
+                kind, bit = "sc", it.ql - LANE_QUBITS
+                g = it.gre + 1j * it.gim
+                if bit not in scat_bits:
+                    if len(scat_bits) >= scatter_max:
+                        flush()
+                    scat_bits.add(bit)
+            else:
+                flush()
+                parts.append(("xla", it))
                 continue
-            flush()
-            parts.append(("xla", it))
+            stages.append(MatStage(kind, 1 << it.w, real_only, lane_p,
+                                   row_p, bit))
+            # keep operator arrays HOST-side (numpy): as closure
+            # constants they upload with the program instead of occupying
+            # HBM and round-tripping device->host at trace time
+            arrays.append(np.stack([g.real, g.imag]).astype(np.float32))
             continue
         if isinstance(it, F.DiagItem):
             op = it.op
@@ -177,7 +200,7 @@ def segment_plan(items: Sequence, n: int, block_row_bits: int):
                 d = np.asarray(op.operand, dtype=np.complex128).reshape(-1)
                 lane_p, row_p = _split_preds(
                     tuple(zip(op.controls, op.cstates or
-                              (1,) * len(op.controls))), n)
+                              (1,) * len(op.controls))))
                 stages.append(DiagVecStage(
                     targets, tuple(d.real), tuple(d.imag), lane_p, row_p))
                 continue
@@ -207,16 +230,74 @@ def segment_plan(items: Sequence, n: int, block_row_bits: int):
 # ---------------------------------------------------------------------------
 
 
+@dataclasses.dataclass(frozen=True)
+class _Geometry:
+    """Block/row geometry of one compiled segment."""
+    n: int
+    scat: Tuple[int, ...]       # scattered GLOBAL row bits, descending
+    inner_bits: int
+    gaps: Tuple[Tuple[int, int], ...]  # grid dims as (lo_bit, width_bits),
+    # outermost first — one per gap above/between scattered axes plus the
+    # gap between the lowest scattered bit and the inner rows
+
+    @property
+    def rows_eff(self) -> int:
+        return 1 << (len(self.scat) + self.inner_bits)
+
+    def view_dims(self):
+        """Row-space view dims (outer->inner) and the block-shape entry
+        per dim (1 for grid axes, full extent otherwise)."""
+        dims, blocks = [], []
+        for (lo, width) in self.gaps[:-1]:
+            dims.append(1 << width)
+            blocks.append(1)
+            dims.append(2)
+            blocks.append(2)
+        lo, width = self.gaps[-1]
+        dims.append(1 << width)
+        blocks.append(1)
+        dims.append(1 << self.inner_bits)
+        blocks.append(1 << self.inner_bits)
+        return tuple(dims), tuple(blocks)
+
+
+def _geometry(n: int, scat_bits, rows_eff_bits: int) -> _Geometry:
+    total_row_bits = n - LANE_QUBITS
+    scat = tuple(sorted(scat_bits, reverse=True))
+    h = len(scat)
+    inner_bits = min(rows_eff_bits - h,
+                     scat[-1] if scat else total_row_bits,
+                     total_row_bits)
+    # grid dims: the bit gaps (top .. scat[0]), (scat[a] .. scat[a+1]),
+    # ..., (scat[-1] .. inner) — possibly zero-width (size-1 grid dims)
+    gaps = []
+    hi = total_row_bits
+    for s in scat:
+        gaps.append((s + 1, hi - s - 1))
+        hi = s
+    gaps.append((inner_bits, hi - inner_bits))
+    return _Geometry(n, scat, inner_bits, tuple(gaps))
+
+
+def _row_ids(geo: _Geometry, pids):
+    """(rows_eff, 1) int32 GLOBAL row id of each block row."""
+    base = 0
+    for (lo, _), pid in zip(geo.gaps, pids):
+        base = base + pid * (1 << lo)
+    j = jax.lax.broadcasted_iota(jnp.int32, (geo.rows_eff, 1), 0)
+    ids = base + (j & ((1 << geo.inner_bits) - 1))
+    h = len(geo.scat)
+    for a, s in enumerate(geo.scat):
+        bit = (j >> (geo.inner_bits + h - 1 - a)) & 1
+        ids = ids + (bit << s)
+    return ids
+
+
 def _lane_iota():
     return jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
 
 
-def _row_iota(rows, pid):
-    base = pid * rows
-    return base + jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
-
-
-def _mask_of(rows, pid, lane_preds, row_preds):
+def _mask_of(row_ids, lane_preds, row_preds):
     mask = None
     if lane_preds:
         ids = _lane_iota()
@@ -224,9 +305,8 @@ def _mask_of(rows, pid, lane_preds, row_preds):
             m = ((ids >> bit) & 1) == want
             mask = m if mask is None else (mask & m)
     if row_preds:
-        ids = _row_iota(rows, pid)
         for bit, want in row_preds:
-            m = ((ids >> bit) & 1) == want
+            m = ((row_ids >> bit) & 1) == want
             mask = m if mask is None else (mask & m)
     return mask
 
@@ -244,11 +324,11 @@ def _cdot(contract, re, im, gre, gim, real_only):
     return t1 - t2, t3 - t1 - t2
 
 
-def _apply_mat_stage(re, im, st: MatStage, gref, rows, pid):
+def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
     g = gref[...]
     gre, gim = g[0], g[1]
     f32 = jnp.float32
-
+    rows = geo.rows_eff
     hi = jax.lax.Precision.HIGHEST  # TPU dots default to bf16 passes;
     # f32 amplitudes need full-precision passes (norm drifts ~1e-3 else)
 
@@ -269,26 +349,37 @@ def _apply_mat_stage(re, im, st: MatStage, gref, rows, pid):
             return out.reshape(d, a, LANES).transpose(1, 0, 2) \
                       .reshape(rows, LANES)
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
-    else:  # b2: tile-axis contraction
-        d = st.dim
+    else:                        # 'sc': butterfly on one scattered axis
+        a = geo.scat.index(st.bit)
+        pre = 1 << a
+        post = (rows >> (a + 1)) * LANES
 
-        def contract(gg, x):
-            x2 = x.reshape(d, (rows // d) * LANES)
-            out = jax.lax.dot_general(
-                gg, x2, (((1,), (0,)), ((), ())),
-                preferred_element_type=f32, precision=hi)
-            return out.reshape(rows, LANES)
-        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+        def halves(x):
+            v = x.reshape(pre, 2, post)
+            return v[:, 0, :], v[:, 1, :]
 
-    mask = _mask_of(rows, pid, st.lane_preds, st.row_preds)
+        r0, r1 = halves(re)
+        i0, i1 = halves(im)
+
+        def cmul(cr, ci, xr, xi):
+            return cr * xr - ci * xi, cr * xi + ci * xr
+
+        a0r, a0i = cmul(gre[0, 0], gim[0, 0], r0, i0)
+        b0r, b0i = cmul(gre[0, 1], gim[0, 1], r1, i1)
+        a1r, a1i = cmul(gre[1, 0], gim[1, 0], r0, i0)
+        b1r, b1i = cmul(gre[1, 1], gim[1, 1], r1, i1)
+        nre = jnp.stack([a0r + b0r, a1r + b1r], axis=1).reshape(rows, LANES)
+        nim = jnp.stack([a0i + b0i, a1i + b1i], axis=1).reshape(rows, LANES)
+
+    mask = _mask_of(row_ids, st.lane_preds, st.row_preds)
     if mask is not None:
         nre = jnp.where(mask, nre, re)
         nim = jnp.where(mask, nim, im)
     return nre, nim
 
 
-def _apply_phase_stage(re, im, st: PhaseStage, rows, pid):
-    mask = _mask_of(rows, pid, st.lane_bits, st.row_bits)
+def _apply_phase_stage(re, im, st: PhaseStage, row_ids):
+    mask = _mask_of(row_ids, st.lane_bits, st.row_bits)
     tre, tim = np.float32(st.tre), np.float32(st.tim)
     nre = re * tre - im * tim
     nim = re * tim + im * tre
@@ -297,7 +388,7 @@ def _apply_phase_stage(re, im, st: PhaseStage, rows, pid):
     return jnp.where(mask, nre, re), jnp.where(mask, nim, im)
 
 
-def _apply_parity_stage(re, im, st: ParityStage, rows, pid):
+def _apply_parity_stage(re, im, st: ParityStage, row_ids):
     sign = None
     if st.lane_targets:
         ids = _lane_iota()
@@ -306,10 +397,9 @@ def _apply_parity_stage(re, im, st: ParityStage, rows, pid):
             s = s * (1.0 - 2.0 * ((ids >> q) & 1).astype(jnp.float32))
         sign = s
     if st.row_targets:
-        ids = _row_iota(rows, pid)
-        s = jnp.ones((rows, 1), dtype=jnp.float32)
+        s = jnp.ones(row_ids.shape, dtype=jnp.float32)
         for j in st.row_targets:
-            s = s * (1.0 - 2.0 * ((ids >> j) & 1).astype(jnp.float32))
+            s = s * (1.0 - 2.0 * ((row_ids >> j) & 1).astype(jnp.float32))
         sign = s if sign is None else sign * s
     half = st.angle / 2.0
     cosf = np.float32(np.cos(half))
@@ -319,77 +409,99 @@ def _apply_parity_stage(re, im, st: ParityStage, rows, pid):
     return nre, nim
 
 
-def _bit_of(q, rows, pid):
+def _bit_of(q, row_ids):
     """(broadcastable) value of bit `q` of each amplitude's global index."""
     if q < LANE_QUBITS:
         return (_lane_iota() >> q) & 1
-    return (_row_iota(rows, pid) >> (q - LANE_QUBITS)) & 1
+    return (row_ids >> (q - LANE_QUBITS)) & 1
 
 
-def _apply_diagvec_stage(re, im, st: DiagVecStage, rows, pid):
+def _apply_diagvec_stage(re, im, st: DiagVecStage, row_ids):
     k = len(st.targets)
     fre = jnp.full((1, 1), np.float32(st.dre[0]))
     fim = jnp.full((1, 1), np.float32(st.dim_[0]))
     for b in range(1, 1 << k):
         sel = None
         for j, q in enumerate(st.targets):
-            m = _bit_of(q, rows, pid) == ((b >> j) & 1)
+            m = _bit_of(q, row_ids) == ((b >> j) & 1)
             sel = m if sel is None else (sel & m)
         fre = jnp.where(sel, np.float32(st.dre[b]), fre)
         fim = jnp.where(sel, np.float32(st.dim_[b]), fim)
     nre = re * fre - im * fim
     nim = re * fim + im * fre
-    mask = _mask_of(rows, pid, st.lane_preds, st.row_preds)
+    mask = _mask_of(row_ids, st.lane_preds, st.row_preds)
     if mask is not None:
         nre = jnp.where(mask, nre, re)
         nim = jnp.where(mask, nim, im)
     return nre, nim
 
 
-def _segment_kernel(in_ref, *rest, stages, rows):
+def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
     num_mats = sum(isinstance(s, MatStage) for s in stages)
     mat_refs = rest[:num_mats]
     out_ref = rest[num_mats]
-    pid = pl.program_id(0)
+    pids = [pl.program_id(d) for d in range(len(geo.gaps))]
+    row_ids = _row_ids(geo, pids)
     blk = in_ref[...]
-    re, im = blk[0], blk[1]
+    re = blk[0].reshape(geo.rows_eff, LANES)
+    im = blk[1].reshape(geo.rows_eff, LANES)
     mi = 0
     for st in stages:
         if isinstance(st, MatStage):
-            re, im = _apply_mat_stage(re, im, st, mat_refs[mi], rows, pid)
+            re, im = _apply_mat_stage(re, im, st, mat_refs[mi], geo, row_ids)
             mi += 1
         elif isinstance(st, PhaseStage):
-            re, im = _apply_phase_stage(re, im, st, rows, pid)
+            re, im = _apply_phase_stage(re, im, st, row_ids)
         elif isinstance(st, DiagVecStage):
-            re, im = _apply_diagvec_stage(re, im, st, rows, pid)
+            re, im = _apply_diagvec_stage(re, im, st, row_ids)
         else:
-            re, im = _apply_parity_stage(re, im, st, rows, pid)
-    out_ref[0] = re
-    out_ref[1] = im
+            re, im = _apply_parity_stage(re, im, st, row_ids)
+    shape = out_ref.shape
+    out_ref[...] = jnp.stack([re, im]).reshape(shape)
 
 
 def compile_segment(stages: Sequence, n: int,
-                    block_row_bits: int = DEFAULT_BLOCK_ROW_BITS,
+                    rows_eff_bits: int = ROWS_EFF_BITS,
                     interpret: bool = False):
     """Build fn(amps, mat_arrays) -> amps applying `stages` in one kernel
-    launch (grid over contiguous row blocks)."""
-    total_rows = 1 << (n - LANE_QUBITS)
-    rows = min(1 << block_row_bits, total_rows)
-    grid = (total_rows // rows,)
+    launch (grid over the row axes outside the block)."""
+    total_row_bits = n - LANE_QUBITS
+    rows_eff_bits = min(rows_eff_bits, total_row_bits)
+    scat_bits = {st.bit for st in stages
+                 if isinstance(st, MatStage) and st.kind == "sc"}
+    # the sublane band's contraction needs its whole operator in-block
+    b1_bits = max((st.dim.bit_length() - 1 for st in stages
+                   if isinstance(st, MatStage) and st.kind == "b1"),
+                  default=0)
+    rows_eff_bits = max(rows_eff_bits, b1_bits + len(scat_bits))
+    geo = _geometry(n, scat_bits, rows_eff_bits)
+    dims, blocks = geo.view_dims()
+    grid = tuple(1 << w for (lo, w) in geo.gaps)
+    grid_axes = [i for i, b in enumerate(blocks) if b == 1]
+
+    def index_map(*ids):
+        out = [0] * (len(dims) + 2)   # + plane axis, + lane axis
+        for ax, i in zip(grid_axes, ids):
+            out[1 + ax] = i
+        return tuple(out)
+
+    block_shape = (2, *blocks, LANES)
+    view_shape = (2, *dims, LANES)
 
     mat_stages = [s for s in stages if isinstance(s, MatStage)]
     kernel = functools.partial(_segment_kernel, stages=tuple(stages),
-                               rows=rows)
-    in_specs = [pl.BlockSpec((2, rows, LANES), lambda i: (0, i, 0))]
+                               geo=geo)
+    in_specs = [pl.BlockSpec(block_shape, index_map)]
     for st in mat_stages:
-        in_specs.append(pl.BlockSpec((2, st.dim, st.dim),
-                                     lambda i: (0, 0, 0)))
+        d = st.dim
+        in_specs.append(
+            pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
     fn = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((2, rows, LANES), lambda i: (0, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((2, total_rows, LANES), jnp.float32),
+        out_specs=pl.BlockSpec(block_shape, index_map),
+        out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
         input_output_aliases={0: 0},  # in-place on the state buffer
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=VMEM_LIMIT_BYTES),
@@ -397,8 +509,16 @@ def compile_segment(stages: Sequence, n: int,
     )
 
     def apply(amps, mat_arrays):
-        out = fn(amps.reshape(2, total_rows, LANES), *mat_arrays)
-        return out.reshape(2, -1)
+        # callers keep the state in (2, rows, 128) between segments: that
+        # shape and every segment view share the same (8, 128) physical
+        # tiling, so these reshapes are free bitcasts. A flat (2, 2^n)
+        # boundary would get XLA's T(2,128) tiling and cost a whole-state
+        # retile copy per dispatch (the 8 GB HLO temp that OOMed 30q).
+        # The kernel is pure f32/int32; trace it with x64 disabled —
+        # under jax_enable_x64 stray int64 ops fail Mosaic legalization.
+        with jax.enable_x64(False):
+            out = fn(amps.reshape(view_shape), *mat_arrays)
+        return out.reshape(2, -1, LANES)
 
     return apply
 

@@ -25,6 +25,7 @@ surface; the planes are the matching real dtype.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -73,12 +74,36 @@ def _planes(num_state_qubits: int, rdt):
     return jnp.zeros((2, 1 << num_state_qubits), dtype=rdt)
 
 
+@partial(jax.jit, static_argnames=("n", "rdt"))
+def _basis_planes_hl(hi, lo, *, n, rdt):
+    """Planes of a computational-basis state built in ONE fused buffer
+    (zeros().at[...].set() briefly materializes TWO full-state buffers —
+    at 30 qubits that is 16 GB and exhausts the chip's HBM). The target
+    index arrives split as (index >> 20, index & 0xFFFFF) so every iota
+    stays within int32 regardless of jax_enable_x64 (int64 iotas silently
+    truncate when x64 is off)."""
+    lo_bits = min(n, 20)
+    view = (2, 1 << (n - lo_bits), 1 << lo_bits)
+    ih = jax.lax.broadcasted_iota(jnp.int32, view, 1)
+    il = jax.lax.broadcasted_iota(jnp.int32, view, 2)
+    plane = jax.lax.broadcasted_iota(jnp.int32, view, 0)
+    hit = (ih == hi) & (il == lo) & (plane == 0)
+    return jnp.where(hit, 1.0, 0.0).astype(rdt).reshape(2, 1 << n)
+
+
+def _basis_planes(flat_index, *, n, rdt):
+    lo_bits = min(n, 20)
+    return _basis_planes_hl(int(flat_index) >> lo_bits,
+                            int(flat_index) & ((1 << lo_bits) - 1),
+                            n=n, rdt=rdt)
+
+
 def _make(num_qubits: int, is_density: bool, dtype, sharding=None) -> Qureg:
     validation.validate_num_qubits(num_qubits)
     dtype = np.dtype(dtype) if dtype is not None else precision.get_default_dtype()
     n = 2 * num_qubits if is_density else num_qubits
     rdt = precision.real_dtype_of(dtype)
-    amps = _planes(n, rdt).at[0, 0].set(1.0)
+    amps = _basis_planes(0, n=n, rdt=rdt)
     if sharding is not None:
         amps = jax.device_put(amps, sharding)
     return Qureg(amps=amps, num_qubits=num_qubits, is_density=is_density)
@@ -121,8 +146,8 @@ def init_blank_state(qureg: Qureg) -> Qureg:
 
 def init_zero_state(qureg: Qureg) -> Qureg:
     """|0...0> or |0..0><0..0|."""
-    return qureg.replace_amps(
-        _planes(qureg.num_state_qubits, qureg.real_dtype).at[0, 0].set(1.0))
+    return qureg.replace_amps(_basis_planes(
+        0, n=qureg.num_state_qubits, rdt=qureg.real_dtype))
 
 
 def init_plus_state(qureg: Qureg) -> Qureg:
@@ -145,8 +170,8 @@ def init_classical_state(qureg: Qureg, state_index: int) -> Qureg:
         flat = state_index + (state_index << qureg.num_qubits)
     else:
         flat = state_index
-    return qureg.replace_amps(
-        _planes(qureg.num_state_qubits, qureg.real_dtype).at[0, flat].set(1.0))
+    return qureg.replace_amps(_basis_planes(
+        flat, n=qureg.num_state_qubits, rdt=qureg.real_dtype))
 
 
 def init_debug_state(qureg: Qureg) -> Qureg:

@@ -33,7 +33,6 @@ def timeit(jfn, amps, reps, label, n, passes=1):
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
-    brb = 11
     print("devices:", jax.devices(), flush=True)
 
     tiny = jnp.zeros((8, 128), dtype=jnp.float32)
@@ -50,17 +49,18 @@ def main():
     rng = np.random.default_rng(0)
     q, _ = np.linalg.qr(rng.standard_normal((128, 128)))
     g = jnp.asarray(np.stack([q, q * 0.1]).astype(np.float32))
-    seg = PB.compile_segment([PB.MatStage("b0", 128, False, (), ())], n, brb)
+    seg = PB.compile_segment([PB.MatStage("b0", 128, False, (), ())], n)
 
+    amps3 = amps.reshape(2, -1, 128)
     jfn = jax.jit(lambda a: seg(a, [g]), donate_argnums=(0,))
-    amps = timeit(jfn, amps, 20, "pallas b0 (1 pass)", n)
+    amps3 = timeit(jfn, amps3, 20, "pallas b0 (1 pass)", n)
 
     def eight(a):
         for _ in range(8):
             a = seg(a, [g])
         return a
     jfn = jax.jit(eight, donate_argnums=(0,))
-    amps = timeit(jfn, amps, 20, "pallas b0 (8 passes)", n, passes=8)
+    amps3 = timeit(jfn, amps3, 20, "pallas b0 (8 passes)", n, passes=8)
 
 
 if __name__ == "__main__":

@@ -15,16 +15,17 @@ enable_compile_cache()
 from quest_tpu.ops import pallas_band as PB
 
 
-def seg(stages, arrays, n, brb, reps=20):
-    fn = PB.compile_segment(stages, n, brb)
+def seg(stages, arrays, n, reps=20):
+    fn = PB.compile_segment(stages, n)
     jfn = jax.jit(lambda a: fn(a, arrays), donate_argnums=(0,))
-    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    amps = jnp.zeros((2, 1 << (n - 7), 128),
+                     dtype=jnp.float32).at[0, 0, 0].set(1.0)
     amps = jfn(amps)
-    _ = np.asarray(amps[0, :4])
+    _ = np.asarray(amps[0, 0, :4])
     t0 = time.perf_counter()
     for _ in range(reps):
         amps = jfn(amps)
-    _ = np.asarray(amps[0, :4])
+    _ = np.asarray(amps[0, 0, :4])
     dt = (time.perf_counter() - t0) / reps
     bw = 2 * 2 * (1 << n) * 4 / dt
     return dt * 1e3, bw / 1e9
@@ -39,26 +40,26 @@ def g_input(d, real=False):
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 26
-    brb = 11
     print("devices:", jax.devices(), flush=True)
-    d2 = 1 << (brb - 7)
+    hi_bit = n - 8  # a scattered (grid-range) row bit
     cases = {
         "b0 (complex)": ([PB.MatStage("b0", 128, False, (), ())],
                           [g_input(128)]),
         "b0 (real)": ([PB.MatStage("b0", 128, True, (), ())],
                        [g_input(128, real=True)]),
         "b1": ([PB.MatStage("b1", 128, False, (), ())], [g_input(128)]),
-        "b2": ([PB.MatStage("b2", d2, False, (), ())], [g_input(d2)]),
+        "sc": ([PB.MatStage("sc", 2, False, (), (), hi_bit)],
+               [g_input(2)]),
         "parity": ([PB.ParityStage((1, 3), (2, 12), 0.3)], []),
-        "b0+b1+b2": ([PB.MatStage("b0", 128, False, (), ()),
+        "b0+b1+sc": ([PB.MatStage("b0", 128, False, (), ()),
                       PB.MatStage("b1", 128, False, (), ()),
-                      PB.MatStage("b2", d2, False, (), ())],
-                     [g_input(128), g_input(128), g_input(d2)]),
+                      PB.MatStage("sc", 2, False, (), (), hi_bit)],
+                     [g_input(128), g_input(128), g_input(2)]),
         "b0 x3": ([PB.MatStage("b0", 128, False, (), ())] * 3,
                   [g_input(128)] * 3),
     }
     for name, (stages, arrays) in cases.items():
-        ms, bw = seg(stages, arrays, n, brb)
+        ms, bw = seg(stages, arrays, n)
         print(f"{name:14s}: {ms:7.2f} ms/pass   {bw:6.1f} GB/s r+w", flush=True)
 
 

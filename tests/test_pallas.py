@@ -16,11 +16,9 @@ from quest_tpu.state import to_dense
 N = 10  # 8 rows x 128 lanes — the smallest cleanly-tiled register
 
 
-def parts_of(c: Circuit, n=N, brb=None):
-    if brb is None:
-        brb = min(PB.DEFAULT_BLOCK_ROW_BITS, n - PB.LANE_QUBITS)
-    items = F.plan(c.ops, n, bands=PB.plan_bands(n, brb))
-    return PB.segment_plan(items, n, brb)
+def parts_of(c: Circuit, n=N, scatter_max=PB.SCATTER_MAX):
+    items = F.plan(c.ops, n, bands=PB.plan_bands(n))
+    return PB.segment_plan(items, n, scatter_max)
 
 
 def check(circ: Circuit, n=N, density=False, tol=1e-5):
@@ -109,36 +107,38 @@ def test_segment_break_on_cross_band_gate():
     check(c)
 
 
-def test_band_above_block_top_goes_xla():
-    n = 12
-    brb = 2               # block top = qubit 9
+def test_scattered_qubits_fuse():
+    """Gates on high qubits become scattered-axis stages — no XLA
+    passthrough until SCATTER_MAX distinct high qubits are in play."""
+    n = 16
     c = Circuit(n)
     c.h(0)
-    c.h(10)               # band above the block top
-    parts = parts_of(c, n=n, brb=brb)
-    kinds = [p[0] for p in parts]
-    assert kinds.count("xla") == 1 and kinds.count("segment") == 1
-    # numerics via a custom-brb compile
-    items = F.plan(c.ops, n, bands=PB.plan_bands(n, brb))
-    parts = PB.segment_plan(items, n, brb)
+    for q in (14, 15):
+        c.ry(q, 0.1 * q)      # scattered qubits
+    parts = parts_of(c, n=n)
+    assert [p[0] for p in parts] == ["segment"]
+    kinds = [s.kind for s in parts[0][1]]
+    assert kinds.count("sc") == 2
+    check(c, n=n)
+
+
+def test_scatter_overflow_splits_segment():
+    n = 16
+    c = Circuit(n)
+    for q in range(14, 16):
+        c.h(q)
+    parts = parts_of(c, n=n, scatter_max=1)
+    assert [p[0] for p in parts] == ["segment", "segment"]
+    # numerics at the tiny scatter budget
     import jax.numpy as jnp
-    from quest_tpu.ops import apply as A
     amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
     for part in parts:
-        if part[0] == "segment":
-            amps = PB.compile_segment(part[1], n, brb, interpret=True)(
-                amps, part[2])
-        else:
-            it = part[1]
-            amps = A.apply_band(amps, n, (it.gre, it.gim), it.ql, it.w,
-                                it.preds)
-    c2 = Circuit(n)
-    c2.h(0)
-    c2.h(10)
-    want = c2.compiled(n, density=False, donate=False)(
+        amps = PB.compile_segment(part[1], n, interpret=True)(
+            amps, part[2])
+    want = c.compiled(n, density=False, donate=False)(
         jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0))
-    np.testing.assert_allclose(np.asarray(amps), np.asarray(want),
-                               atol=1e-5, rtol=0)
+    np.testing.assert_allclose(np.asarray(amps.reshape(2, -1)),
+                               np.asarray(want), atol=1e-5, rtol=0)
 
 
 def test_random_circuit_fused_matches():
@@ -164,27 +164,26 @@ def test_multi_block_grid():
     """Small block size -> many grid blocks: pid-dependent paths (global
     row ids for masks/diagonals/parity, BlockSpec index maps) must agree
     with the XLA engine."""
-    n = 12  # 32 rows; brb=3 -> grid of 4 blocks of 8 rows
-    brb = 3
+    n = 17  # rows_eff_bits=7 -> grid over 8 blocks of 128 rows
     c = Circuit(n)
     c.h(0)
     c.h(8)               # sublane butterfly within a block
-    c.rz(11, 0.3)        # parity on a grid row bit
+    c.rz(16, 0.3)        # parity on a grid row bit
     c.s(7)
-    c.x(1, 11)           # lane target controlled on a GRID row qubit
-    c.cz(2, 10)          # phase with a grid row bit
-    items = F.plan(c.ops, n, bands=PB.plan_bands(n, brb))
-    parts = PB.segment_plan(items, n, brb)
+    c.x(1, 16)           # lane target controlled on a GRID row qubit
+    c.cz(2, 15)          # phase with a grid row bit
+    items = F.plan(c.ops, n, bands=PB.plan_bands(n))
+    parts = PB.segment_plan(items, n)
     assert [p[0] for p in parts] == ["segment"]
     import jax.numpy as jnp
     amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
     for part in parts:
-        amps = PB.compile_segment(part[1], n, brb, interpret=True)(
-            amps, part[2])
+        amps = PB.compile_segment(part[1], n, rows_eff_bits=7,
+                                  interpret=True)(amps, part[2])
     want = c.compiled(n, density=False, donate=False)(
         jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0))
-    np.testing.assert_allclose(np.asarray(amps), np.asarray(want),
-                               atol=1e-5, rtol=0)
+    np.testing.assert_allclose(np.asarray(amps.reshape(2, -1)),
+                               np.asarray(want), atol=1e-5, rtol=0)
 
 
 def test_small_register_falls_back():
