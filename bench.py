@@ -67,14 +67,10 @@ def _basis_state(shape):
     """|0...0> planes built in ONE fused device buffer (zeros().at.set()
     would briefly hold two full-state buffers)."""
     import jax.numpy as jnp
+    from quest_tpu.state import _basis_planes
 
-    @jax.jit
-    def init():
-        flat = jax.lax.broadcasted_iota(
-            jnp.int32, (int(np.prod(shape)),), 0)
-        return jnp.where(flat == 0, 1.0, 0.0).astype(
-            jnp.float32).reshape(shape)
-    return init()
+    n = int(np.prod(shape)).bit_length() - 2  # shape holds 2 * 2^n reals
+    return _basis_planes(0, n=n, rdt=jnp.float32).reshape(shape)
 
 
 def _warm_step(n: int):

@@ -77,19 +77,25 @@ def bench_tutorial():
 def bench_rcs():
     from quest_tpu.circuit import random_circuit
 
-    n = 26 if _on_tpu() else 20
+    n = 28 if _on_tpu() else 20
     depth = 20
     circ = random_circuit(n, depth, seed=1)
     num_gates = len(circ.ops)
-    fn = circ.compiled(n, density=False, donate=True)
-    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    if _on_tpu():
+        # fused band-segment engine with its native (2, rows, 128) state
+        fn = circ.compiled_fused(n, density=False, donate=True)
+        amps = jnp.zeros((2, 1 << (n - 7), 128), dtype=jnp.float32)
+        amps = amps.at[0, 0, 0].set(1.0)
+    else:
+        fn = circ.compiled_banded(n, density=False, donate=True)
+        amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
     amps = fn(amps)
-    _sync(amps)
+    np.asarray(amps.ravel()[:1])
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
         amps = fn(amps)
-    _sync(amps)
+    np.asarray(amps.ravel()[:1])
     dt = (time.perf_counter() - t0) / reps
     _emit("rcs", f"RCS depth-{depth} @ {n}q wall-clock", dt * 1000, "ms/run",
           gates_per_sec=round(num_gates / dt, 1))

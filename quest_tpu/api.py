@@ -100,10 +100,10 @@ def reportQuESTEnv(env: QuESTEnv) -> None:
 
 
 def getEnvironmentString(env: QuESTEnv, qureg: "Qureg" = None) -> str:
-    s = env.get_environment_string()
-    if qureg is not None:
-        s = f"{qureg.numQubitsRepresented}qubits_{s}"
-    return s
+    # the reference formats qureg.numQubitsInStateVec — the DOUBLED count
+    # for density matrices (QuEST_cpu.c:1363), not numQubitsRepresented
+    n = qureg.state.num_state_qubits if qureg is not None else None
+    return env.get_environment_string(n)
 
 
 def seedQuEST(seeds: Sequence[int]) -> None:

@@ -350,6 +350,10 @@ class Circuit:
         flat = self._flat_ops(n, density)
         items = F.plan(flat, n, bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
+        # f64 registers use the XLA band path, which composes best with
+        # the default 7-wide band layout (the Pallas plan's width-1 high
+        # bands would cost one pass per high qubit)
+        items64 = F.plan(flat, n)
         appliers = []   # segment appliers work on (2, rows, 128); XLA
         # passthroughs flatten and restore around their op
         for part in parts:
@@ -376,7 +380,7 @@ class Circuit:
             # precision on the XLA band path
             if amps.dtype != jnp.float32:
                 flat_in = amps.reshape(2, -1)
-                out = _loop(lambda a: _apply_banded_items(a, n, items),
+                out = _loop(lambda a: _apply_banded_items(a, n, items64),
                             flat_in, iters)
                 return out.reshape(amps.shape)
             shape = amps.shape

@@ -58,11 +58,16 @@ class QuESTEnv:
         """Block until all queued device work completes (ref syncQuESTEnv)."""
         jax.effects_barrier()
 
-    def get_environment_string(self) -> str:
-        """Benchmark-label tag (ref getEnvironmentString,
-        QuEST_cpu.c:1358-1364)."""
+    def get_environment_string(self, num_state_qubits: int = None) -> str:
+        """Benchmark-label tag in the reference's documented format
+        "{n}qubits_{PLATFORM}_{r}ranksx{t}threads" (getEnvironmentString,
+        QuEST_cpu.c:1358-1364; platform replaces "CPU", device count plays
+        the rank role, 1 thread per device core)."""
         plat = self.devices[0].platform.upper() if self.devices else "CPU"
-        return f"{plat}_{self.num_ranks}devices"
+        tag = f"{plat}_{self.num_ranks}ranksx1threads"
+        if num_state_qubits is not None:
+            tag = f"{num_state_qubits}qubits_{tag}"
+        return tag
 
     def report(self) -> str:
         s = (f"EXECUTION ENVIRONMENT:\nRunning distributed (MPI) version: "

@@ -46,6 +46,19 @@ import numpy as np
 
 BAND_W = 7  # qubits per hardware axis: 2^7 = 128 lanes / sublanes / tiles
 
+_SWAP_MATRIX = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                         [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.complex128)
+
+
+@dataclasses.dataclass(frozen=True)
+class _PhaseOp:
+    """Synthetic GateOp-shaped record for planner-generated phase ops."""
+    kind: str
+    targets: Tuple[int, ...]
+    controls: Tuple[int, ...]
+    cstates: Tuple[int, ...]
+    operand: object
+
 
 # ---------------------------------------------------------------------------
 # plan items
@@ -238,8 +251,54 @@ def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List
 
         tbands = {band_of(t) for t in targets}
         if len(tbands) != 1:
-            # cross-band multi-target unitary (superop targets, swaps across
-            # bands, ...) — general apply path
+            # cross-band SWAP: decompose into 3 CNOTs (each a 1q target
+            # with a control — controls fuse as masks, so the whole swap
+            # stays in-kernel). The reference instead relabels qubits via
+            # distributed swaps (QuEST_cpu_distributed.c:1441-1483).
+            if (op.kind == "matrix" and len(targets) == 2 and not controls
+                    and operand.shape == (4, 4)
+                    and np.allclose(operand, _SWAP_MATRIX)):
+                a_q, b_q = targets
+                x_mat = np.array([[0.0, 1.0], [1.0, 0.0]])
+                for tgt, ctl in ((b_q, a_q), (a_q, b_q), (b_q, a_q)):
+                    # targets sit in different bands, so the control is
+                    # always out-of-band: a masked-matmul predicate
+                    b = band_of(tgt)
+                    ql, w = band_rng(b)
+                    preds = ((ctl, 1),)
+                    emb = embed_operator(x_mat, [tgt - ql], [], [], w)
+                    nd = frozenset((tgt,))
+                    tc = frozenset((tgt, ctl))
+                    if not try_merge(b, emb, preds, nd, tc):
+                        items.append(BandOp(ql, w, emb.real, emb.imag,
+                                            preds, nd, tc))
+                continue
+            # general cross-band 2q UNITARY: KAK-decompose into local 1q
+            # factors + parity rotations (quest_tpu/ops/kak.py) — every
+            # piece fuses, so the gate never leaves the kernel
+            if (op.kind == "matrix" and len(targets) == 2 and not controls
+                    and operand.shape == (4, 4)
+                    and np.allclose(operand @ operand.conj().T, np.eye(4),
+                                    atol=1e-9)):
+                from quest_tpu.ops import kak as K
+                for item in K.kak_gate_sequence(operand, *targets):
+                    if item[0] == "1q":
+                        _, tq, mat = item
+                        b = band_of(tq)
+                        ql, w = band_rng(b)
+                        emb = embed_operator(mat, [tq - ql], [], [], w)
+                        nd, tc = frozenset((tq,)), frozenset((tq,))
+                        if not try_merge(b, emb, (), nd, tc):
+                            items.append(BandOp(ql, w, emb.real, emb.imag,
+                                                (), nd, tc))
+                    else:
+                        _, pq, ang = item
+                        pop = _PhaseOp("parity", tuple(pq), (), (),
+                                       float(ang))
+                        items.append(DiagItem(pop, frozenset(pq)))
+                continue
+            # remaining cross-band multi-target ops (superop targets,
+            # controlled 2q across bands, non-unitary) — general apply path
             items.append(PassOp(op, frozenset(targets),
                                 frozenset(targets) | frozenset(controls)))
             continue

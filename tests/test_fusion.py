@@ -121,14 +121,30 @@ def test_cross_band_control_becomes_pred():
     assert items[0].preds == ((8, 1),)
 
 
-def test_cross_band_two_qubit_unitary_passes_through():
+def test_cross_band_two_qubit_unitary_kak_decomposes():
     rng = np.random.default_rng(11)
     n = 9
     u = oracle.random_unitary(2, rng)
     c = Circuit(n)
     c.gate(u, (2, 8))
     items = F.plan(c.ops, n)
-    assert len(items) == 1 and isinstance(items[0], F.PassOp)
+    # KAK: local band ops + parity rotations, no PassOp
+    assert not any(isinstance(it, F.PassOp) for it in items)
+    got = banded_state(c, n)
+    vec = np.zeros(1 << n, dtype=np.complex128)
+    vec[0] = 1.0
+    want = oracle.apply_to_vector(vec, n, u, [2, 8])
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=0)
+
+
+def test_cross_band_controlled_2q_passes_through():
+    rng = np.random.default_rng(12)
+    n = 9
+    u = oracle.random_unitary(2, rng)
+    c = Circuit(n)
+    c.cu(u, (2, 8), 5)     # control makes it non-KAK-able
+    items = F.plan(c.ops, n)
+    assert any(isinstance(it, F.PassOp) for it in items)
 
 
 # ---------------------------------------------------------------------------

@@ -93,18 +93,37 @@ def test_controls_every_position():
     check(c)
 
 
-def test_segment_break_on_cross_band_gate():
+def test_cross_band_2q_fuses_via_kak():
     rng = np.random.default_rng(3)
     z = rng.normal(size=(4, 4)) + 1j * rng.normal(size=(4, 4))
     u, _ = np.linalg.qr(z)
     c = Circuit(N)
     c.h(0)
-    c.gate(u, (3, 8))     # cross-band 2q unitary -> XLA passthrough
+    c.gate(u, (3, 8))     # cross-band 2q unitary -> KAK, stays fused
     c.h(9)
     parts = parts_of(c)
-    kinds = [p[0] for p in parts]
-    assert "xla" in kinds
-    check(c)
+    assert [p[0] for p in parts] == ["segment"]
+    check(c, tol=5e-5)
+
+
+def test_cross_band_superop_passes_through():
+    # 6q density register: superop targets (1, 7) straddle bands and the
+    # superoperator is non-unitary, so it must fall through to XLA
+    c = Circuit(6)
+    c.damping(1, 0.2)
+    items = F.plan(c._flat_ops(12, True), 12, bands=PB.plan_bands(12))
+    parts = PB.segment_plan(items, 12)
+    assert "xla" in [p[0] for p in parts]
+
+
+def test_small_register_superop_fuses():
+    # 4q density register: superop targets (1, 5) sit in ONE band, so the
+    # (non-unitary) superoperator embeds straight into the band operator
+    c = Circuit(4)
+    c.damping(1, 0.2)
+    items = F.plan(c._flat_ops(8, True), 8, bands=PB.plan_bands(8))
+    parts = PB.segment_plan(items, 8)
+    assert [p[0] for p in parts] == ["segment"]
 
 
 def test_scattered_qubits_fuse():
