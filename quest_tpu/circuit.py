@@ -54,6 +54,31 @@ def dual_of(op: GateOp, shift: int):
 _LOOP_UNROLL_MAX = 32
 
 
+def flatten_ops(ops, n: int, density: bool) -> List[GateOp]:
+    """Expand density duals into a flat op list (ref QuEST.c:8-10);
+    superops become explicit matrix ops on the doubled targets. The ONE
+    place this expansion lives — every engine (XLA, banded, fused,
+    sharded) flattens through here."""
+    if not density and any(op.kind == "superop" for op in ops):
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid operation: noise channels require a density-matrix "
+            "register")
+    flat: List[GateOp] = []
+    for op in ops:
+        if op.kind == "superop":
+            flat.append(dataclasses.replace(
+                op, kind="matrix",
+                targets=M.superop_targets(op.targets, n // 2)))
+            continue
+        flat.append(op)
+        if density:
+            dual = dual_of(op, n // 2)
+            if dual is not None:
+                flat.append(dual)
+    return flat
+
+
 def _loop(body, amps, iters: int):
     """Apply `body` to the state `iters` times inside one program, so deep
     repetition costs ONE dispatch (dispatch through the TPU tunnel costs
@@ -269,26 +294,7 @@ class Circuit:
         return q.replace_amps(self.compiled(n, q.is_density, donate)(q.amps))
 
     def _flat_ops(self, n: int, density: bool) -> List[GateOp]:
-        """Expand density duals into a flat op list (ref QuEST.c:8-10);
-        superops become explicit matrix ops on the doubled targets."""
-        if not density and any(op.kind == "superop" for op in self.ops):
-            from quest_tpu.validation import QuESTError
-            raise QuESTError(
-                "Invalid operation: noise channels require a density-matrix "
-                "register")
-        flat: List[GateOp] = []
-        for op in self.ops:
-            if op.kind == "superop":
-                flat.append(dataclasses.replace(
-                    op, kind="matrix",
-                    targets=M.superop_targets(op.targets, n // 2)))
-                continue
-            flat.append(op)
-            if density:
-                dual = dual_of(op, n // 2)
-                if dual is not None:
-                    flat.append(dual)
-        return flat
+        return flatten_ops(self.ops, n, density)
 
     def compiled_banded(self, n: int, density: bool, donate: bool = True,
                         iters: int = 1):
@@ -416,6 +422,31 @@ class Circuit:
             fn = S.compile_circuit_sharded(self.ops, n, density, mesh, donate)
             self._compiled[key] = fn
         return fn
+
+    def compiled_sharded_banded(self, n: int, density: bool, mesh,
+                                donate: bool = True):
+        """Band-fusion engine over the device mesh (one shard_map program;
+        see quest_tpu.parallel.sharded.compile_circuit_sharded_banded)."""
+        from quest_tpu.parallel import sharded as S
+        key = ("sharded-banded", n, density, id(mesh),
+               int(mesh.devices.size), donate)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = S.compile_circuit_sharded_banded(self.ops, n, density, mesh,
+                                                  donate)
+            self._compiled[key] = fn
+        return fn
+
+    def apply_sharded_banded(self, q: Qureg, mesh,
+                             donate: bool = False) -> Qureg:
+        """Apply via the band-fusion shard_map engine."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        from quest_tpu.parallel import mesh as MM
+        fn = self.compiled_sharded_banded(q.num_state_qubits, q.is_density,
+                                          mesh, donate)
+        amps = jax.device_put(q.amps, MM.amp_sharding(mesh))
+        return q.replace_amps(fn(amps))
 
     def apply_sharded(self, q: Qureg, mesh, donate: bool = False) -> Qureg:
         """Apply via the explicit shard_map engine on a mesh-sharded register."""

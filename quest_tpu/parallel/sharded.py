@@ -300,6 +300,80 @@ def _apply_gateop(chunk, dev, *, D, local_n, density, op):
     return chunk
 
 
+def _shard_bands(n: int, local_n: int):
+    """Band layout aligned to the shard boundary: full-width bands inside
+    the local chunk, width-1 bands for global (device-index) qubits — the
+    distributed analogue of pallas_band.plan_bands, so composed runs stay
+    local and each global qubit costs exactly one pair exchange."""
+    from quest_tpu.ops.fusion import BAND_W
+    bands = []
+    ql = 0
+    while ql < local_n:
+        w = min(BAND_W, local_n - ql)
+        bands.append((ql, w))
+        ql += w
+    for q in range(local_n, n):
+        bands.append((q, 1))
+    return bands
+
+
+def _band_op_sharded(chunk, dev, *, D, local_n, bop):
+    """A composed BandOp on the sharded register: local bands apply as one
+    in-chunk contraction; width-1 global bands ride the single-qubit pair
+    exchange. Cross-shard controls become whole-chunk predicates."""
+    if bop.ql >= local_n:          # global qubit: 2x2 via pair exchange
+        return _matrix_op(chunk, dev, D=D, local_n=local_n,
+                          m_pair=(bop.gre, bop.gim), targets=[bop.ql],
+                          controls=[q for q, _ in bop.preds],
+                          cstates=[s for _, s in bop.preds])
+    loc_p = [(q, s) for q, s in bop.preds if q < local_n]
+    glob_p = [(q - local_n, s) for q, s in bop.preds if q >= local_n]
+    pred = _global_pred(dev, glob_p)
+    new = A.apply_band(chunk, local_n, (bop.gre, bop.gim), bop.ql, bop.w,
+                       loc_p)
+    if pred is not None:
+        new = jnp.where(pred, new, chunk)
+    return new
+
+
+def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
+                                   mesh: Mesh, donate: bool = True):
+    """Band-fusion engine over the mesh: the same planner that drives the
+    single-chip engines (quest_tpu/ops/fusion.py), with bands aligned to
+    the shard boundary. Commuting gate runs on local qubits compose into
+    one contraction per band; global-qubit runs compose into one 2x2 per
+    qubit (ONE ppermute pair exchange each — the reference would exchange
+    once per gate, QuEST_cpu_distributed.c:846-881); cross-shard 2q
+    unitaries KAK-decompose so their entangling content travels as
+    communication-free parity phases."""
+    from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
+
+    D = int(mesh.devices.size)
+    g = int(math.log2(D))
+    local_n = n - g
+    if local_n < 1:
+        raise ValueError("register too small for mesh")
+    flat = flatten_ops(ops, n, density)
+    items = F.plan(flat, n, bands=_shard_bands(n, local_n))
+
+    def run(chunk):
+        chunk = chunk.reshape(2, -1)
+        dev = lax.axis_index(AMP_AXIS)
+        for it in items:
+            if isinstance(it, F.BandOp):
+                chunk = _band_op_sharded(chunk, dev, D=D, local_n=local_n,
+                                         bop=it)
+            else:
+                chunk = _apply_gateop(chunk, dev, D=D, local_n=local_n,
+                                      density=False, op=it.op)
+        return chunk
+
+    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
+                            out_specs=P(None, AMP_AXIS))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
 def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
                             donate: bool = True):
     """Compile a gate sequence into ONE shard_map program over the mesh —
