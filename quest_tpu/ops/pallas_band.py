@@ -116,6 +116,29 @@ class ParityStage:
 
 
 @dataclasses.dataclass(frozen=True)
+class PairStage:
+    """General (possibly non-unitary) 2-qubit matrix on (q_op, q_sliced):
+    the sliced qubit's two halves select 2x2 blocks M[r][c], each applied
+    on the op-side qubit — out_r = sum_c M_rc x_c. This is how Kraus
+    superoperators on the doubled density register (targets (t, t+N),
+    ref QuEST_common.c:540-673) stay fused at any register size.
+
+    op_kind: 'lane' (M_rc embedded 128x128, right-matmul) |
+             'b1'   (M_rc embedded 128x128 on the sublane axis) |
+             'sc'   (M_rc 2x2 scalars; q_op has its own scattered axis)
+    sliced_kind: 'scat' (own scattered axis) | 'sub' (sublane bit; only
+             valid when op_kind == 'lane')."""
+    op_kind: str
+    op_dim: int                               # 128 or 2
+    op_bit: int                               # 'sc': GLOBAL row bit
+    sliced_kind: str
+    sliced_bit: int                           # GLOBAL row bit
+    real_only: bool
+    lane_preds: Tuple[Tuple[int, int], ...]
+    row_preds: Tuple[Tuple[int, int], ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class DiagVecStage:
     """General k-qubit diagonal: multiply each amplitude by the entry
     selected by its target-bit pattern (identity where controls unmet).
@@ -219,10 +242,96 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
             flush()
             parts.append(("xla", it))
             continue
+        if isinstance(it, F.PassOp):
+            st = _try_pair_stage(it, scatter_max)
+            if st is not None:
+                stage, arr, new_scat = st
+                if new_scat is not None and new_scat - scat_bits:
+                    if len(scat_bits | new_scat) > scatter_max:
+                        flush()
+                    scat_bits |= new_scat
+                stages.append(stage)
+                arrays.append(arr)
+                continue
         flush()
         parts.append(("xla", it))
     flush()
     return parts
+
+
+def _try_pair_stage(it, scatter_max):
+    """PassOp -> (PairStage, operand array, scat bits needed) when the op
+    is an uncontrolled 2-target matrix whose qubits the kernel can reach;
+    None otherwise."""
+    op = it.op
+    if op.kind != "matrix" or len(op.targets) != 2 or op.controls:
+        return None
+    m = np.asarray(op.operand)
+    if m.shape != (4, 4) or not np.issubdtype(m.dtype, np.number):
+        return None
+    qa, qb = op.targets           # matrix bit 0 = qa, bit 1 = qb
+
+    def locate(q):
+        if q < LANE_QUBITS:
+            return "lane"
+        if q < 14:
+            return "sub"
+        return "scat"
+
+    la, lb = locate(qa), locate(qb)
+    # pick the sliced qubit: prefer a scattered one; a sublane qubit may
+    # only be sliced when the op side is a lane qubit
+    if lb == "scat":
+        q_op, q_sl, bit_op = qa, qb, 0
+    elif la == "scat":
+        q_op, q_sl, bit_op = qb, qa, 1
+    elif la == "lane" and lb == "sub":
+        q_op, q_sl, bit_op = qa, qb, 0
+    elif lb == "lane" and la == "sub":
+        q_op, q_sl, bit_op = qb, qa, 1
+    else:
+        return None               # same-band pairs are composed upstream
+    op_loc = locate(q_op)
+    sliced_kind = "scat" if locate(q_sl) == "scat" else "sub"
+
+    need = set()
+    if sliced_kind == "scat":
+        need.add(q_sl - LANE_QUBITS)
+    if op_loc == "scat":
+        need.add(q_op - LANE_QUBITS)
+    if len(need) > scatter_max:
+        return None
+
+    m = m.astype(np.complex128)
+    blocks = np.empty((2, 4), dtype=object)
+    for r in range(2):
+        for c in range(2):
+            sub = np.empty((2, 2), dtype=np.complex128)
+            for ao in range(2):
+                for ai in range(2):
+                    row = (ao << bit_op) | (r << (1 - bit_op))
+                    col = (ai << bit_op) | (c << (1 - bit_op))
+                    sub[ao, ai] = m[row, col]
+            if op_loc == "lane":
+                emb = _embed_2x2(sub, q_op).T            # X @ G^T form
+            elif op_loc == "sub":
+                emb = _embed_2x2(sub, q_op - LANE_QUBITS)
+            else:
+                emb = sub
+            blocks[0, r * 2 + c] = emb.real.astype(np.float32)
+            blocks[1, r * 2 + c] = emb.imag.astype(np.float32)
+    d = blocks[0, 0].shape[0]
+    arr = np.stack([np.stack(list(blocks[p])) for p in range(2)])
+    kind = {"lane": "lane", "sub": "b1", "scat": "sc"}[op_loc]
+    real_only = bool(np.all(m.imag == 0.0))
+    st = PairStage(kind, d, q_op - LANE_QUBITS if op_loc == "scat" else -1,
+                   sliced_kind, q_sl - LANE_QUBITS, real_only, (), ())
+    return st, arr, (need if need else None)
+
+
+def _embed_2x2(sub, pos):
+    """Embed a 2x2 at bit `pos` of a 7-bit space (lane or sublane)."""
+    return F.embed_operator(sub, [pos], [], [], LANE_QUBITS)
 
 
 # ---------------------------------------------------------------------------
@@ -436,8 +545,113 @@ def _apply_diagvec_stage(re, im, st: DiagVecStage, row_ids):
     return nre, nim
 
 
+def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
+                      row_ids):
+    g = gref[...]                 # (2, 4, D, D) block operators
+    rows = geo.rows_eff
+    f32 = jnp.float32
+    hi = jax.lax.Precision.HIGHEST
+
+    if st.op_kind == "sc":
+        # both qubits on scattered axes: 4 input slices, 16 scalar cmuls
+        a_sl = geo.scat.index(st.sliced_bit)
+        a_op = geo.scat.index(st.op_bit)
+        ax1, ax2 = sorted((a_sl, a_op))
+        p1 = 1 << ax1
+        p2 = 1 << (ax2 - ax1 - 1)
+        p3 = (rows >> (ax2 + 1)) * LANES
+
+        def split(x):
+            v = x.reshape(p1, 2, p2, 2, p3)
+            return {(b1, b2): v[:, b1, :, b2, :]
+                    for b1 in range(2) for b2 in range(2)}
+
+        def bits(b1, b2):       # -> (sliced value, op value)
+            return (b1, b2) if a_sl == ax1 else (b2, b1)
+
+        xr, xi = split(re), split(im)
+        outr, outi = {}, {}
+        for b1 in range(2):
+            for b2 in range(2):
+                r, ao = bits(b1, b2)
+                nr = ni = None
+                for c in range(2):
+                    for ai in range(2):
+                        gre = g[0, r * 2 + c, ao, ai]
+                        sb1, sb2 = (c, ai) if a_sl == ax1 else (ai, c)
+                        if st.real_only:
+                            tr = gre * xr[(sb1, sb2)]
+                            ti = gre * xi[(sb1, sb2)]
+                        else:
+                            gim = g[1, r * 2 + c, ao, ai]
+                            tr = gre * xr[(sb1, sb2)] - gim * xi[(sb1, sb2)]
+                            ti = gre * xi[(sb1, sb2)] + gim * xr[(sb1, sb2)]
+                        nr = tr if nr is None else nr + tr
+                        ni = ti if ni is None else ni + ti
+                outr[(b1, b2)], outi[(b1, b2)] = nr, ni
+
+        def join(d):
+            rows_of = [jnp.stack([d[(b1, 0)], d[(b1, 1)]], axis=2)
+                       for b1 in range(2)]
+            return jnp.stack(rows_of, axis=1).reshape(rows, LANES)
+        nre, nim = join(outr), join(outi)
+    else:
+        # sliced qubit halves select embedded 128-dim block operators
+        if st.sliced_kind == "scat":
+            a = geo.scat.index(st.sliced_bit)
+            pre = 1 << a
+            post = (rows >> (a + 1)) * LANES
+        else:                     # sublane bit (op side is the lane space)
+            j = st.sliced_bit
+            pre = rows >> (j + 1)
+            post = (1 << j) * LANES
+
+        def halves(x):
+            v = x.reshape(pre, 2, post)
+            return v[:, 0, :], v[:, 1, :]
+
+        def rejoin(x0, x1):
+            return jnp.stack([x0, x1], axis=1).reshape(rows, LANES)
+
+        if st.op_kind == "lane":
+            def block(gg, x):     # g packed pre-transposed: X @ G^T
+                return jnp.dot(x.reshape(-1, LANES), gg,
+                               preferred_element_type=f32,
+                               precision=hi).reshape(x.shape)
+        else:                     # 'b1': sublane-axis contraction
+            def block(gg, x):
+                half_rows = x.size // LANES
+                aa = half_rows // LANES
+                xt = x.reshape(aa, LANES, LANES).transpose(1, 0, 2)
+                xt = xt.reshape(LANES, aa * LANES)
+                out = jax.lax.dot_general(
+                    gg, xt, (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32, precision=hi)
+                return out.reshape(LANES, aa, LANES).transpose(1, 0, 2) \
+                          .reshape(x.shape)
+
+        xr, xi = halves(re), halves(im)
+        outs = []
+        for r in range(2):
+            nr = ni = None
+            for c in range(2):
+                tr, ti = _cdot(block, xr[c], xi[c], g[0, r * 2 + c],
+                               g[1, r * 2 + c], st.real_only)
+                nr = tr if nr is None else nr + tr
+                ni = ti if ni is None else ni + ti
+            outs.append((nr, ni))
+        nre = rejoin(outs[0][0], outs[1][0])
+        nim = rejoin(outs[0][1], outs[1][1])
+
+    mask = _mask_of(row_ids, st.lane_preds, st.row_preds)
+    if mask is not None:
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return nre, nim
+
+
 def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
-    num_mats = sum(isinstance(s, MatStage) for s in stages)
+    num_mats = sum(isinstance(s, (MatStage, PairStage)) for s in stages)
     mat_refs = rest[:num_mats]
     out_ref = rest[num_mats]
     pids = [pl.program_id(d) for d in range(len(geo.gaps))]
@@ -449,6 +663,10 @@ def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
     for st in stages:
         if isinstance(st, MatStage):
             re, im = _apply_mat_stage(re, im, st, mat_refs[mi], geo, row_ids)
+            mi += 1
+        elif isinstance(st, PairStage):
+            re, im = _apply_pair_stage(re, im, st, mat_refs[mi], geo,
+                                       row_ids)
             mi += 1
         elif isinstance(st, PhaseStage):
             re, im = _apply_phase_stage(re, im, st, row_ids)
@@ -469,10 +687,24 @@ def compile_segment(stages: Sequence, n: int,
     rows_eff_bits = min(rows_eff_bits, total_row_bits)
     scat_bits = {st.bit for st in stages
                  if isinstance(st, MatStage) and st.kind == "sc"}
-    # the sublane band's contraction needs its whole operator in-block
-    b1_bits = max((st.dim.bit_length() - 1 for st in stages
-                   if isinstance(st, MatStage) and st.kind == "b1"),
-                  default=0)
+    for st in stages:
+        if isinstance(st, PairStage):
+            if st.sliced_kind == "scat":
+                scat_bits.add(st.sliced_bit)
+            if st.op_kind == "sc":
+                scat_bits.add(st.op_bit)
+    # in-block floors: the sublane band's contraction needs its whole
+    # operator in-block, and a PairStage needs its op space plus any
+    # sliced sublane bit
+    need_bits = [st.dim.bit_length() - 1 for st in stages
+                 if isinstance(st, MatStage) and st.kind == "b1"]
+    for st in stages:
+        if isinstance(st, PairStage):
+            if st.op_kind == "b1":
+                need_bits.append(LANE_QUBITS)
+            if st.sliced_kind == "sub":
+                need_bits.append(st.sliced_bit + 1)
+    b1_bits = max(need_bits, default=0)
     rows_eff_bits = max(rows_eff_bits, b1_bits + len(scat_bits))
     geo = _geometry(n, scat_bits, rows_eff_bits)
     dims, blocks = geo.view_dims()
@@ -488,14 +720,19 @@ def compile_segment(stages: Sequence, n: int,
     block_shape = (2, *blocks, LANES)
     view_shape = (2, *dims, LANES)
 
-    mat_stages = [s for s in stages if isinstance(s, MatStage)]
+    mat_stages = [s for s in stages if isinstance(s, (MatStage, PairStage))]
     kernel = functools.partial(_segment_kernel, stages=tuple(stages),
                                geo=geo)
     in_specs = [pl.BlockSpec(block_shape, index_map)]
     for st in mat_stages:
-        d = st.dim
-        in_specs.append(
-            pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
+        if isinstance(st, PairStage):
+            d = st.op_dim
+            in_specs.append(
+                pl.BlockSpec((2, 4, d, d), lambda *ids: (0, 0, 0, 0)))
+        else:
+            d = st.dim
+            in_specs.append(
+                pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
     fn = pl.pallas_call(
         kernel,
         grid=grid,

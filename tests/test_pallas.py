@@ -106,14 +106,61 @@ def test_cross_band_2q_fuses_via_kak():
     check(c, tol=5e-5)
 
 
-def test_cross_band_superop_passes_through():
-    # 6q density register: superop targets (1, 7) straddle bands and the
-    # superoperator is non-unitary, so it must fall through to XLA
+def test_cross_band_superop_fuses_as_pair_stage():
+    # 6q density register: superop targets (1, 7) straddle bands; the
+    # non-unitary superoperator fuses as a PairStage (lane op, sliced
+    # sublane qubit)
     c = Circuit(6)
     c.damping(1, 0.2)
     items = F.plan(c._flat_ops(12, True), 12, bands=PB.plan_bands(12))
     parts = PB.segment_plan(items, 12)
-    assert "xla" in [p[0] for p in parts]
+    assert [p[0] for p in parts] == ["segment"]
+    kinds = [type(s).__name__ for s in parts[0][1]]
+    assert "PairStage" in kinds
+
+
+@pytest.mark.parametrize("nq", [6, 8])
+def test_density_channels_fuse_at_scale(nq):
+    """Channels on registers whose doubled targets straddle bands run
+    through PairStages (all three op kinds: lane / b1 / scattered) and
+    match the per-gate engine."""
+    c = Circuit(nq)
+    c.h(0)
+    c.cnot(0, nq - 1)
+    c.damping(1, 0.2)         # lane-op pair
+    c.damping(nq - 1, 0.3)    # nq=8: targets (7,15) -> b1-op pair
+    c.depolarising(nq - 2, 0.1)
+    c.dephasing(0, 0.15)
+    q1 = qt.init_debug_state(qt.create_density_qureg(nq))
+    want = to_dense(c.apply(q1))
+    got = to_dense(c.apply_fused(
+        qt.init_debug_state(qt.create_density_qureg(nq)), interpret=True))
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=5e-5 * scale, rtol=0)
+
+
+def test_scat_scat_pair_stage():
+    """A 2q matrix with BOTH qubits on scattered axes (the 'sc' op kind):
+    numerics vs the per-gate engine."""
+    rng = np.random.default_rng(9)
+    n = 17
+    m = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    # non-unitary so the KAK path cannot take it
+    m = m @ np.diag([1.0, 0.8, 0.9, 1.0])
+    c = Circuit(n)
+    c.h(0)
+    c._add("matrix", (14, 16), m.astype(np.complex128))
+    items = F.plan(c.ops, n, bands=PB.plan_bands(n))
+    parts = PB.segment_plan(items, n)
+    assert [p[0] for p in parts] == ["segment"]
+    kinds = [type(s).__name__ for s in parts[0][1]]
+    assert "PairStage" in kinds
+    import jax.numpy as jnp
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 3].set(1.0)
+    got = np.asarray(c.compiled_fused(n, density=False, donate=False,
+                                      interpret=True)(amps)).reshape(2, -1)
+    want = np.asarray(c.compiled(n, density=False, donate=False)(amps))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=0)
 
 
 def test_small_register_superop_fuses():
