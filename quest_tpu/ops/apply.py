@@ -124,6 +124,14 @@ def apply_matrix(
     if k > _UNROLL_MAX_TARGETS:
         return _apply_matrix_matmul(amps, n, op_pair, targets, controls,
                                     control_states)
+    if n >= 14 and any(q < _LANE_QUBITS for q in targets):
+        # Large registers: a segment view exposing a low qubit leaves a
+        # tiny minor dim, which the TPU pads to (8, 128) tiles — up to
+        # 64x memory (measured OOM on 24-state-qubit channels). Keep the
+        # minor dim at 128 lanes: low-qubit content becomes embedded
+        # 128x128 lane operators, high target bits become block slices.
+        return _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
+                                       control_states)
     mre, mim, concrete = _as_pair(op_pair, amps.dtype)
     mre = mre.reshape(1 << k, 1 << k)
     mim = mim.reshape(1 << k, 1 << k)
@@ -223,6 +231,159 @@ def apply_band(
                 q = q - (ql + w)
             bit = ((ids >> q) & 1) == s
             mask = bit if mask is None else (mask & bit)
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
+
+
+_LANE_QUBITS = 7
+_LANES = 1 << _LANE_QUBITS
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _lane_basis(low_rel, lc_rel, lcs):
+    """(2^kl, 2^kl, 128, 128) basis: entry (i, j) is the lane-space
+    embedding of e_ij over the low target qubits with low controls; plus
+    the identity-on-unsatisfied-controls completion. Cached per
+    (targets, controls) signature — deep circuits reuse it."""
+    from quest_tpu.ops import fusion as F
+    kl = len(low_rel)
+    dim = 1 << kl
+    unsat = F.embed_operator(np.zeros((dim, dim)), low_rel, lc_rel, lcs,
+                             _LANE_QUBITS).real
+    basis = np.zeros((dim, dim, _LANES, _LANES))
+    for i in range(dim):
+        for j in range(dim):
+            e = np.zeros((dim, dim))
+            e[i, j] = 1.0
+            # embed_operator folds identity-on-unsatisfied-controls into
+            # EVERY embedding; strip it so the linear combination
+            # L = sum sub[i,j] B_ij scales only the gate content
+            basis[i, j] = F.embed_operator(e, low_rel, lc_rel, lcs,
+                                           _LANE_QUBITS).real - unsat
+    return basis, unsat
+
+
+def _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
+                            control_states):
+    """Matrix on a big register where some target is a lane
+    qubit (< 7): per high-target bit pattern pair (r, c), a 128x128 lane
+    operator applies as (rows, 128) @ L_rc^T — the minor dim never drops
+    below 128 lanes (TPU tiling stays 1x). Works for traced operands (the
+    embedding is a linear combination of precomputed basis matrices)."""
+    mre, mim, concrete = _as_pair(op_pair, amps.dtype)
+    k = len(targets)
+    mre = mre.reshape(1 << k, 1 << k)
+    mim = mim.reshape(1 << k, 1 << k)
+    low_idx = [j for j, t in enumerate(targets) if t < _LANE_QUBITS]
+    high_idx = [j for j, t in enumerate(targets) if t >= _LANE_QUBITS]
+    kl, kh = len(low_idx), len(high_idx)
+    lc = [c for c in controls if c < _LANE_QUBITS]
+    lcs = [s for c, s in zip(controls, control_states) if c < _LANE_QUBITS]
+    hc = [(c, s) for c, s in zip(controls, control_states)
+          if c >= _LANE_QUBITS]
+    basis, unsat = _lane_basis(tuple(targets[j] for j in low_idx),
+                               tuple(lc), tuple(lcs))
+    lib = np if concrete else jnp
+    # cast in BOTH branches: the float64 basis otherwise promotes a
+    # float32 state to float64 under jax_enable_x64 (doubling the state
+    # buffer — the very OOM this path prevents)
+    if concrete:
+        basis_l = basis.astype(amps.dtype)
+        unsat_l = unsat.astype(amps.dtype)
+    else:
+        basis_l = jnp.asarray(basis, dtype=amps.dtype)
+        unsat_l = jnp.asarray(unsat, dtype=amps.dtype)
+
+    def _indices(hpat):
+        """Matrix indices whose low bits sweep and high bits equal hpat."""
+        out = np.zeros(1 << kl, dtype=np.int64)
+        for a in range(1 << kl):
+            v = 0
+            for b, j in enumerate(low_idx):
+                v |= ((a >> b) & 1) << j
+            for b, j in enumerate(high_idx):
+                v |= ((hpat >> b) & 1) << j
+            out[a] = v
+        return out
+
+    def sub_block(m, rh, ch):
+        """(2^kl, 2^kl) sub-matrix for high pattern (rh, ch)."""
+        rows, cols = _indices(rh), _indices(ch)
+        return m[np.ix_(rows, cols)] if concrete else m[rows][:, cols]
+
+    def lane_op(m, rh, ch, with_unsat):
+        sub = sub_block(m, rh, ch)
+        L = lib.tensordot(sub, basis_l, axes=([0, 1], [0, 1]))
+        if with_unsat:
+            L = L + unsat_l
+        return L
+
+    # row-space view: high target bits get axes; trailing lane axis 128
+    rows_n = n - _LANE_QUBITS
+    high_bits = sorted({targets[j] - _LANE_QUBITS for j in high_idx} |
+                       {c - _LANE_QUBITS for c, _ in hc}, reverse=True)
+    rdims, raxis = seg_view(rows_n, tuple(high_bits))
+    dims = rdims + (_LANES,)
+    re = amps[0].reshape(dims)
+    im = amps[1].reshape(dims)
+    taxes = [raxis[targets[j] - _LANE_QUBITS] for j in high_idx]
+
+    def block(x, combo):
+        idx = [slice(None)] * len(dims)
+        for b, ax in enumerate(taxes):
+            v = (combo >> b) & 1
+            idx[ax] = slice(v, v + 1)
+        return x[tuple(idx)]
+
+    hi = lax.Precision.HIGHEST
+
+    def matmul(x, L):
+        flat = x.reshape(-1, _LANES)
+        return jnp.matmul(flat, L.T, precision=hi).reshape(x.shape)
+
+    out_re = [None] * (1 << kh)
+    out_im = [None] * (1 << kh)
+    for rh in range(1 << kh):
+        nr = ni = None
+        for ch in range(1 << kh):
+            Lre = lane_op(mre, rh, ch, with_unsat=(rh == ch))
+            Lim = lane_op(mim, rh, ch, with_unsat=False)
+            xr, xi_ = block(re, ch), block(im, ch)
+            if concrete and np.all(np.asarray(Lim) == 0.0):
+                if np.all(np.asarray(Lre) == 0.0):
+                    continue
+                tr, ti = matmul(xr, Lre), matmul(xi_, Lre)
+            else:
+                t1 = matmul(xr, Lre)
+                t2 = matmul(xi_, Lim)
+                t3 = matmul(xr + xi_, Lre + Lim)
+                tr, ti = t1 - t2, t3 - t1 - t2
+            nr = tr if nr is None else nr + tr
+            ni = ti if ni is None else ni + ti
+        if nr is None:
+            nr, ni = jnp.zeros_like(block(re, rh)), jnp.zeros_like(block(im, rh))
+        out_re[rh] = nr
+        out_im[rh] = ni
+
+    for b in range(kh):
+        ax = taxes[b]
+        out_re = [jnp.concatenate([out_re[2 * i], out_re[2 * i + 1]], axis=ax)
+                  for i in range(len(out_re) // 2)]
+        out_im = [jnp.concatenate([out_im[2 * i], out_im[2 * i + 1]], axis=ax)
+                  for i in range(len(out_im) // 2)]
+    nre, nim = out_re[0], out_im[0]
+
+    if hc:
+        mask = None
+        for c, s in hc:
+            shape = [1] * len(dims)
+            shape[raxis[c - _LANE_QUBITS]] = 2
+            vec = jnp.arange(2).reshape(shape) == s
+            mask = vec if mask is None else (mask & vec)
         nre = jnp.where(mask, nre, re)
         nim = jnp.where(mask, nim, im)
     return jnp.stack([nre.reshape(-1), nim.reshape(-1)])

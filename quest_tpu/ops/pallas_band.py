@@ -58,6 +58,7 @@ from quest_tpu.ops import fusion as F
 
 LANE_QUBITS = 7
 LANES = 1 << LANE_QUBITS
+SUBLANE_TOP = 2 * LANE_QUBITS  # first qubit above the sublane band
 ROWS_EFF_BITS = 12    # log2 of rows held per block (scattered x inner):
 # (2, 4096, 128) f32 = 4 MiB per block buffer; with Pallas double-buffering
 # and stage temporaries this stays within VMEM_LIMIT_BYTES
@@ -74,7 +75,7 @@ def plan_bands(n: int) -> List[Tuple[int, int]]:
     XLA contraction when a segment overflows)."""
     bands = []
     ql = 0
-    while ql < min(n, 14):
+    while ql < min(n, SUBLANE_TOP):
         w = min(LANE_QUBITS, n - ql)
         bands.append((ql, w))
         ql += w
@@ -274,7 +275,7 @@ def _try_pair_stage(it, scatter_max):
     def locate(q):
         if q < LANE_QUBITS:
             return "lane"
-        if q < 14:
+        if q < SUBLANE_TOP:
             return "sub"
         return "scat"
 
@@ -433,6 +434,24 @@ def _cdot(contract, re, im, gre, gim, real_only):
     return t1 - t2, t3 - t1 - t2
 
 
+def _sublane_contract(d):
+    """Contraction over the lowest log2(d) row bits of an (R, LANES)
+    block: cheap (A, d, l) -> (d, A, l) relayout, one MXU dot, undo.
+    Shared by the b1 MatStage and b1-op PairStage paths."""
+    f32 = jnp.float32
+    hi = jax.lax.Precision.HIGHEST
+
+    def contract(gg, x):
+        rows = x.size // LANES
+        a = rows // d
+        xt = x.reshape(a, d, LANES).transpose(1, 0, 2).reshape(d, a * LANES)
+        out = jax.lax.dot_general(
+            gg, xt, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=hi)
+        return out.reshape(d, a, LANES).transpose(1, 0, 2).reshape(x.shape)
+    return contract
+
+
 def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
     g = gref[...]
     gre, gim = g[0], g[1]
@@ -446,17 +465,7 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
             return jnp.dot(x, gg, preferred_element_type=f32, precision=hi)
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
     elif st.kind == "b1":
-        d = st.dim               # sublane band: row bits [0, log2 d)
-        a = rows // d
-
-        def contract(gg, x):
-            xt = x.reshape(a, d, LANES).transpose(1, 0, 2)
-            xt = xt.reshape(d, a * LANES)
-            out = jax.lax.dot_general(
-                gg, xt, (((1,), (0,)), ((), ())),
-                preferred_element_type=f32, precision=hi)
-            return out.reshape(d, a, LANES).transpose(1, 0, 2) \
-                      .reshape(rows, LANES)
+        contract = _sublane_contract(st.dim)
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
     else:                        # 'sc': butterfly on one scattered axis
         a = geo.scat.index(st.bit)
@@ -619,16 +628,7 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
                                preferred_element_type=f32,
                                precision=hi).reshape(x.shape)
         else:                     # 'b1': sublane-axis contraction
-            def block(gg, x):
-                half_rows = x.size // LANES
-                aa = half_rows // LANES
-                xt = x.reshape(aa, LANES, LANES).transpose(1, 0, 2)
-                xt = xt.reshape(LANES, aa * LANES)
-                out = jax.lax.dot_general(
-                    gg, xt, (((1,), (0,)), ((), ())),
-                    preferred_element_type=f32, precision=hi)
-                return out.reshape(LANES, aa, LANES).transpose(1, 0, 2) \
-                          .reshape(x.shape)
+            block = _sublane_contract(LANES)
 
         xr, xi = halves(re), halves(im)
         outs = []

@@ -68,3 +68,41 @@ def test_five_target_density_dual(rng):
     u = oracle.random_unitary(5, rng)
     out = to_dense(G.multi_qubit_unitary(load_dm(rho), list(range(5)), u))
     np.testing.assert_allclose(out, u @ rho @ u.conj().T, atol=1e-9)
+
+
+def test_laneblock_path_matches_oracle():
+    """apply_matrix routes big-register gates touching lane qubits through
+    the lane-block formulation (minor dim stays 128 on TPU — tiny-axis
+    views padded 64x and OOMed 24-state-qubit channels). Fuzz it against
+    the oracle at n=14, where the routing threshold is crossed."""
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as A
+    from . import oracle
+
+    rng = np.random.default_rng(77)
+    n = 14
+    amps0 = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    amps0 /= np.linalg.norm(amps0)
+    amps = jnp.asarray(amps0)
+    vec = (amps0[0] + 1j * amps0[1]).astype(np.complex128)
+    for _ in range(16):
+        k = int(rng.integers(1, 5))
+        qs = rng.permutation(n)[:k + 2]
+        targets = tuple(int(q) for q in qs[:k])
+        if not any(t < 7 for t in targets):
+            targets = (int(rng.integers(0, 7)),) + targets[1:]
+            targets = tuple(dict.fromkeys(targets))
+            k = len(targets)
+        ncs = int(rng.integers(0, 3))
+        controls = tuple(int(q) for q in qs[k:k + ncs]
+                         if q not in targets)
+        cstates = tuple(int(b) for b in rng.integers(0, 2, len(controls)))
+        m = (rng.standard_normal((1 << k, 1 << k))
+             + 1j * rng.standard_normal((1 << k, 1 << k)))
+        mp = (m.real.astype(np.float32), m.imag.astype(np.float32))
+        got = np.asarray(A.apply_matrix(amps, n, mp, targets, controls,
+                                        cstates))
+        want = oracle.apply_to_vector(vec, n, m, list(targets),
+                                      list(controls), list(cstates) or None)
+        err = np.abs((got[0] + 1j * got[1]) - want).max()
+        assert err < 1e-5, (targets, controls, cstates, err)
