@@ -14,7 +14,7 @@ band-fusion engine (quest_tpu/ops/fusion): commuting gate runs compose
 into one operator per 7-qubit band, each applied as a single MXU
 contraction; if it fails to compile, the XLA per-gate path runs instead
 and the fallback is REPORTED on stderr, never silent (ladder overridable
-via QUEST_BENCH_ENGINES). A size ladder (28 -> 22) degrades
+via QUEST_BENCH_ENGINES). A size ladder (30 -> 22) degrades
 gracefully: any size that fails logs its error and the next one runs, so a
 JSON line is emitted whenever ANY size succeeds.
 
@@ -50,6 +50,11 @@ def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _sync(state):
+    from quest_tpu.env import sync_array
+    sync_array(state)
+
+
 def _build_circuit(n: int):
     """GATES_PER_STEP single-qubit rotations round-robin over qubits
     [1, n-1] through the public Circuit builder."""
@@ -64,13 +69,15 @@ def _build_circuit(n: int):
 
 
 def _basis_state(shape):
-    """|0...0> planes built in ONE fused device buffer (zeros().at.set()
-    would briefly hold two full-state buffers)."""
+    """|0...0> planes built in ONE fused device buffer DIRECTLY in the
+    engine's view shape (zeros().at.set() would briefly hold two
+    full-state buffers; an out-of-jit reshape would relayout-copy —
+    either one is 16 GB at 30q)."""
     import jax.numpy as jnp
     from quest_tpu.state import _basis_planes
 
     n = int(np.prod(shape)).bit_length() - 2  # shape holds 2 * 2^n reals
-    return _basis_planes(0, n=n, rdt=jnp.float32).reshape(shape)
+    return _basis_planes(0, n=n, rdt=jnp.float32, shape=shape)
 
 
 def _warm_step(n: int):
@@ -107,7 +114,7 @@ def _warm_step(n: int):
                 shape = (2, 1 << n)
             state = _basis_state(shape)
             state = step(state)  # warmup/compile
-            _ = np.asarray(state.ravel()[:4])  # full sync
+            _sync(state)
             _log(f"n={n} engine={name} compile+warmup "
                  f"{time.perf_counter()-t0:.1f}s")
             return step, state, name
@@ -122,7 +129,7 @@ def _measure_jax(n: int, reps: int) -> float:
     t0 = time.perf_counter()
     for _ in range(reps):
         state = step(state)
-    _ = np.asarray(state.ravel()[:4])
+    _sync(state)
     dt = time.perf_counter() - t0
     gps = GATES_PER_STEP * INNER_STEPS * reps / dt
     eff_bw = gps * 2 * (1 << n) * 4 * 2  # r+w of both f32 planes per gate
@@ -175,7 +182,7 @@ def main():
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
     if on_tpu:
-        sizes, reps = (28, 26, 24, 22), 5
+        sizes, reps = (30, 28, 26, 24, 22), 5
     else:
         sizes, reps = (24, 22, 20), 2
 

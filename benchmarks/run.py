@@ -29,7 +29,8 @@ import numpy as np
 
 
 def _sync(x):
-    np.asarray(x[0, :1])  # real-dtype fetch forces full completion
+    from quest_tpu.env import sync_array
+    sync_array(x)  # true device sync (see sync_array's axon caveat)
 
 
 def _emit(scenario, metric, value, unit, **extra):

@@ -86,6 +86,17 @@ def destroy_quest_env(env: QuESTEnv) -> None:
     """No resources to free in the functional design; kept for API parity."""
 
 
+def sync_array(x) -> None:
+    """Block until `x` (and the queued computation chain behind it) has
+    ACTUALLY executed, by materializing one 4-element slice on the host.
+    The one place this idiom lives: on the tunneled axon platform
+    jax.block_until_ready returns before queued steps run (measured in
+    round 2 — it timed a 30q step chain at 4M gates/s), and fetching
+    ravel()[:k] would relayout-copy the whole state (8 GB at 30q); a tiny
+    leading slice forces true completion at zero cost."""
+    np.asarray(x[(0,) * (x.ndim - 1) + (slice(0, 4),)])
+
+
 def sync_quest_success(success_code: int = 1) -> int:
     """AND a success code across processes (ref syncQuESTSuccess,
     QuEST_cpu_distributed.c:166-170). Single-process: identity."""

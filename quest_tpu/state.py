@@ -74,28 +74,31 @@ def _planes(num_state_qubits: int, rdt):
     return jnp.zeros((2, 1 << num_state_qubits), dtype=rdt)
 
 
-@partial(jax.jit, static_argnames=("n", "rdt"))
-def _basis_planes_hl(hi, lo, *, n, rdt):
+@partial(jax.jit, static_argnames=("n", "rdt", "shape"))
+def _basis_planes_hl(hi, lo, *, n, rdt, shape=None):
     """Planes of a computational-basis state built in ONE fused buffer
     (zeros().at[...].set() briefly materializes TWO full-state buffers —
     at 30 qubits that is 16 GB and exhausts the chip's HBM). The target
     index arrives split as (index >> 20, index & 0xFFFFF) so every iota
     stays within int32 regardless of jax_enable_x64 (int64 iotas silently
-    truncate when x64 is off)."""
+    truncate when x64 is off). `shape` builds the buffer directly in a
+    caller-chosen view of (2, 2^n) — reshaping OUTSIDE the jit would
+    relayout-copy the whole state (another 8 GB at 30q)."""
     lo_bits = min(n, 20)
     view = (2, 1 << (n - lo_bits), 1 << lo_bits)
     ih = jax.lax.broadcasted_iota(jnp.int32, view, 1)
     il = jax.lax.broadcasted_iota(jnp.int32, view, 2)
     plane = jax.lax.broadcasted_iota(jnp.int32, view, 0)
     hit = (ih == hi) & (il == lo) & (plane == 0)
-    return jnp.where(hit, 1.0, 0.0).astype(rdt).reshape(2, 1 << n)
+    out = jnp.where(hit, 1.0, 0.0).astype(rdt)
+    return out.reshape(shape if shape is not None else (2, 1 << n))
 
 
-def _basis_planes(flat_index, *, n, rdt):
+def _basis_planes(flat_index, *, n, rdt, shape=None):
     lo_bits = min(n, 20)
     return _basis_planes_hl(int(flat_index) >> lo_bits,
                             int(flat_index) & ((1 << lo_bits) - 1),
-                            n=n, rdt=rdt)
+                            n=n, rdt=rdt, shape=shape)
 
 
 def _make(num_qubits: int, is_density: bool, dtype, sharding=None) -> Qureg:
