@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from quest_tpu import cplx
+from quest_tpu import precision
 from quest_tpu.ops import apply as A
 from quest_tpu.ops import matrices as M
 from quest_tpu.state import Qureg
@@ -277,7 +278,8 @@ class Circuit:
 
     def compiled(self, n: int, density: bool, donate: bool = True,
                  iters: int = 1):
-        key = (n, density, donate, iters)
+        key = (n, density, donate, iters,
+               precision.matmul_precision())
         fn = self._compiled.get(key)
         if fn is None:
             def run(amps):
@@ -304,7 +306,8 @@ class Circuit:
         contraction (apply_band). Diagonal/parity ops stay elementwise and
         XLA fuses them into the neighbouring passes. A layer of n
         single-qubit gates costs ~ceil(n/7) memory passes instead of n."""
-        key = ("banded", n, density, donate, iters)
+        key = ("banded", n, density, donate, iters,
+               precision.matmul_precision())
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -344,7 +347,8 @@ class Circuit:
         runs the kernels in the Pallas interpreter (for CPU testing)."""
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
-        key = ("fused", n, density, donate, interpret, iters)
+        key = ("fused", n, density, donate, interpret, iters,
+               precision.matmul_precision())
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -354,12 +358,11 @@ class Circuit:
             return fn
 
         flat = self._flat_ops(n, density)
+        # PB.plan_bands now matches fusion's default 7-wide layout, so the
+        # same plan serves both the kernel segmentation and the f64 XLA
+        # band path
         items = F.plan(flat, n, bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
-        # f64 registers use the XLA band path, which composes best with
-        # the default 7-wide band layout (the Pallas plan's width-1 high
-        # bands would cost one pass per high qubit)
-        items64 = F.plan(flat, n)
         appliers = []   # segment appliers work on (2, rows, 128); XLA
         # passthroughs flatten and restore around their op
         for part in parts:
@@ -386,7 +389,7 @@ class Circuit:
             # precision on the XLA band path
             if amps.dtype != jnp.float32:
                 flat_in = amps.reshape(2, -1)
-                out = _loop(lambda a: _apply_banded_items(a, n, items64),
+                out = _loop(lambda a: _apply_banded_items(a, n, items),
                             flat_in, iters)
                 return out.reshape(amps.shape)
             shape = amps.shape

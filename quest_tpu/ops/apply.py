@@ -51,6 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from quest_tpu import precision
+
 Axes = Tuple[int, ...]
 
 
@@ -205,7 +207,7 @@ def apply_band(
     im = amps[1].reshape(pre, band, post)
     gre = jnp.asarray(gre).reshape(band, band)
     gim = jnp.asarray(gim).reshape(band, band)
-    hi = lax.Precision.HIGHEST
+    hi = precision.matmul_precision()
 
     def contract(g, x):
         return jnp.einsum("ab,pbq->paq", g, x, precision=hi)
@@ -339,7 +341,7 @@ def _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
             idx[ax] = slice(v, v + 1)
         return x[tuple(idx)]
 
-    hi = lax.Precision.HIGHEST
+    hi = precision.matmul_precision()
 
     def matmul(x, L):
         flat = x.reshape(-1, _LANES)
@@ -420,7 +422,7 @@ def _apply_matrix_matmul(amps, n, op_pair, targets, controls,
 
     re2 = to2d(amps[0])
     im2 = to2d(amps[1])
-    hi = lax.Precision.HIGHEST
+    hi = precision.matmul_precision()
     # new[r, s'] = sum_s m2[s', s] v[r, s] -> v @ m2^T
     m2_t, m2i_t = jnp.asarray(m2).T, jnp.asarray(m2i).T
     nre = jnp.matmul(re2, m2_t, precision=hi) - jnp.matmul(im2, m2i_t,

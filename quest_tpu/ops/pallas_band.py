@@ -28,7 +28,12 @@ Stages inside the block:
   b0   composed 128x128 operator on the lane band: X @ G^T on the MXU
   b1   composed operator on the sublane band (qubits 7..13): cheap
        (A,d,l)->(d,A,l) relayout, one MXU dot, undo
-  sc   composed 2x2 on one scattered qubit: elementwise butterfly
+  scb  composed 2^w x 2^w operator on a HIGH band (qubits 14+): ONE MXU
+       dot over the band's w merged scattered axes — a whole layer of
+       gates on qubits 14..20 costs one dot instead of 7 serial VPU
+       butterflies (measured 4x on those bands at 29q)
+  sc   composed 2x2 on one scattered qubit (width-1 remainder bands):
+       elementwise butterfly
   diagonal / all-ones / parity phases on ANY qubits (global row ids from
        the grid indices) — these never break a segment
   controls anywhere become lane/global-row-id masks
@@ -37,9 +42,14 @@ Operator matrices ride along as kernel INPUTS, not baked constants, so
 segments with identical structure but different angles compile to the
 same kernel (layer reuse across RCS depth).
 
-A segment ends when it would need more than SCATTER_MAX scattered qubits,
-or at a cross-band multi-target unitary (XLA passthrough between
-segments; quest_tpu/circuit.py compiled_fused).
+A segment ends when the next stage's scattered row bits would exceed
+SCATTER_MAX, or when the in-block row bits (sublane floor from b1/pair
+stages + scattered axes) would exceed MAX_BLOCK_ROW_BITS — the VMEM
+budget; a b1 stage and a full 7-bit scb therefore land in separate
+segments. Ops the kernel cannot host at all (>=3-target cross-band
+unitaries, oversized single stages under a caller-shrunk scatter budget)
+run as XLA band passthroughs between segments (quest_tpu/circuit.py
+compiled_fused).
 """
 
 from __future__ import annotations
@@ -54,6 +64,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from quest_tpu import precision
 from quest_tpu.ops import fusion as F
 
 LANE_QUBITS = 7
@@ -62,25 +73,31 @@ SUBLANE_TOP = 2 * LANE_QUBITS  # first qubit above the sublane band
 ROWS_EFF_BITS = 12    # log2 of rows held per block (scattered x inner):
 # (2, 4096, 128) f32 = 4 MiB per block buffer; with Pallas double-buffering
 # and stage temporaries this stays within VMEM_LIMIT_BYTES
-SCATTER_MAX = 5       # scattered qubits per segment (keeps inner_bits >= 7
-# so the full sublane band stays in-block)
+SCATTER_MAX = 7       # scattered row bits per segment: enough for one
+# full high band as an scb stage
+MAX_BLOCK_ROW_BITS = 13  # cap on in-block row bits (sublane floor +
+# scattered axes): a 2^13-row block is 2 x 8192 x 128 f32 = 8 MiB; the
+# kernel stack holds it double-buffered in+out plus stage temporaries
+# (measured: 2^14 rows hit 118 MiB of scoped VMEM and failed to compile,
+# so a b1 stage and a full 7-bit scb get separate segments)
 VMEM_LIMIT_BYTES = 100 * (1 << 20)  # v5e has 128 MiB VMEM; the default
 # 16 MiB scoped limit rejects multi-stage kernels (measured round 1/2)
 
 
 def plan_bands(n: int) -> List[Tuple[int, int]]:
-    """Band layout matching the kernel's reach: 7-qubit lane and sublane
-    bands, then width-1 bands — each high qubit composes its own 2x2 run,
-    applied in-kernel as a scattered-axis butterfly (or via a cheap D=2
-    XLA contraction when a segment overflows)."""
+    """Band layout matching the kernel's reach: 7-qubit bands everywhere.
+    The lane band contracts on the lane axis, the sublane band on the
+    sublane axis, and each HIGH band becomes one MXU contraction over its
+    merged scattered axes (an 'scb' stage) — so a whole layer of gates on
+    qubits 14..20 costs ONE dot instead of 7 serial VPU butterflies
+    (measured 4x on those bands at 29q). Width-1 remainders stay
+    scattered-axis butterflies."""
     bands = []
     ql = 0
-    while ql < min(n, SUBLANE_TOP):
+    while ql < n:
         w = min(LANE_QUBITS, n - ql)
         bands.append((ql, w))
         ql += w
-    for q in range(ql, n):
-        bands.append((q, 1))
     return bands
 
 
@@ -91,12 +108,14 @@ def plan_bands(n: int) -> List[Tuple[int, int]]:
 
 @dataclasses.dataclass(frozen=True)
 class MatStage:
-    kind: str                  # 'b0' | 'b1' | 'sc'
+    kind: str                  # 'b0' | 'b1' | 'sc' | 'scb'
     dim: int                   # operator dimension D
     real_only: bool
     lane_preds: Tuple[Tuple[int, int], ...]   # (lane bit, want)
     row_preds: Tuple[Tuple[int, int], ...]    # (GLOBAL row bit, want)
-    bit: int = -1              # 'sc': the GLOBAL row bit this acts on
+    bit: int = -1              # 'sc': the GLOBAL row bit this acts on;
+    # 'scb': the LOWEST of the log2(dim) contiguous row bits the composed
+    # high-band operator contracts over (each a scattered block axis)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,13 +193,38 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
     stages: List = []
     arrays: List = []
     scat_bits: set = set()
+    b1_floor = 0    # in-block sublane bits forced by b1/pair stages
 
     def flush():
-        nonlocal stages, arrays, scat_bits
+        nonlocal stages, arrays, scat_bits, b1_floor
         if stages:
             parts.append(("segment", stages, arrays))
             stages, arrays = [], []
         scat_bits = set()
+        b1_floor = 0
+
+    def reserve(bits=frozenset(), floor=0):
+        """Claim scattered row bits / a sublane-floor for the next stage,
+        flushing first if the block would outgrow its VMEM budget
+        (MAX_BLOCK_ROW_BITS rows — the kernel stack holds the block
+        double-buffered in+out plus stage temporaries) or its scattered-
+        axis budget. Returns False — claiming nothing — when the stage's
+        OWN requirement exceeds the budgets even in a fresh segment (the
+        caller must fall back to an XLA passthrough)."""
+        nonlocal scat_bits, b1_floor
+        if (len(set(bits)) > scatter_max
+                or floor + len(set(bits)) > MAX_BLOCK_ROW_BITS):
+            return False
+        new_scat = scat_bits | set(bits)
+        new_floor = max(b1_floor, floor)
+        if (len(new_scat) > scatter_max
+                or new_floor + len(new_scat) > MAX_BLOCK_ROW_BITS):
+            flush()
+            new_scat = set(bits)
+            new_floor = floor
+        scat_bits = new_scat
+        b1_floor = new_floor
+        return True
 
     for it in items:
         if isinstance(it, F.BandOp):
@@ -192,17 +236,22 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
             elif it.ql == LANE_QUBITS:
                 kind, bit = "b1", -1
                 g = it.gre + 1j * it.gim
+                reserve(floor=it.w)
             elif it.w == 1:
                 kind, bit = "sc", it.ql - LANE_QUBITS
                 g = it.gre + 1j * it.gim
-                if bit not in scat_bits:
-                    if len(scat_bits) >= scatter_max:
-                        flush()
-                    scat_bits.add(bit)
-            else:
-                flush()
-                parts.append(("xla", it))
-                continue
+                if not reserve(bits=(bit,)):
+                    flush()
+                    parts.append(("xla", it))
+                    continue
+            else:                  # high band: one MXU dot over its
+                kind = "scb"       # merged scattered axes
+                bit = it.ql - LANE_QUBITS
+                g = it.gre + 1j * it.gim
+                if not reserve(bits=range(bit, bit + it.w)):
+                    flush()
+                    parts.append(("xla", it))
+                    continue
             stages.append(MatStage(kind, 1 << it.w, real_only, lane_p,
                                    row_p, bit))
             # keep operator arrays HOST-side (numpy): as closure
@@ -247,13 +296,15 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
             st = _try_pair_stage(it, scatter_max)
             if st is not None:
                 stage, arr, new_scat = st
-                if new_scat is not None and new_scat - scat_bits:
-                    if len(scat_bits | new_scat) > scatter_max:
-                        flush()
-                    scat_bits |= new_scat
-                stages.append(stage)
-                arrays.append(arr)
-                continue
+                floor = 0
+                if stage.op_kind == "b1":
+                    floor = LANE_QUBITS
+                if stage.sliced_kind == "sub":
+                    floor = max(floor, stage.sliced_bit + 1)
+                if reserve(bits=new_scat or frozenset(), floor=floor):
+                    stages.append(stage)
+                    arrays.append(arr)
+                    continue
         flush()
         parts.append(("xla", it))
     flush()
@@ -434,12 +485,19 @@ def _cdot(contract, re, im, gre, gim, real_only):
     return t1 - t2, t3 - t1 - t2
 
 
+def _dot_precision():
+    """Mosaic lowers only DEFAULT and HIGHEST dot precisions; clamp the
+    session knob's HIGH (usable on the XLA band path) up to HIGHEST."""
+    p = precision.matmul_precision()
+    return jax.lax.Precision.HIGHEST if p == jax.lax.Precision.HIGH else p
+
+
 def _sublane_contract(d):
     """Contraction over the lowest log2(d) row bits of an (R, LANES)
     block: cheap (A, d, l) -> (d, A, l) relayout, one MXU dot, undo.
     Shared by the b1 MatStage and b1-op PairStage paths."""
     f32 = jnp.float32
-    hi = jax.lax.Precision.HIGHEST
+    hi = _dot_precision()
 
     def contract(gg, x):
         rows = x.size // LANES
@@ -457,8 +515,8 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
     gre, gim = g[0], g[1]
     f32 = jnp.float32
     rows = geo.rows_eff
-    hi = jax.lax.Precision.HIGHEST  # TPU dots default to bf16 passes;
-    # f32 amplitudes need full-precision passes (norm drifts ~1e-3 else)
+    hi = _dot_precision()  # HIGHEST default: TPU dots
+    # otherwise run single bf16 passes and norm drifts ~1e-3 (see precision.py)
 
     if st.kind == "b0":
         def contract(gg, x):     # x (rows, LANES) @ G^T (LANES, LANES)
@@ -466,6 +524,34 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
     elif st.kind == "b1":
         contract = _sublane_contract(st.dim)
+        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+    elif st.kind == "scb":
+        # composed high-band operator: ONE dot over the merged scattered
+        # axes (they are adjacent row dims of the block — the scat tuple
+        # is bit-descending, so the merged index's MSB is the band's top
+        # qubit, matching the operator's index convention)
+        d = st.dim
+        w = d.bit_length() - 1
+        p = geo.scat.index(st.bit + w - 1)
+        assert geo.scat[p:p + w] == tuple(
+            range(st.bit + w - 1, st.bit - 1, -1)), \
+            (geo.scat, st.bit, w)
+        pre = 1 << p
+        post = (rows >> (p + w)) * LANES
+
+        def contract(gg, x):
+            if pre == 1:
+                xt = x.reshape(d, post)
+                out = jax.lax.dot_general(
+                    gg, xt, (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32, precision=hi)
+                return out.reshape(x.shape)
+            xt = x.reshape(pre, d, post).transpose(1, 0, 2)
+            out = jax.lax.dot_general(
+                gg, xt.reshape(d, pre * post), (((1,), (0,)), ((), ())),
+                preferred_element_type=f32, precision=hi)
+            return (out.reshape(d, pre, post).transpose(1, 0, 2)
+                    .reshape(x.shape))
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
     else:                        # 'sc': butterfly on one scattered axis
         a = geo.scat.index(st.bit)
@@ -559,7 +645,7 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
     g = gref[...]                 # (2, 4, D, D) block operators
     rows = geo.rows_eff
     f32 = jnp.float32
-    hi = jax.lax.Precision.HIGHEST
+    hi = _dot_precision()
 
     if st.op_kind == "sc":
         # both qubits on scattered axes: 4 input slices, 16 scalar cmuls
@@ -688,6 +774,9 @@ def compile_segment(stages: Sequence, n: int,
     scat_bits = {st.bit for st in stages
                  if isinstance(st, MatStage) and st.kind == "sc"}
     for st in stages:
+        if isinstance(st, MatStage) and st.kind == "scb":
+            scat_bits |= set(range(st.bit,
+                                   st.bit + st.dim.bit_length() - 1))
         if isinstance(st, PairStage):
             if st.sliced_kind == "scat":
                 scat_bits.add(st.sliced_bit)

@@ -140,21 +140,44 @@ def test_density_channels_fuse_at_scale(nq):
 
 
 def test_scat_scat_pair_stage():
-    """A 2q matrix with BOTH qubits on scattered axes (the 'sc' op kind):
-    numerics vs the per-gate engine."""
+    """A 2q matrix with both qubits on scattered axes of DIFFERENT high
+    bands (the 'sc' op kind PairStage): numerics vs the per-gate
+    engine."""
     rng = np.random.default_rng(9)
-    n = 17
+    n = 23
     m = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
     # non-unitary so the KAK path cannot take it
     m = m @ np.diag([1.0, 0.8, 0.9, 1.0])
     c = Circuit(n)
     c.h(0)
-    c._add("matrix", (14, 16), m.astype(np.complex128))
+    c._add("matrix", (14, 21), m.astype(np.complex128))
     items = F.plan(c.ops, n, bands=PB.plan_bands(n))
     parts = PB.segment_plan(items, n)
     assert [p[0] for p in parts] == ["segment"]
     kinds = [type(s).__name__ for s in parts[0][1]]
     assert "PairStage" in kinds
+    import jax.numpy as jnp
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 3].set(1.0)
+    got = np.asarray(c.compiled_fused(n, density=False, donate=False,
+                                      interpret=True)(amps)).reshape(2, -1)
+    want = np.asarray(c.compiled(n, density=False, donate=False)(amps))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=0)
+
+
+def test_same_high_band_2q_composes_to_scb():
+    """A 2q matrix whose qubits share one high band composes into that
+    band's scb operator — no PairStage, no passthrough."""
+    rng = np.random.default_rng(9)
+    n = 17
+    m = rng.standard_normal((4, 4)) + 1j * rng.standard_normal((4, 4))
+    m = m @ np.diag([1.0, 0.8, 0.9, 1.0])  # non-unitary: no KAK escape
+    c = Circuit(n)
+    c.h(0)
+    c._add("matrix", (14, 16), m.astype(np.complex128))
+    parts = parts_of(c, n=n)
+    assert [p[0] for p in parts] == ["segment"]
+    kinds = [s.kind for s in parts[0][1]]
+    assert kinds == ["b0", "scb"]
     import jax.numpy as jnp
     amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 3].set(1.0)
     got = np.asarray(c.compiled_fused(n, density=False, donate=False,
@@ -174,36 +197,74 @@ def test_small_register_superop_fuses():
 
 
 def test_scattered_qubits_fuse():
-    """Gates on high qubits become scattered-axis stages — no XLA
-    passthrough until SCATTER_MAX distinct high qubits are in play."""
+    """Gates on high qubits compose into ONE scb stage per high band —
+    one MXU dot over the band's merged scattered axes, no passthrough."""
     n = 16
     c = Circuit(n)
     c.h(0)
     for q in (14, 15):
-        c.ry(q, 0.1 * q)      # scattered qubits
+        c.ry(q, 0.1 * q)      # both in the (14, 2) high band
     parts = parts_of(c, n=n)
     assert [p[0] for p in parts] == ["segment"]
     kinds = [s.kind for s in parts[0][1]]
-    assert kinds.count("sc") == 2
+    assert kinds == ["b0", "scb"]
+    assert parts[0][1][1].dim == 4
     check(c, n=n)
 
 
-def test_scatter_overflow_splits_segment():
-    n = 16
+def test_full_high_band_scb():
+    """A whole 7-qubit high band (d=128 scb) plus gates in every other
+    band and a cross-band CZ — numerics through the interpreter."""
+    n = 23
     c = Circuit(n)
-    for q in range(14, 16):
-        c.h(q)
-    parts = parts_of(c, n=n, scatter_max=1)
+    for q in range(14, 21):
+        c.ry(q, 0.1 * (q - 13))
+    c.cz(13, 14)              # crosses the sublane/high-band split
+    c.h(2)
+    c.ry(9, 0.3)
+    c.x(21, 15)               # top-band target, scb-band control — its
+    # band's 2 scat bits exceed the budget next to the d=128 scb's 7, so
+    # a second segment starts (still no XLA passthrough)
+    parts = parts_of(c, n=n)
+    assert [p[0] for p in parts] == ["segment", "segment"]
+    kinds = [s.kind for s in parts[0][1] if hasattr(s, "kind")]
+    assert "scb" in kinds
+    assert any(getattr(s, "dim", 0) == 128 and s.kind == "scb"
+               for s in parts[0][1])
+    check(c, n=n)
+
+
+def test_oversized_band_passthrough_under_small_budget():
+    """A 7-wide high band cannot fit a scatter budget smaller than its
+    width even in a fresh segment — it must fall back to an XLA
+    passthrough, never silently over-claim scattered axes."""
+    n = 23
+    c = Circuit(n)
+    c.h(14)                   # band (14, 7): needs 7 scat bits
+    parts = parts_of(c, n=n, scatter_max=5)
+    assert [p[0] for p in parts] == ["xla"]
+    assert all(len(getattr(p[1], "qubits", lambda: set())()) <= 5
+               or p[0] == "xla" for p in parts)
+
+
+def test_scatter_overflow_splits_segment():
+    """Two high bands whose scattered axes exceed the scatter budget get
+    separate segments; numerics still match."""
+    n = 23
+    c = Circuit(n)
+    c.h(14)                   # band (14, 7): scb needing 7 scat bits
+    c.h(21)                   # band (21, 2): 2 more
+    parts = parts_of(c, n=n, scatter_max=7)
     assert [p[0] for p in parts] == ["segment", "segment"]
     # numerics at the tiny scatter budget
     import jax.numpy as jnp
     amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    out = amps.reshape(2, -1, PB.LANES)
     for part in parts:
-        amps = PB.compile_segment(part[1], n, interpret=True)(
-            amps, part[2])
-    want = c.compiled(n, density=False, donate=False)(
-        jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0))
-    np.testing.assert_allclose(np.asarray(amps.reshape(2, -1)),
+        out = PB.compile_segment(part[1], n, interpret=True)(
+            out, part[2])
+    want = c.compiled(n, density=False, donate=False)(amps)
+    np.testing.assert_allclose(np.asarray(out.reshape(2, -1)),
                                np.asarray(want), atol=1e-5, rtol=0)
 
 
