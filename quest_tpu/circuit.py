@@ -419,7 +419,8 @@ class Circuit:
         whole circuit, reference-style ppermute schedule — see
         quest_tpu.parallel.sharded)."""
         from quest_tpu.parallel import sharded as S
-        key = ("sharded", n, density, id(mesh), int(mesh.devices.size), donate)
+        key = ("sharded", n, density, id(mesh), int(mesh.devices.size),
+               donate, precision.matmul_precision())
         fn = self._compiled.get(key)
         if fn is None:
             fn = S.compile_circuit_sharded(self.ops, n, density, mesh, donate)
@@ -432,13 +433,42 @@ class Circuit:
         see quest_tpu.parallel.sharded.compile_circuit_sharded_banded)."""
         from quest_tpu.parallel import sharded as S
         key = ("sharded-banded", n, density, id(mesh),
-               int(mesh.devices.size), donate)
+               int(mesh.devices.size), donate,
+               precision.matmul_precision())
         fn = self._compiled.get(key)
         if fn is None:
             fn = S.compile_circuit_sharded_banded(self.ops, n, density, mesh,
                                                   donate)
             self._compiled[key] = fn
         return fn
+
+    def compiled_sharded_fused(self, n: int, density: bool, mesh,
+                               donate: bool = True,
+                               interpret: bool = False):
+        """Pallas band-segment engine over the device mesh (local fused
+        mega-kernel segments between explicit ppermute exchanges; see
+        quest_tpu.parallel.sharded.compile_circuit_sharded_fused)."""
+        from quest_tpu.parallel import sharded as S
+        key = ("sharded-fused", n, density, id(mesh),
+               int(mesh.devices.size), donate, interpret,
+               precision.matmul_precision())
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = S.compile_circuit_sharded_fused(self.ops, n, density, mesh,
+                                                 donate, interpret)
+            self._compiled[key] = fn
+        return fn
+
+    def apply_sharded_fused(self, q: Qureg, mesh, donate: bool = False,
+                            interpret: bool = False) -> Qureg:
+        """Apply via the Pallas fused shard_map engine."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        from quest_tpu.parallel import mesh as MM
+        fn = self.compiled_sharded_fused(q.num_state_qubits, q.is_density,
+                                         mesh, donate, interpret)
+        amps = jax.device_put(q.amps, MM.amp_sharding(mesh))
+        return q.replace_amps(fn(amps))
 
     def apply_sharded_banded(self, q: Qureg, mesh,
                              donate: bool = False) -> Qureg:

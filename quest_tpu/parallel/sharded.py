@@ -374,6 +374,102 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
+                                  mesh: Mesh, donate: bool = True,
+                                  interpret: bool = False):
+    """The Pallas band-segment engine over the device mesh: the pod-scale
+    composition of the two fastest paths in the framework. Runs of
+    purely-local fused items (band contractions, diagonals, phases, pair
+    stages whose qubits and control predicates all sit inside the chunk)
+    execute as mega-kernel segments — many operators per HBM pass per
+    device, exactly as on one chip (quest_tpu/ops/pallas_band.py) —
+    while items touching global (device-index) qubits ride the explicit
+    ppermute schedule between segments. The reference has no analogue:
+    its distributed backend dispatches one kernel per gate per rank
+    (QuEST_cpu_distributed.c:846-881); here a whole local stretch of an
+    RCS layer is one kernel launch on every device simultaneously.
+
+    interpret=True runs the kernels in the Pallas interpreter (CPU-mesh
+    testing)."""
+    from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+
+    D = int(mesh.devices.size)
+    g = int(math.log2(D))
+    local_n = n - g
+    if local_n < 1:
+        raise ValueError("register too small for mesh")
+    if not PB.usable(local_n):
+        return compile_circuit_sharded_banded(ops, n, density, mesh, donate)
+
+    flat = flatten_ops(ops, n, density)
+    # local bands follow the kernel's layout; global qubits get width-1
+    # bands so each composes into one 2x2 pair exchange
+    bands = list(PB.plan_bands(local_n)) + [(q, 1)
+                                            for q in range(local_n, n)]
+    items = F.plan(flat, n, bands=bands)
+
+    def local_only(it) -> bool:
+        return all(q < local_n for q in it.qubits())
+
+    # group maximal runs of purely-local items into kernel segments;
+    # everything else goes through the explicit sharded appliers
+    parts = []        # ("kernel", applier, arrays) | ("sharded", item)
+    run_items: list = []
+
+    def close_run():
+        nonlocal run_items
+        if not run_items:
+            return
+        for sub in PB.segment_plan(run_items, local_n):
+            if sub[0] == "segment":
+                seg = PB.compile_segment(sub[1], local_n,
+                                         interpret=interpret)
+                parts.append(("kernel", seg, sub[2]))
+            else:
+                parts.append(("sharded", sub[1]))
+        run_items = []
+
+    for it in items:
+        if local_only(it):
+            run_items.append(it)
+        else:
+            close_run()
+            parts.append(("sharded", it))
+    close_run()
+
+    def apply_sharded_item(chunk, dev, it):
+        if isinstance(it, F.BandOp):
+            return _band_op_sharded(chunk, dev, D=D, local_n=local_n,
+                                    bop=it)
+        return _apply_gateop(chunk, dev, D=D, local_n=local_n,
+                             density=False, op=it.op)
+
+    def run(chunk):
+        chunk = chunk.reshape(2, -1)
+        dev = lax.axis_index(AMP_AXIS)
+        if chunk.dtype != jnp.float32:
+            # the kernels are f32-only; f64 registers keep full precision
+            # on the XLA banded schedule over the same plan
+            for it in items:
+                chunk = apply_sharded_item(chunk, dev, it)
+            return chunk
+        for part in parts:
+            if part[0] == "kernel":
+                out = part[1](chunk.reshape(2, -1, PB.LANES), part[2])
+                chunk = out.reshape(2, -1)
+            else:
+                chunk = apply_sharded_item(chunk, dev, part[1])
+        return chunk
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, and every value here is explicitly per-device anyway
+    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
+                            out_specs=P(None, AMP_AXIS), check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
 def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
                             donate: bool = True):
     """Compile a gate sequence into ONE shard_map program over the mesh —

@@ -57,9 +57,15 @@ def set_matmul_precision(p) -> None:
     traced with."""
     global _matmul_precision
     if isinstance(p, str):
-        p = {"default": jax.lax.Precision.DEFAULT,
-             "high": jax.lax.Precision.HIGH,
-             "highest": jax.lax.Precision.HIGHEST}[p.lower()]
+        table = {"default": jax.lax.Precision.DEFAULT,
+                 "high": jax.lax.Precision.HIGH,
+                 "highest": jax.lax.Precision.HIGHEST}
+        if p.lower() not in table:
+            raise ValueError(
+                f"matmul precision must be one of {sorted(table)} "
+                f"(via QUEST_MATMUL_PRECISION or set_matmul_precision), "
+                f"got {p!r}")
+        p = table[p.lower()]
     _matmul_precision = p
 
 

