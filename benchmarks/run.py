@@ -78,25 +78,30 @@ def bench_tutorial():
 def bench_rcs():
     from quest_tpu.circuit import random_circuit
 
-    n = 28 if _on_tpu() else 20
+    from quest_tpu.state import _basis_planes
+
+    n = 30 if _on_tpu() else 20
     depth = 20
     circ = random_circuit(n, depth, seed=1)
     num_gates = len(circ.ops)
     if _on_tpu():
-        # fused band-segment engine with its native (2, rows, 128) state
+        # fused band-segment engine with its native (2, rows, 128) state,
+        # built directly in that layout (see bench.py: an out-of-jit
+        # reshape or a zeros().at.set would transiently double the 8 GB
+        # state at 30q)
         fn = circ.compiled_fused(n, density=False, donate=True)
-        amps = jnp.zeros((2, 1 << (n - 7), 128), dtype=jnp.float32)
-        amps = amps.at[0, 0, 0].set(1.0)
+        amps = _basis_planes(0, n=n, rdt=jnp.float32,
+                             shape=(2, 1 << (n - 7), 128))
     else:
         fn = circ.compiled_banded(n, density=False, donate=True)
-        amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+        amps = _basis_planes(0, n=n, rdt=jnp.float32)
     amps = fn(amps)
-    np.asarray(amps.ravel()[:1])
+    _sync(amps)
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
         amps = fn(amps)
-    np.asarray(amps.ravel()[:1])
+    _sync(amps)
     dt = (time.perf_counter() - t0) / reps
     _emit("rcs", f"RCS depth-{depth} @ {n}q wall-clock", dt * 1000, "ms/run",
           gates_per_sec=round(num_gates / dt, 1))
@@ -180,9 +185,11 @@ def bench_qft_sharded():
     d = 1 << (len(devices).bit_length() - 1)
     n = 26 if _on_tpu() else 20
     mesh = make_amp_mesh(d)
+    from quest_tpu.state import _basis_planes
+
     circ = qft_circuit(n)
     fn = circ.compiled_sharded(n, density=False, mesh=mesh, donate=True)
-    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    amps = _basis_planes(0, n=n, rdt=jnp.float32)
     amps = jax.device_put(amps, amp_sharding(mesh))
     amps = fn(amps)
     _sync(amps)

@@ -365,10 +365,12 @@ class Circuit:
         parts = PB.segment_plan(items, n)
         appliers = []   # segment appliers work on (2, rows, 128); XLA
         # passthroughs flatten and restore around their op
+        seg_cache = {}  # identical-structure segments share one kernel
         for part in parts:
             if part[0] == "segment":
                 _, stages, arrays = part
-                seg = PB.compile_segment(stages, n, interpret=interpret)
+                seg = PB.compile_segment_cached(seg_cache, stages, n,
+                                                interpret=interpret)
                 appliers.append(
                     lambda amps, seg=seg, arrays=arrays: seg(amps, arrays))
             else:

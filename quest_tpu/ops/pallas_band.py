@@ -121,18 +121,19 @@ class MatStage:
 @dataclasses.dataclass(frozen=True)
 class PhaseStage:
     """allones phase: multiply amplitudes whose listed bits are all `want`
-    by (tre + i*tim)."""
+    by (tre + i*tim). The (tre, tim) pair rides as a (1, 2) kernel input
+    — stages are pure STRUCTURE, so segments that differ only in values
+    (RCS layers with different angles) share one compiled kernel."""
     lane_bits: Tuple[Tuple[int, int], ...]
     row_bits: Tuple[Tuple[int, int], ...]     # GLOBAL row bits
-    tre: float
-    tim: float
 
 
 @dataclasses.dataclass(frozen=True)
 class ParityStage:
+    """exp(-i angle/2 Z...Z); (cos, sin) of the half angle ride as a
+    (1, 2) kernel input."""
     lane_targets: Tuple[int, ...]
     row_targets: Tuple[int, ...]              # GLOBAL row bits
-    angle: float
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,10 +163,9 @@ class PairStage:
 class DiagVecStage:
     """General k-qubit diagonal: multiply each amplitude by the entry
     selected by its target-bit pattern (identity where controls unmet).
-    Entry index bit j corresponds to targets[j]."""
+    Entry index bit j corresponds to targets[j]; the (2, 2^k) re/im
+    entry table rides as a kernel input."""
     targets: Tuple[int, ...]                  # GLOBAL qubits
-    dre: Tuple[float, ...]                    # 2^k entries
-    dim_: Tuple[float, ...]
     lane_preds: Tuple[Tuple[int, int], ...]
     row_preds: Tuple[Tuple[int, int], ...]
 
@@ -263,19 +263,21 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
             op = it.op
             targets = tuple(op.targets)
             if op.kind == "parity":
+                half = float(op.operand) / 2.0
                 stages.append(ParityStage(
                     tuple(q for q in targets if q < LANE_QUBITS),
                     tuple(q - LANE_QUBITS for q in targets
-                          if q >= LANE_QUBITS),
-                    float(op.operand)))
+                          if q >= LANE_QUBITS)))
+                arrays.append(np.array([[np.cos(half), np.sin(half)]],
+                                       dtype=np.float32))
                 continue
             if op.kind == "diagonal":
                 d = np.asarray(op.operand, dtype=np.complex128).reshape(-1)
                 lane_p, row_p = _split_preds(
                     tuple(zip(op.controls, op.cstates or
                               (1,) * len(op.controls))))
-                stages.append(DiagVecStage(
-                    targets, tuple(d.real), tuple(d.imag), lane_p, row_p))
+                stages.append(DiagVecStage(targets, lane_p, row_p))
+                arrays.append(np.stack([d.real, d.imag]).astype(np.float32))
                 continue
             if op.kind == "allones" and isinstance(
                     op.operand, (int, float, complex)):
@@ -287,7 +289,9 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                 row_b = tuple((q - LANE_QUBITS, s) for q, s in
                               zip(bits, want) if q >= LANE_QUBITS)
                 t = complex(op.operand)
-                stages.append(PhaseStage(lane_b, row_b, t.real, t.imag))
+                stages.append(PhaseStage(lane_b, row_b))
+                arrays.append(np.array([[t.real, t.imag]],
+                                       dtype=np.float32))
                 continue
             flush()
             parts.append(("xla", it))
@@ -582,9 +586,10 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
     return nre, nim
 
 
-def _apply_phase_stage(re, im, st: PhaseStage, row_ids):
+def _apply_phase_stage(re, im, st: PhaseStage, gref, row_ids):
+    g = gref[...]               # (1, 2): [tre, tim]
     mask = _mask_of(row_ids, st.lane_bits, st.row_bits)
-    tre, tim = np.float32(st.tre), np.float32(st.tim)
+    tre, tim = g[0, 0], g[0, 1]
     nre = re * tre - im * tim
     nim = re * tim + im * tre
     if mask is None:            # global phase
@@ -592,7 +597,8 @@ def _apply_phase_stage(re, im, st: PhaseStage, row_ids):
     return jnp.where(mask, nre, re), jnp.where(mask, nim, im)
 
 
-def _apply_parity_stage(re, im, st: ParityStage, row_ids):
+def _apply_parity_stage(re, im, st: ParityStage, gref, row_ids):
+    g = gref[...]               # (1, 2): [cos(angle/2), sin(angle/2)]
     sign = None
     if st.lane_targets:
         ids = _lane_iota()
@@ -605,9 +611,8 @@ def _apply_parity_stage(re, im, st: ParityStage, row_ids):
         for j in st.row_targets:
             s = s * (1.0 - 2.0 * ((row_ids >> j) & 1).astype(jnp.float32))
         sign = s if sign is None else sign * s
-    half = st.angle / 2.0
-    cosf = np.float32(np.cos(half))
-    sinf = np.float32(np.sin(half)) * sign
+    cosf = g[0, 0]
+    sinf = g[0, 1] * sign
     nre = re * cosf + im * sinf
     nim = im * cosf - re * sinf
     return nre, nim
@@ -620,17 +625,18 @@ def _bit_of(q, row_ids):
     return (row_ids >> (q - LANE_QUBITS)) & 1
 
 
-def _apply_diagvec_stage(re, im, st: DiagVecStage, row_ids):
+def _apply_diagvec_stage(re, im, st: DiagVecStage, gref, row_ids):
+    g = gref[...]               # (2, 2^k) re/im entry table
     k = len(st.targets)
-    fre = jnp.full((1, 1), np.float32(st.dre[0]))
-    fim = jnp.full((1, 1), np.float32(st.dim_[0]))
+    fre = g[0, 0].reshape(1, 1)
+    fim = g[1, 0].reshape(1, 1)
     for b in range(1, 1 << k):
         sel = None
         for j, q in enumerate(st.targets):
             m = _bit_of(q, row_ids) == ((b >> j) & 1)
             sel = m if sel is None else (sel & m)
-        fre = jnp.where(sel, np.float32(st.dre[b]), fre)
-        fim = jnp.where(sel, np.float32(st.dim_[b]), fim)
+        fre = jnp.where(sel, g[0, b], fre)
+        fim = jnp.where(sel, g[1, b], fim)
     nre = re * fre - im * fim
     nim = re * fim + im * fre
     mask = _mask_of(row_ids, st.lane_preds, st.row_preds)
@@ -737,29 +743,24 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
 
 
 def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
-    num_mats = sum(isinstance(s, (MatStage, PairStage)) for s in stages)
-    mat_refs = rest[:num_mats]
-    out_ref = rest[num_mats]
+    mat_refs = rest[:len(stages)]   # one operand ref per stage
+    out_ref = rest[len(stages)]
     pids = [pl.program_id(d) for d in range(len(geo.gaps))]
     row_ids = _row_ids(geo, pids)
     blk = in_ref[...]
     re = blk[0].reshape(geo.rows_eff, LANES)
     im = blk[1].reshape(geo.rows_eff, LANES)
-    mi = 0
-    for st in stages:
+    for st, ref in zip(stages, mat_refs):
         if isinstance(st, MatStage):
-            re, im = _apply_mat_stage(re, im, st, mat_refs[mi], geo, row_ids)
-            mi += 1
+            re, im = _apply_mat_stage(re, im, st, ref, geo, row_ids)
         elif isinstance(st, PairStage):
-            re, im = _apply_pair_stage(re, im, st, mat_refs[mi], geo,
-                                       row_ids)
-            mi += 1
+            re, im = _apply_pair_stage(re, im, st, ref, geo, row_ids)
         elif isinstance(st, PhaseStage):
-            re, im = _apply_phase_stage(re, im, st, row_ids)
+            re, im = _apply_phase_stage(re, im, st, ref, row_ids)
         elif isinstance(st, DiagVecStage):
-            re, im = _apply_diagvec_stage(re, im, st, row_ids)
+            re, im = _apply_diagvec_stage(re, im, st, ref, row_ids)
         else:
-            re, im = _apply_parity_stage(re, im, st, row_ids)
+            re, im = _apply_parity_stage(re, im, st, ref, row_ids)
     shape = out_ref.shape
     out_ref[...] = jnp.stack([re, im]).reshape(shape)
 
@@ -809,19 +810,24 @@ def compile_segment(stages: Sequence, n: int,
     block_shape = (2, *blocks, LANES)
     view_shape = (2, *dims, LANES)
 
-    mat_stages = [s for s in stages if isinstance(s, (MatStage, PairStage))]
     kernel = functools.partial(_segment_kernel, stages=tuple(stages),
                                geo=geo)
     in_specs = [pl.BlockSpec(block_shape, index_map)]
-    for st in mat_stages:
+    for st in stages:
         if isinstance(st, PairStage):
             d = st.op_dim
             in_specs.append(
                 pl.BlockSpec((2, 4, d, d), lambda *ids: (0, 0, 0, 0)))
-        else:
+        elif isinstance(st, MatStage):
             d = st.dim
             in_specs.append(
                 pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
+        elif isinstance(st, DiagVecStage):
+            k = len(st.targets)
+            in_specs.append(
+                pl.BlockSpec((2, 1 << k), lambda *ids: (0, 0)))
+        else:                    # PhaseStage / ParityStage value pair
+            in_specs.append(pl.BlockSpec((1, 2), lambda *ids: (0, 0)))
     fn = pl.pallas_call(
         kernel,
         grid=grid,
@@ -847,6 +853,20 @@ def compile_segment(stages: Sequence, n: int,
         return out.reshape(2, -1, LANES)
 
     return apply
+
+
+def compile_segment_cached(cache: dict, stages: Sequence, n: int,
+                           interpret: bool = False):
+    """Kernel-sharing wrapper around compile_segment: stages are pure
+    STRUCTURE (operand values ride as kernel inputs), so segments that
+    differ only in values — e.g. RCS layers with different angles —
+    share one compiled kernel. The ONE place the cache key lives."""
+    key = (tuple(stages), n, interpret)
+    fn = cache.get(key)
+    if fn is None:
+        fn = compile_segment(stages, n, interpret=interpret)
+        cache[key] = fn
+    return fn
 
 
 def usable(n: int) -> bool:

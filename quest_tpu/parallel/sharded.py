@@ -417,6 +417,7 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
     # everything else goes through the explicit sharded appliers
     parts = []        # ("kernel", applier, arrays) | ("sharded", item)
     run_items: list = []
+    seg_cache = {}    # identical-structure segments share one kernel
 
     def close_run():
         nonlocal run_items
@@ -424,8 +425,8 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
             return
         for sub in PB.segment_plan(run_items, local_n):
             if sub[0] == "segment":
-                seg = PB.compile_segment(sub[1], local_n,
-                                         interpret=interpret)
+                seg = PB.compile_segment_cached(seg_cache, sub[1], local_n,
+                                                interpret=interpret)
                 parts.append(("kernel", seg, sub[2]))
             else:
                 parts.append(("sharded", sub[1]))
