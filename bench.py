@@ -74,10 +74,10 @@ def _basis_state(shape):
     full-state buffers; an out-of-jit reshape would relayout-copy —
     either one is 16 GB at 30q)."""
     import jax.numpy as jnp
-    from quest_tpu.state import _basis_planes
+    from quest_tpu.state import basis_planes
 
     n = int(np.prod(shape)).bit_length() - 2  # shape holds 2 * 2^n reals
-    return _basis_planes(0, n=n, rdt=jnp.float32, shape=shape)
+    return basis_planes(0, n=n, rdt=jnp.float32, shape=shape)
 
 
 def _warm_step(n: int):
@@ -107,7 +107,8 @@ def _warm_step(n: int):
                                            iters=INNER_STEPS)
                 # the fused engine's native boundary shape: same physical
                 # tiling as its kernel views (flat would retile per call)
-                shape = (2, 1 << (n - 7), 128)
+                from quest_tpu.state import fused_state_shape
+                shape = fused_state_shape(n)
             else:
                 step = circ.compiled(n, density=False, donate=True,
                                      iters=INNER_STEPS)

@@ -78,7 +78,7 @@ def bench_tutorial():
 def bench_rcs():
     from quest_tpu.circuit import random_circuit
 
-    from quest_tpu.state import _basis_planes
+    from quest_tpu.state import basis_planes, fused_state_shape
 
     n = 30 if _on_tpu() else 20
     depth = 20
@@ -90,11 +90,11 @@ def bench_rcs():
         # reshape or a zeros().at.set would transiently double the 8 GB
         # state at 30q)
         fn = circ.compiled_fused(n, density=False, donate=True)
-        amps = _basis_planes(0, n=n, rdt=jnp.float32,
-                             shape=(2, 1 << (n - 7), 128))
+        amps = basis_planes(0, n=n, rdt=jnp.float32,
+                            shape=fused_state_shape(n))
     else:
         fn = circ.compiled_banded(n, density=False, donate=True)
-        amps = _basis_planes(0, n=n, rdt=jnp.float32)
+        amps = basis_planes(0, n=n, rdt=jnp.float32)
     amps = fn(amps)
     _sync(amps)
     t0 = time.perf_counter()
@@ -185,11 +185,11 @@ def bench_qft_sharded():
     d = 1 << (len(devices).bit_length() - 1)
     n = 26 if _on_tpu() else 20
     mesh = make_amp_mesh(d)
-    from quest_tpu.state import _basis_planes
+    from quest_tpu.state import basis_planes
 
     circ = qft_circuit(n)
     fn = circ.compiled_sharded(n, density=False, mesh=mesh, donate=True)
-    amps = _basis_planes(0, n=n, rdt=jnp.float32)
+    amps = basis_planes(0, n=n, rdt=jnp.float32)
     amps = jax.device_put(amps, amp_sharding(mesh))
     amps = fn(amps)
     _sync(amps)

@@ -101,6 +101,26 @@ def _basis_planes(flat_index, *, n, rdt, shape=None):
                             n=n, rdt=rdt, shape=shape)
 
 
+def basis_planes(flat_index, *, n, rdt=np.float32, shape=None):
+    """PUBLIC: the (2, 2^n) re/im planes of computational-basis state
+    |flat_index>, built in one fused device buffer, optionally directly
+    in a caller-chosen view `shape` (see fused_state_shape — building in
+    the target layout avoids an out-of-jit relayout copy, 8 GB at 30q).
+    Benchmarks and scripts should use this instead of allocating
+    zeros().at[...].set(...)."""
+    return _basis_planes(flat_index, n=n, rdt=rdt, shape=shape)
+
+
+def fused_state_shape(n: int):
+    """The fused (Pallas band-segment) engine's native state view for an
+    n-qubit register: (2, 2^(n-7), 128) — same physical (8, 128) tiling
+    as the kernel blocks, so engine-boundary reshapes are free bitcasts.
+    The ONE place this layout constant lives for out-of-package callers
+    (compiled_fused callers, bench.py, benchmarks/run.py)."""
+    from quest_tpu.ops.pallas_band import LANE_QUBITS, LANES
+    return (2, 1 << (n - LANE_QUBITS), LANES)
+
+
 def _make(num_qubits: int, is_density: bool, dtype, sharding=None) -> Qureg:
     validation.validate_num_qubits(num_qubits)
     dtype = np.dtype(dtype) if dtype is not None else precision.get_default_dtype()

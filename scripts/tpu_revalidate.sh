@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-shot revalidation after TPU access returns (the axon tunnel drops
+# occasionally): on-chip smoke tests, the headline bench, and the 30q
+# RCS wall-clock, in the order that surfaces failures fastest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== devices =="
+timeout 300 python -c "import jax; print(jax.devices())" || {
+    echo "TPU still unreachable"; exit 1; }
+
+echo "== on-chip smoke tests =="
+QUEST_TEST_PLATFORM=axon timeout 1500 python -m pytest tests/test_tpu_smoke.py -q || exit 1
+
+echo "== headline bench =="
+timeout 1500 python bench.py || exit 1
+
+echo "== 30q depth-20 RCS wall-clock (benchmarks/run.py rcs) =="
+timeout 1500 python -u benchmarks/run.py rcs || exit 1

@@ -83,6 +83,23 @@ def test_density_channels_on_chip():
     np.testing.assert_allclose(got, want, atol=2e-5 * scale, rtol=0)
 
 
+def test_full_scb_band_on_chip():
+    """A d=128 scb stage (whole high band as one MXU dot over merged
+    scattered axes) compiled for the real chip: numerics vs the per-gate
+    path, plus cross-band couplings into and out of the band."""
+    from quest_tpu.circuit import Circuit
+
+    n = 22
+    c = Circuit(n)
+    for q in range(14, 21):
+        c.ry(q, 0.13 * (q - 13))   # composes into one d=128 scb
+    c.cz(13, 14)                   # couples sublane band to the scb band
+    c.x(15, 21)                    # scb-band target, top-qubit control
+    c.h(2)
+    c.rz(18, 0.7)
+    _check_engine_matches(c, n)
+
+
 def test_kernel_bandwidth_floor():
     """A warmed 16-gate fused step must beat 10x the reference's measured
     single-core CPU throughput at the same size — a deliberately
