@@ -363,28 +363,28 @@ class Circuit:
         # band path
         items = F.plan(flat, n, bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
-        appliers = []   # segment appliers work on (2, rows, 128); XLA
-        # passthroughs flatten and restore around their op
         seg_cache = {}  # identical-structure segments share one kernel
-        for part in parts:
+
+        def make_applier(part):
+            # segment appliers work on (2, rows, 128); XLA passthroughs
+            # flatten and restore around their op
             if part[0] == "segment":
                 _, stages, arrays = part
                 seg = PB.compile_segment_cached(seg_cache, stages, n,
                                                 interpret=interpret)
-                appliers.append(
-                    lambda amps, seg=seg, arrays=arrays: seg(amps, arrays))
+                return lambda amps, seg=seg, arrays=arrays: seg(amps, arrays)
+            it = part[1]
+            if isinstance(it, F.BandOp):
+                xla_fn = (lambda a, it=it: A.apply_band(
+                    a, n, (it.gre, it.gim), it.ql, it.w, it.preds))
+            elif isinstance(it, F.DiagItem):
+                xla_fn = lambda a, it=it: _apply_one(a, n, it.op)
             else:
-                it = part[1]
-                if isinstance(it, F.BandOp):
-                    xla_fn = (lambda a, it=it: A.apply_band(
-                        a, n, (it.gre, it.gim), it.ql, it.w, it.preds))
-                elif isinstance(it, F.DiagItem):
-                    xla_fn = lambda a, it=it: _apply_one(a, n, it.op)
-                else:
-                    xla_fn = lambda a, it=it: _apply_op(a, n, False, it.op)
-                appliers.append(
-                    lambda amps, f=xla_fn: f(amps.reshape(2, -1))
-                    .reshape(amps.shape))
+                xla_fn = lambda a, it=it: _apply_op(a, n, False, it.op)
+            return (lambda amps, f=xla_fn:
+                    f(amps.reshape(2, -1)).reshape(amps.shape))
+
+        appliers = [make_applier(pt) for pt in parts]
 
         def run(amps):
             # the Pallas kernels are f32-only; f64 registers keep their

@@ -80,6 +80,10 @@ MAX_BLOCK_ROW_BITS = 13  # cap on in-block row bits (sublane floor +
 # kernel stack holds it double-buffered in+out plus stage temporaries
 # (measured: 2^14 rows hit 118 MiB of scoped VMEM and failed to compile,
 # so a b1 stage and a full 7-bit scb get separate segments)
+MAX_SEGMENT_STAGES = 32  # stages per kernel launch: operand blocks are
+# resident in VMEM (a 128x128 operator pair is 131 KiB), so unbounded
+# deep circuits at small n — where few flushes happen naturally — would
+# otherwise accumulate hundreds of operands per segment
 VMEM_LIMIT_BYTES = 100 * (1 << 20)  # v5e has 128 MiB VMEM; the default
 # 16 MiB scoped limit rejects multi-stage kernels (measured round 1/2)
 
@@ -227,6 +231,8 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
         return True
 
     for it in items:
+        if len(stages) >= MAX_SEGMENT_STAGES:
+            flush()
         if isinstance(it, F.BandOp):
             lane_p, row_p = _split_preds(it.preds)
             real_only = bool(np.all(it.gim == 0.0))

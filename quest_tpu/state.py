@@ -117,7 +117,11 @@ def fused_state_shape(n: int):
     as the kernel blocks, so engine-boundary reshapes are free bitcasts.
     The ONE place this layout constant lives for out-of-package callers
     (compiled_fused callers, bench.py, benchmarks/run.py)."""
-    from quest_tpu.ops.pallas_band import LANE_QUBITS, LANES
+    from quest_tpu.ops.pallas_band import LANE_QUBITS, LANES, usable
+    if not usable(n):
+        raise ValueError(
+            f"the fused engine needs n >= {LANE_QUBITS + 3} qubits "
+            f"(one (8, 128) f32 tile per block), got n={n}")
     return (2, 1 << (n - LANE_QUBITS), LANES)
 
 

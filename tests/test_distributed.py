@@ -414,3 +414,19 @@ def test_fused_sharded_plan_has_kernel_parts(mesh):
     segs = [p for p in PB.segment_plan(local, local_n)
             if p[0] == "segment"]
     assert segs, "local items produced no kernel segments"
+
+
+@pytest.mark.parametrize("ndev", [2, 4])
+def test_fused_sharded_other_mesh_sizes(ndev):
+    """The fused sharded engine must agree with the single-device path at
+    every mesh size (different shard boundaries move the local/global
+    qubit split, exercising different segment plans)."""
+    mesh_d = make_amp_mesh(ndev)
+    c = random_circuit(NF, depth=2, seed=31)
+    q1 = qt.init_debug_state(qt.create_qureg(NF, dtype=np.complex64))
+    q2 = qt.init_debug_state(qt.create_qureg(NF, dtype=np.complex64))
+    want = to_dense(c.apply(q1))
+    got = to_dense(c.apply_sharded_fused(shard_qureg(q2, mesh_d), mesh_d,
+                                         interpret=True))
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=1e-4 * scale, rtol=0)

@@ -388,3 +388,21 @@ def test_channel_builders_validate():
         c.kraus(0, [np.eye(2) * 0.5])          # non-CPTP
     with pytest.raises(QuESTError):
         c.kraus((0, 1), [np.eye(2)])           # dim mismatch
+
+
+def test_deep_circuit_segment_stage_cap():
+    """Deep circuits split at MAX_SEGMENT_STAGES so kernel operand blocks
+    cannot accumulate without bound in VMEM; numerics unchanged."""
+    rng = np.random.default_rng(7)
+    n, depth = 12, 60
+    c = Circuit(n)
+    for d in range(depth):
+        for q in range(n):
+            c.rx(q, float(rng.uniform(0, 2 * np.pi)))
+        for q in range(d % 2, n - 1, 2):
+            c.cz(q, q + 1)
+    parts = parts_of(c, n=n)
+    segs = [p for p in parts if p[0] == "segment"]
+    assert len(segs) >= 2
+    assert all(len(s[1]) <= PB.MAX_SEGMENT_STAGES + 1 for s in segs)
+    check(c, n=n, tol=5e-5)
