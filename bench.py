@@ -180,7 +180,9 @@ def _baseline_gates_per_sec(n: int) -> tuple[float, str]:
 
 
 def main():
-    platform = jax.devices()[0].platform
+    from quest_tpu.env import ensure_live_backend
+    ensure_live_backend()          # may pin the CPU platform (loudly)
+    platform = jax.devices()[0].platform  # the in-process truth
     on_tpu = platform in ("tpu", "axon")
     if on_tpu:
         sizes, reps = (30, 28, 26, 24, 22), 5

@@ -86,6 +86,42 @@ def destroy_quest_env(env: QuESTEnv) -> None:
     """No resources to free in the functional design; kept for API parity."""
 
 
+def ensure_live_backend(timeout_s: int = 240) -> str:
+    """Probe the default JAX backend in a SUBPROCESS and return its
+    platform name, falling back to the host CPU when it is unreachable.
+
+    The tunneled TPU backend can drop for hours (observed in round 2);
+    an in-process jax.devices() then hangs indefinitely and would wedge
+    whatever called it — the benchmark, the driver's dryrun. Probing in
+    a subprocess bounds the wait; on failure the CURRENT process is
+    switched to the CPU platform (jax.config, the only override that
+    works after the container's sitecustomize pre-captures env vars) so
+    callers still produce a result."""
+    import subprocess
+    import sys
+    import time as _time
+    code = "import jax; print(jax.devices()[0].platform)"
+    last_err = ""
+    for attempt in range(3):
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 timeout=timeout_s, capture_output=True,
+                                 text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"probe timed out after {timeout_s}s (tunnel down?)"
+            break   # a hung init rarely clears quickly; don't triple the wait
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+        # fast nonzero exit: often another process holds the device's
+        # exclusive lock — that can clear, so retry before downgrading
+        last_err = (out.stderr or "").strip()[-500:]
+        _time.sleep(20)
+    print(f"[quest_tpu] default backend unavailable, falling back to host "
+          f"CPU. Last probe error: {last_err}", file=sys.stderr, flush=True)
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
 def sync_array(x) -> None:
     """Block until `x` (and the queued computation chain behind it) has
     ACTUALLY executed, by materializing one 4-element slice on the host.
