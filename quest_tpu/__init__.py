@@ -55,5 +55,6 @@ from quest_tpu import qasm
 from quest_tpu import api
 from quest_tpu import checkpoint
 from quest_tpu import profiling
+from quest_tpu import variational
 
 __version__ = "0.1.0"

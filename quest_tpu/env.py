@@ -100,9 +100,21 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
     import subprocess
     import sys
     import time as _time
+    from jax._src import xla_bridge as _xb
+    try:
+        already = bool(_xb._backends)
+    except Exception:
+        already = False
+    if already:
+        # This process has committed to a backend: a probe child would
+        # deadlock against OUR device lock, and jax_platforms cannot be
+        # rebound after init — nothing useful to do but report.
+        return jax.devices()[0].platform
+
     code = "import jax; print(jax.devices()[0].platform)"
     last_err = ""
-    for attempt in range(3):
+    attempts = 3
+    for attempt in range(attempts):
         try:
             out = subprocess.run([sys.executable, "-c", code],
                                  timeout=timeout_s, capture_output=True,
@@ -115,7 +127,8 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
         # fast nonzero exit: often another process holds the device's
         # exclusive lock — that can clear, so retry before downgrading
         last_err = (out.stderr or "").strip()[-500:]
-        _time.sleep(20)
+        if attempt < attempts - 1:
+            _time.sleep(20)
     print(f"[quest_tpu] default backend unavailable, falling back to host "
           f"CPU. Last probe error: {last_err}", file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")

@@ -84,9 +84,22 @@ def bit_tensor(ndims: int, axis: int):
     return jnp.arange(2).reshape(shape)
 
 
+def norm_control_states(controls, control_states):
+    """Empty `control_states` means all-ones. The ONE place this
+    normalization lives: a silent zip truncation against default-empty
+    states once DROPPED controls entirely (found by the variational
+    tests) — every consumer that pairs controls with states must
+    normalize through here first."""
+    if controls and not control_states:
+        return (1,) * len(controls)
+    assert len(controls) == len(control_states), (controls, control_states)
+    return tuple(control_states)
+
+
 def control_mask(ndims: int, axis_of, controls, control_states):
     """Boolean tensor broadcastable against the segment view, True where all
     control qubits carry their required state; None if no controls."""
+    control_states = norm_control_states(controls, control_states)
     mask = None
     for c, s in zip(controls, control_states):
         vec = bit_tensor(ndims, axis_of[c]) == s
@@ -122,6 +135,7 @@ def apply_matrix(
     register). Returns the new (2, 2^n) planes."""
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
+    control_states = norm_control_states(controls, control_states)
     k = len(targets)
     if k > _UNROLL_MAX_TARGETS:
         return _apply_matrix_matmul(amps, n, op_pair, targets, controls,
