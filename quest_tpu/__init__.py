@@ -56,5 +56,6 @@ from quest_tpu import api
 from quest_tpu import checkpoint
 from quest_tpu import profiling
 from quest_tpu import variational
+from quest_tpu import trajectories
 
 __version__ = "0.1.0"

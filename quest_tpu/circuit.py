@@ -234,24 +234,19 @@ class Circuit:
         from quest_tpu import validation as val
         p = float(prob)
         val.validate_one_qubit_damping_prob(p)
-        k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]])
-        k1 = np.array([[0, np.sqrt(p)], [0, 0]])
-        return self.kraus(target, [k0, k1])
+        return self.kraus(target, M.damping_kraus(p))
 
     def depolarising(self, target, prob):
         from quest_tpu import validation as val
         p = float(prob)
         val.validate_one_qubit_depol_prob(p)
-        ops = [np.sqrt(1 - p) * M.PAULI_I, np.sqrt(p / 3) * M.PAULI_X,
-               np.sqrt(p / 3) * M.PAULI_Y, np.sqrt(p / 3) * M.PAULI_Z]
-        return self.kraus(target, ops)
+        return self.kraus(target, M.depolarising_kraus(p))
 
     def dephasing(self, target, prob):
         from quest_tpu import validation as val
         p = float(prob)
         val.validate_one_qubit_dephase_prob(p)
-        ops = [np.sqrt(1 - p) * M.PAULI_I, np.sqrt(p) * M.PAULI_Z]
-        return self.kraus(target, ops)
+        return self.kraus(target, M.dephasing_kraus(p))
 
     def cu(self, matrix, target, *controls, cstates=None):
         """Arbitrary single/multi-controlled k-qubit unitary."""

@@ -198,9 +198,7 @@ def mix_pauli(q: Qureg, target: int, prob_x, prob_y, prob_z) -> Qureg:
     val.validate_density_matr(q)
     val.validate_target(q, target)
     val.validate_pauli_probs(float(prob_x), float(prob_y), float(prob_z))
-    pi = 1.0 - float(prob_x) - float(prob_y) - float(prob_z)
-    ops = [np.sqrt(pi) * M.PAULI_I, np.sqrt(float(prob_x)) * M.PAULI_X,
-           np.sqrt(float(prob_y)) * M.PAULI_Y, np.sqrt(float(prob_z)) * M.PAULI_Z]
+    ops = M.pauli_kraus(float(prob_x), float(prob_y), float(prob_z))
     return _mix_packed(q, (target,), M.kraus_superoperator(ops))
 
 

@@ -75,6 +75,33 @@ def phase_diag(angle) -> np.ndarray:
     return np.array([1.0, np.exp(1j * float(angle))])
 
 
+def damping_kraus(p: float):
+    """Amplitude-damping Kraus pair {K0=diag(1,sqrt(1-p)), K1=sqrt(p)|0><1|}
+    (ref mixDamping operators, QuEST_cpu.c:130-180). The ONE place these
+    live — shared by the density channels, circuit builders, and the
+    trajectory unraveling."""
+    return [np.array([[1.0, 0.0], [0.0, np.sqrt(1.0 - p)]]),
+            np.array([[0.0, np.sqrt(p)], [0.0, 0.0]])]
+
+
+def dephasing_kraus(p: float):
+    """Phase-damping pair {sqrt(1-p) I, sqrt(p) Z} (ref mixDephasing)."""
+    return [np.sqrt(1.0 - p) * PAULI_I, np.sqrt(p) * PAULI_Z]
+
+
+def depolarising_kraus(p: float):
+    """Depolarising quadruple (ref mixDepolarising)."""
+    return [np.sqrt(1.0 - p) * PAULI_I, np.sqrt(p / 3.0) * PAULI_X,
+            np.sqrt(p / 3.0) * PAULI_Y, np.sqrt(p / 3.0) * PAULI_Z]
+
+
+def pauli_kraus(px: float, py: float, pz: float):
+    """Probabilistic-Pauli quadruple (ref densmatr_mixPauli,
+    QuEST_common.c:675-695)."""
+    return [np.sqrt(1.0 - px - py - pz) * PAULI_I, np.sqrt(px) * PAULI_X,
+            np.sqrt(py) * PAULI_Y, np.sqrt(pz) * PAULI_Z]
+
+
 def kraus_superoperator(ops) -> np.ndarray:
     """Sum_k conj(K_k) (x) K_k, a 2k-qubit operator on the doubled register.
 

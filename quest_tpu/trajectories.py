@@ -1,0 +1,112 @@
+"""Quantum-trajectory noise simulation: stochastic Kraus unraveling.
+
+The reference simulates noise ONLY via density matrices — 2^{2N}
+amplitudes (`QuEST.c` mixDamping/mixKrausMap on the doubled register),
+which caps noisy registers at half the qubit count of pure states. The
+trajectory method unravels a channel into a stochastic choice of Kraus
+branch per shot: each trajectory is a STATEVECTOR (2^N), and averaging
+|psi><psi| over shots converges to the channel's density matrix. On TPU
+the method is a natural fit: a trajectory is a pure traced function of a
+`jax.random` key, so `jax.vmap` runs a whole batch of shots as one
+compiled program, and every gate inside rides the same engines as
+noiseless simulation.
+
+    key = jax.random.key(0)
+    def shot(k):
+        amps = state.basis_planes(0, n=n, rdt=jnp.float32)
+        amps = V.h(amps, n, 0)
+        amps, k, _ = T.damping(amps, k, n, 0, 0.3)
+        amps, k, _ = T.depolarising(amps, k, n, 1, 0.1)
+        return amps
+    batch = jax.vmap(shot)(jax.random.split(key, 4096))  # (shots, 2, 2^n)
+
+Averages of observables over the batch estimate the open-system result
+to O(1/sqrt(shots)); `tests/test_trajectories.py` pins the estimator
+against the exact density-matrix engine.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import cplx
+from quest_tpu.ops import apply as A
+from quest_tpu.ops import matrices as M
+
+
+def kraus(amps, key, n, targets, ops: Sequence) -> Tuple:
+    """One stochastic application of the Kraus map {K_k} to `targets`:
+    branch k is drawn with Born probability p_k = ||K_k psi||^2 and the
+    state renormalizes to K_k psi / sqrt(p_k). Returns
+    (new_amps, next_key, branch_index).
+
+    All branches are evaluated (their norms are needed for the
+    probabilities anyway) and the draw selects via a one-hot weighted
+    sum — branch-free, so the whole thing jits and vmaps cleanly."""
+    targets = (targets,) if np.isscalar(targets) else tuple(targets)
+    ops = [np.asarray(K, dtype=np.complex128) for K in ops]
+    key, sub = jax.random.split(key)
+    ws = [A.apply_matrix(amps, n, cplx.pack(K), targets) for K in ops]
+    ps = jnp.stack([jnp.sum(w[0] * w[0] + w[1] * w[1]) for w in ws])
+    k = jax.random.categorical(sub, jnp.log(ps + 1e-30))
+    onehot = jax.nn.one_hot(k, len(ops), dtype=amps.dtype)
+    w = ws[0] * onehot[0]
+    for i in range(1, len(ops)):
+        w = w + ws[i] * onehot[i]
+    return w / jnp.sqrt(ps[k]), key, k
+
+
+def _validate_channel_prob(p: float, what: str) -> float:
+    """Trajectory channels accept the full CPTP range 0 <= p <= 1 —
+    wider than the density API's maximal-mixing caps (1/2, 3/4, ...,
+    QuEST_validation.c:113-117), which encode a convention, not
+    validity. Out-of-range still fails loudly instead of unraveling to
+    an all-NaN state."""
+    from quest_tpu.validation import QuESTError
+    p = float(p)
+    if not (0.0 <= p <= 1.0):
+        raise QuESTError(
+            f"Invalid probability: the {what} probability must be in "
+            f"[0, 1] for a trajectory unraveling, got {p}")
+    return p
+
+
+def damping(amps, key, n, target, prob):
+    """Amplitude damping as a trajectory branch (ref mixDamping
+    semantics, QuEST_cpu.c:48-130 — here at statevector cost)."""
+    p = _validate_channel_prob(prob, "damping")
+    return kraus(amps, key, n, target, M.damping_kraus(p))
+
+
+def dephasing(amps, key, n, target, prob):
+    """Phase damping (ref mixDephasing)."""
+    p = _validate_channel_prob(prob, "dephasing")
+    return kraus(amps, key, n, target, M.dephasing_kraus(p))
+
+
+def depolarising(amps, key, n, target, prob):
+    """Depolarising channel (ref mixDepolarising)."""
+    p = _validate_channel_prob(prob, "depolarising")
+    return kraus(amps, key, n, target, M.depolarising_kraus(p))
+
+
+def pauli(amps, key, n, target, px, py, pz):
+    """Probabilistic Pauli error (ref mixPauli)."""
+    px = _validate_channel_prob(px, "Pauli-X")
+    py = _validate_channel_prob(py, "Pauli-Y")
+    pz = _validate_channel_prob(pz, "Pauli-Z")
+    _validate_channel_prob(px + py + pz, "total Pauli error")
+    return kraus(amps, key, n, target, M.pauli_kraus(px, py, pz))
+
+
+def average_density(batch) -> jax.Array:
+    """Dense (2^n, 2^n) estimator: mean over the shot axis of
+    |psi><psi|. For validation at small n — real workloads should
+    average observables instead (O(shots * 2^n), not O(shots * 4^n))."""
+    re, im = batch[:, 0, :], batch[:, 1, :]
+    psi = re + 1j * im
+    return jnp.einsum("sa,sb->ab", psi, psi.conj()) / psi.shape[0]

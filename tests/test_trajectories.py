@@ -1,0 +1,99 @@
+"""Quantum-trajectory unraveling (quest_tpu/trajectories.py): averaged
+trajectories must converge to the exact density-matrix engine's channel
+output (the oracle here is the already-oracle-verified channels module),
+and the per-branch mechanics must be exact."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu import trajectories as T
+from quest_tpu.ops import channels as ch
+from quest_tpu.ops import gates as G
+from quest_tpu.state import basis_planes, to_dense
+
+N = 3
+SHOTS = 4096
+
+
+def _exact_rho(build_channels):
+    q = qt.create_density_qureg(N, dtype=np.complex128)
+    q = G.hadamard(q, 0)
+    q = G.controlled_not(q, 0, 1)
+    q = G.rotate_y(q, 2, 0.7)
+    q = build_channels(q)
+    return to_dense(q)
+
+
+def _trajectory_rho(apply_noise, shots=SHOTS):
+    def shot(key):
+        amps = basis_planes(0, n=N, rdt=jnp.float32)
+        amps = qt.variational.h(amps, N, 0)
+        amps = qt.variational.cnot(amps, N, 0, 1)
+        amps = qt.variational.ry(amps, N, 2, 0.7)
+        amps, key = apply_noise(amps, key)
+        return amps
+
+    keys = jax.random.split(jax.random.key(11), shots)
+    batch = jax.jit(jax.vmap(shot))(keys)
+    return np.asarray(T.average_density(batch))
+
+
+def _check(build_channels, apply_noise, tol=0.05):
+    want = _exact_rho(build_channels)
+    got = _trajectory_rho(apply_noise)
+    assert np.max(np.abs(got - want)) < tol, np.max(np.abs(got - want))
+
+
+def test_damping_trajectories_converge():
+    _check(lambda q: ch.mix_damping(q, 0, 0.3),
+           lambda a, k: T.damping(a, k, N, 0, 0.3)[:2])
+
+
+def test_depolarising_trajectories_converge():
+    _check(lambda q: ch.mix_depolarising(q, 1, 0.2),
+           lambda a, k: T.depolarising(a, k, N, 1, 0.2)[:2])
+
+
+def test_dephasing_and_pauli_trajectories_converge():
+    def chans(q):
+        q = ch.mix_dephasing(q, 2, 0.25)
+        return ch.mix_pauli(q, 0, 0.05, 0.1, 0.15)
+
+    def noise(a, k):
+        a, k, _ = T.dephasing(a, k, N, 2, 0.25)
+        a, k, _ = T.pauli(a, k, N, 0, 0.05, 0.1, 0.15)
+        return a, k
+    _check(chans, noise)
+
+
+def test_branch_probabilities_and_renormalization():
+    """On |1>, damping(p) must take branch 1 (decay to |0>) with
+    probability p, and each branch's state must be exactly normalized."""
+    p = 0.3
+    amps0 = basis_planes(1, n=N, rdt=jnp.float64)
+
+    def shot(key):
+        amps, _, k = T.damping(amps0, key, N, 0, p)
+        norm = jnp.sum(amps[0] ** 2 + amps[1] ** 2)
+        return k, norm
+
+    keys = jax.random.split(jax.random.key(3), 2000)
+    ks, norms = jax.vmap(shot)(keys)
+    np.testing.assert_allclose(np.asarray(norms), 1.0, atol=1e-12)
+    frac = float(np.mean(np.asarray(ks) == 1))
+    assert abs(frac - p) < 0.04, frac
+
+
+def test_trajectory_memory_is_statevector_sized():
+    """The point of the method: a noisy shot at n qubits touches only
+    (2, 2^n) planes — no doubled register anywhere."""
+    def shot(key):
+        amps = basis_planes(0, n=N, rdt=jnp.float32)
+        amps, key, _ = T.damping(amps, key, N, 0, 0.2)
+        return amps
+    out = shot(jax.random.key(0))
+    assert out.shape == (2, 1 << N)
