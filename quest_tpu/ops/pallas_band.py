@@ -235,7 +235,6 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
             flush()
         if isinstance(it, F.BandOp):
             lane_p, row_p = _split_preds(it.preds)
-            real_only = bool(np.all(it.gim == 0.0))
             if it.ql == 0:
                 kind, bit = "b0", -1
                 g = it.gre.T + 1j * it.gim.T       # X @ G^T form
@@ -254,11 +253,30 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                 kind = "scb"       # merged scattered axes
                 bit = it.ql - LANE_QUBITS
                 g = it.gre + 1j * it.gim
-                if not reserve(bits=range(bit, bit + it.w)):
+                w = it.w
+                # a run that only mixed SOME of the band's qubits (QFT's
+                # per-qubit Hadamards, sparse circuits) is often an exact
+                # embedding over a narrower sub-range: contract only the
+                # spanning sub-band — a 2x2 butterfly instead of a padded
+                # 128-dot for a lone gate, fewer scattered axes always
+                nd = sorted(q - it.ql for q in it.nondiag
+                            if it.ql <= q < it.ql + it.w)
+                if nd and (nd[0] > 0 or nd[-1] < it.w - 1):
+                    j0, w2 = nd[0], nd[-1] - nd[0] + 1
+                    idx = [x << j0 for x in range(1 << w2)]
+                    sub = g[np.ix_(idx, idx)]
+                    if np.allclose(g, F.embed_operator(
+                            sub, list(range(j0, j0 + w2)), [], [], it.w)):
+                        kind = "scb" if w2 > 1 else "sc"
+                        bit = bit + j0
+                        g = sub
+                        w = w2
+                if not reserve(bits=range(bit, bit + w)):
                     flush()
                     parts.append(("xla", it))
                     continue
-            stages.append(MatStage(kind, 1 << it.w, real_only, lane_p,
+            real_only = bool(np.all(g.imag == 0.0))
+            stages.append(MatStage(kind, g.shape[0], real_only, lane_p,
                                    row_p, bit))
             # keep operator arrays HOST-side (numpy): as closure
             # constants they upload with the program instead of occupying

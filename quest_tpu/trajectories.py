@@ -49,6 +49,11 @@ def kraus(amps, key, n, targets, ops: Sequence) -> Tuple:
     sum — branch-free, so the whole thing jits and vmaps cleanly."""
     targets = (targets,) if np.isscalar(targets) else tuple(targets)
     ops = [np.asarray(K, dtype=np.complex128) for K in ops]
+    # same CPTP check as the density engine's mix_kraus_map: a
+    # mis-normalized set would otherwise converge silently to a
+    # DIFFERENT channel (categorical renormalizes the probabilities)
+    from quest_tpu import validation as val
+    val.validate_kraus_ops(ops, len(targets))
     key, sub = jax.random.split(key)
     ws = [A.apply_matrix(amps, n, cplx.pack(K), targets) for K in ops]
     ps = jnp.stack([jnp.sum(w[0] * w[0] + w[1] * w[1]) for w in ws])
@@ -58,6 +63,24 @@ def kraus(amps, key, n, targets, ops: Sequence) -> Tuple:
     for i in range(1, len(ops)):
         w = w + ws[i] * onehot[i]
     return w / jnp.sqrt(ps[k]), key, k
+
+
+def unitary_mixture(amps, key, n, targets, probs, unitaries) -> Tuple:
+    """Stochastic application of a UNITARY mixture sum_k p_k U . U+:
+    the branch probabilities are state-independent, so the draw happens
+    first and only the chosen branch applies (lax.switch) — one gate
+    per shot instead of one per branch. This covers every unital Pauli
+    channel (dephasing/depolarising/pauli); general Kraus maps need
+    `kraus` (state-dependent Born probabilities)."""
+    targets = (targets,) if np.isscalar(targets) else tuple(targets)
+    probs = np.asarray(probs, dtype=np.float64)
+    key, sub = jax.random.split(key)
+    k = jax.random.categorical(sub, jnp.log(jnp.asarray(probs) + 1e-30))
+    branches = [
+        (lambda a, U=np.asarray(U, dtype=np.complex128):
+         A.apply_matrix(a, n, cplx.pack(U), targets))
+        for U in unitaries]
+    return jax.lax.switch(k, branches, amps), key, k
 
 
 def _validate_channel_prob(p: float, what: str) -> float:
@@ -83,24 +106,30 @@ def damping(amps, key, n, target, prob):
 
 
 def dephasing(amps, key, n, target, prob):
-    """Phase damping (ref mixDephasing)."""
+    """Phase damping (ref mixDephasing) — a unitary mixture, so only
+    the drawn branch applies."""
     p = _validate_channel_prob(prob, "dephasing")
-    return kraus(amps, key, n, target, M.dephasing_kraus(p))
+    return unitary_mixture(amps, key, n, target, [1.0 - p, p],
+                           [M.PAULI_I, M.PAULI_Z])
 
 
 def depolarising(amps, key, n, target, prob):
-    """Depolarising channel (ref mixDepolarising)."""
+    """Depolarising channel (ref mixDepolarising) — unitary mixture."""
     p = _validate_channel_prob(prob, "depolarising")
-    return kraus(amps, key, n, target, M.depolarising_kraus(p))
+    return unitary_mixture(amps, key, n, target,
+                           [1.0 - p, p / 3.0, p / 3.0, p / 3.0],
+                           list(M.PAULIS))
 
 
 def pauli(amps, key, n, target, px, py, pz):
-    """Probabilistic Pauli error (ref mixPauli)."""
+    """Probabilistic Pauli error (ref mixPauli) — unitary mixture."""
     px = _validate_channel_prob(px, "Pauli-X")
     py = _validate_channel_prob(py, "Pauli-Y")
     pz = _validate_channel_prob(pz, "Pauli-Z")
     _validate_channel_prob(px + py + pz, "total Pauli error")
-    return kraus(amps, key, n, target, M.pauli_kraus(px, py, pz))
+    return unitary_mixture(amps, key, n, target,
+                           [1.0 - px - py - pz, px, py, pz],
+                           list(M.PAULIS))
 
 
 def average_density(batch) -> jax.Array:

@@ -235,15 +235,39 @@ def test_full_high_band_scb():
 
 
 def test_oversized_band_passthrough_under_small_budget():
-    """A 7-wide high band cannot fit a scatter budget smaller than its
-    width even in a fresh segment — it must fall back to an XLA
-    passthrough, never silently over-claim scattered axes."""
+    """A high-band operator spanning more scattered bits than the budget
+    allows even in a fresh segment must fall back to an XLA passthrough,
+    never silently over-claim axes. (A lone h(14) no longer triggers
+    this — sub-band extraction shrinks it to one scattered bit.)"""
     n = 23
     c = Circuit(n)
-    c.h(14)                   # band (14, 7): needs 7 scat bits
+    c.h(14)
+    c.h(20)                   # composed span covers the whole (14, 7) band
     parts = parts_of(c, n=n, scatter_max=5)
     assert [p[0] for p in parts] == ["xla"]
     assert isinstance(parts[0][1], F.BandOp) and parts[0][1].w == 7
+
+
+def test_sparse_high_band_extracts_sub_band():
+    """A lone high-qubit gate costs one scattered-bit butterfly, and a
+    2-qubit-support run costs a d=4 sub-band dot — never the padded
+    full-band contraction."""
+    n = 23
+    c = Circuit(n)
+    c.h(16)
+    parts = parts_of(c, n=n)
+    (st,) = parts[0][1]
+    assert st.kind == "sc" and st.bit == 9 and st.dim == 2
+    check(c, n=n)
+
+    c2 = Circuit(n)
+    c2.ry(15, 0.3)
+    c2.ry(16, 0.7)
+    c2.cz(15, 16)
+    parts = parts_of(c2, n=n)
+    (st,) = parts[0][1]
+    assert st.kind == "scb" and st.bit == 8 and st.dim == 4
+    check(c2, n=n)
 
 
 def test_scatter_overflow_splits_segment():
@@ -251,8 +275,9 @@ def test_scatter_overflow_splits_segment():
     separate segments; numerics still match."""
     n = 23
     c = Circuit(n)
-    c.h(14)                   # band (14, 7): scb needing 7 scat bits
-    c.h(21)                   # band (21, 2): 2 more
+    c.h(14)
+    c.h(20)                   # span = the whole (14, 7) band: 7 scat bits
+    c.h(21)                   # band (21, 2): 1 more
     parts = parts_of(c, n=n, scatter_max=7)
     assert [p[0] for p in parts] == ["segment", "segment"]
     # numerics at the tiny scatter budget
