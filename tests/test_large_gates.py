@@ -106,3 +106,30 @@ def test_laneblock_path_matches_oracle():
                                       list(controls), list(cstates) or None)
         err = np.abs((got[0] + 1j * got[1]) - want).max()
         assert err < 1e-5, (targets, controls, cstates, err)
+
+
+@pytest.mark.parametrize("pair", [(3, 10), (3, 17), (10, 17), (15, 18),
+                                  (16, 22), (3, 22), (10, 22)])
+def test_fused_2q_unitary_every_band_pair(pair, rng):
+    """Random 2q unitaries across every band-class pair at n=23 (lane,
+    sublane, scb-band, top band) stay fused — KAK for cross-band, scb
+    composition within a high band — and match the per-gate engine."""
+    import jax.numpy as jnp
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.ops import pallas_band as PB
+    from quest_tpu.ops import fusion as F
+
+    n = 23
+    u = oracle.random_unitary(2, rng)
+    c = Circuit(n)
+    c.h(pair[0])
+    c.gate(u, pair)
+    c.ry(pair[1], 0.3)
+    items = F.plan(c._flat_ops(n, False), n, bands=PB.plan_bands(n))
+    parts = PB.segment_plan(items, n)
+    assert all(p[0] == "segment" for p in parts), [p[0] for p in parts]
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 5].set(1.0)
+    got = np.asarray(c.compiled_fused(n, density=False, donate=False,
+                                      interpret=True)(amps)).reshape(2, -1)
+    want = np.asarray(c.compiled(n, density=False, donate=False)(amps))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=0)
