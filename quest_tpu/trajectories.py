@@ -38,6 +38,10 @@ from quest_tpu.ops import apply as A
 from quest_tpu.ops import matrices as M
 
 
+def _targets_tuple(targets):
+    return (targets,) if np.isscalar(targets) else tuple(targets)
+
+
 def kraus(amps, key, n, targets, ops: Sequence) -> Tuple:
     """One stochastic application of the Kraus map {K_k} to `targets`:
     branch k is drawn with Born probability p_k = ||K_k psi||^2 and the
@@ -47,7 +51,7 @@ def kraus(amps, key, n, targets, ops: Sequence) -> Tuple:
     All branches are evaluated (their norms are needed for the
     probabilities anyway) and the draw selects via a one-hot weighted
     sum — branch-free, so the whole thing jits and vmaps cleanly."""
-    targets = (targets,) if np.isscalar(targets) else tuple(targets)
+    targets = _targets_tuple(targets)
     ops = [np.asarray(K, dtype=np.complex128) for K in ops]
     # same CPTP check as the density engine's mix_kraus_map: a
     # mis-normalized set would otherwise converge silently to a
@@ -72,7 +76,7 @@ def unitary_mixture(amps, key, n, targets, probs, unitaries) -> Tuple:
     per shot instead of one per branch. This covers every unital Pauli
     channel (dephasing/depolarising/pauli); general Kraus maps need
     `kraus` (state-dependent Born probabilities)."""
-    targets = (targets,) if np.isscalar(targets) else tuple(targets)
+    targets = _targets_tuple(targets)
     probs = np.asarray(probs, dtype=np.float64)
     key, sub = jax.random.split(key)
     k = jax.random.categorical(sub, jnp.log(jnp.asarray(probs) + 1e-30))
