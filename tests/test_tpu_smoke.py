@@ -18,9 +18,20 @@ import pytest
 
 import jax
 
+from quest_tpu.env import ensure_live_backend
+
+# probe BEFORE touching jax.devices(): with QUEST_TEST_PLATFORM=axon and
+# the tunnel down, an in-process devices() call hangs pytest collection
+# indefinitely (observed: 25 minutes before an opaque error). The default
+# CPU suite skips the probe — conftest already pinned the cpu platform.
+_platform = os.environ.get("QUEST_TEST_PLATFORM", "cpu")
+if _platform != "cpu":
+    _platform = ensure_live_backend()
+
 pytestmark = pytest.mark.skipif(
     jax.devices()[0].platform not in ("tpu", "axon"),
-    reason="real-TPU smoke tests (set QUEST_TEST_PLATFORM=axon)")
+    reason="real-TPU smoke tests (set QUEST_TEST_PLATFORM=axon); "
+    f"probed platform: {_platform}")
 
 
 def _state(n):
