@@ -54,6 +54,45 @@ def dual_of(op: GateOp, shift: int):
 
 _LOOP_UNROLL_MAX = 32
 
+# named-gate recovery for Circuit.to_qasm (the builder stores operands;
+# the QASM recorder prefers gate names, like the eager API)
+_NAMED_2x2 = (("h", M.HADAMARD), ("x", M.PAULI_X), ("y", M.PAULI_Y),
+              ("z", M.PAULI_Z))
+
+
+def _named_1q(u):
+    """(gate name, params) of a stored 2x2 operand, or None: the fixed
+    Cliffords by exact match, rx/ry by structural recovery of the angle
+    (modulo the rotation's 4pi matrix period)."""
+    for name, mat in _NAMED_2x2:
+        if np.array_equal(u, mat):
+            return (name, ())
+    c, o = u[0, 0], u[0, 1]
+    if (abs(c.imag) < 1e-14 and abs(o.real) < 1e-14
+            and np.allclose(u, [[c, o], [o, c]])):
+        th = 2.0 * np.arctan2(-o.imag, c.real)
+        if np.allclose(u, M.rotation(th, (1.0, 0.0, 0.0))):
+            return ("rx", (th,))
+    if (np.allclose(u.imag, 0.0, atol=1e-14)
+            and np.allclose(u, [[c, o], [-o, c]])):
+        th = 2.0 * np.arctan2(-o.real, c.real)
+        if np.allclose(u, M.rotation(th, (0.0, 1.0, 0.0))):
+            return ("ry", (th,))
+    return None
+
+
+def _named_diag(d):
+    """(gate name, params) of a stored (2,) diagonal operand, or None."""
+    if np.array_equal(d, M.Z_DIAG):
+        return ("z", ())
+    if np.array_equal(d, M.S_DIAG):
+        return ("s", ())
+    if np.array_equal(d, M.T_DIAG):
+        return ("t", ())
+    if abs(d[0] - 1.0) < 1e-14 and abs(abs(d[1]) - 1.0) < 1e-14:
+        return ("phase", (float(np.angle(d[1])),))
+    return None
+
 
 def flatten_ops(ops, n: int, density: bool) -> List[GateOp]:
     """Expand density duals into a flat op list (ref QuEST.c:8-10);
@@ -257,6 +296,74 @@ class Circuit:
     def cphase(self, angle, *qubits):
         """Symmetric controlled phase e^{i angle} on all-ones of qubits."""
         return self._add("allones", tuple(qubits), np.exp(1j * float(angle)))
+
+    def to_qasm(self) -> str:
+        """OPENQASM 2.0 text of this circuit, through the same logger the
+        eager API records with (quest_tpu/qasm.py; ref QuEST_qasm.c).
+        Named gates (h/x/y/z/s/t/rx/ry/rz/phase/swap/sqrtswap) are
+        recovered from the stored operands and emitted by name like the
+        eager recorder; general operands fall back to ZYZ U-lines; ops
+        with no QASM equivalent degrade to comments. Phase/rotation
+        angles are recovered from operands modulo their period (the
+        recorder's restore lines keep the emitted unitary exact)."""
+        from quest_tpu import qasm as Q
+
+        log = Q.QASMLogger(self.num_qubits)
+        log.is_logging = True
+        for op in self.ops:
+            targets, controls = op.targets, op.controls
+            cstates = op.cstates or (1,) * len(controls)
+            if op.kind == "parity":
+                if len(targets) == 1 and not controls:
+                    log.record_gate("rz", targets[0], (), (op.operand,))
+                else:
+                    log.record_comment(
+                        f"Here a multiRotateZ of angle {op.operand:g} was "
+                        f"applied to qubits {list(targets)}")
+            elif op.kind == "allones":
+                term = complex(op.operand)
+                qubits = tuple(targets) + tuple(controls)
+                if abs(term + 1.0) < 1e-14:
+                    log.record_gate("z", qubits[-1], qubits[:-1])
+                else:
+                    log.record_gate("phase", qubits[-1], qubits[:-1],
+                                    (float(np.angle(term)),))
+            elif op.kind == "diagonal" and len(targets) == 1:
+                d = np.asarray(op.operand).reshape(-1)
+                named = _named_diag(d)
+                if any(s == 0 for s in cstates):
+                    log.record_multi_state_controlled_unitary(
+                        np.diag(d), controls, cstates, targets[0])
+                elif named is not None:
+                    log.record_gate(named[0], targets[0], controls,
+                                    named[1])
+                else:
+                    log.record_unitary(np.diag(d), targets[0], controls)
+            elif op.kind == "matrix" and len(targets) == 1:
+                u = np.asarray(op.operand)
+                named = _named_1q(u)
+                if any(s == 0 for s in cstates):
+                    log.record_multi_state_controlled_unitary(
+                        u, controls, cstates, targets[0])
+                elif named is not None:
+                    log.record_gate(named[0], targets[0], controls,
+                                    named[1])
+                else:
+                    log.record_unitary(u, targets[0], controls)
+            elif (op.kind == "matrix" and len(targets) == 2
+                  and not controls):
+                u = np.asarray(op.operand)
+                if np.array_equal(u, M.SWAP):
+                    log.record_gate("swap", targets[1], (targets[0],))
+                elif np.allclose(u, M.SQRT_SWAP):
+                    log.record_gate("sqrtswap", targets[1], (targets[0],))
+                else:
+                    log.record_comment("Here a multi-qubit gate was "
+                                       "applied (no QASM equivalent)")
+            else:
+                log.record_comment("Here a multi-qubit gate was applied "
+                                   "(no QASM equivalent)")
+        return log.recorded()
 
     # -- compilation & execution --------------------------------------------
 

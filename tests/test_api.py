@@ -311,3 +311,58 @@ def test_invalid_input_hook_monkeypatch(monkeypatch):
     with pytest.raises(QuESTError, match="custom: Invalid target"):
         Q.hadamard(q, 9)
     assert seen[0][1] == "hadamard"
+
+
+def test_circuit_to_qasm_matches_api_recorder():
+    """Circuit.to_qasm emits the same OPENQASM lines the eager API's
+    recorder produces for the equivalent gate sequence."""
+    import numpy as np
+    import quest_tpu as qt
+    from quest_tpu import api as Q
+    from quest_tpu.circuit import Circuit
+
+    # generic (not a named gate or pure rotation): both recorders emit
+    # the same ZYZ U-line
+    u = np.array([[0.6, 0.8], [-0.8, 0.6]],
+                 dtype=np.complex128) @ np.diag([1.0, np.exp(0.3j)])
+
+    qreg = Q.createQureg(3)
+    Q.startRecordingQASM(qreg)
+    Q.hadamard(qreg, 0)
+    Q.controlledNot(qreg, 0, 1)
+    Q.rotateZ(qreg, 2, 0.4)
+    Q.rotateX(qreg, 0, 0.9)
+    Q.rotateY(qreg, 1, -1.2)
+    Q.sGate(qreg, 0)
+    Q.tGate(qreg, 1)
+    Q.pauliZ(qreg, 2)
+    Q.phaseShift(qreg, 2, 0.7)
+    Q.controlledPhaseFlip(qreg, 1, 2)
+    Q.controlledPhaseShift(qreg, 0, 2, 1.1)
+    Q.swapGate(qreg, 0, 2)
+    Q.sqrtSwapGate(qreg, 1, 2)
+    Q.unitary(qreg, 2, u)
+    Q.multiRotateZ(qreg, [0, 1], 0.5)
+    want = qreg.qasm.recorded()
+
+    c = Circuit(3)
+    c.h(0)
+    c.cnot(0, 1)
+    c.rz(2, 0.4)
+    c.rx(0, 0.9)
+    c.ry(1, -1.2)
+    c.s(0)
+    c.t(1)
+    c.z(2)
+    c.phase(2, 0.7)
+    c.cz(1, 2)
+    c.cphase(1.1, 0, 2)
+    c.swap(0, 2)
+    c.sqrt_swap(1, 2)
+    c.gate(u, (2,))
+    c.multi_rotate_z((0, 1), 0.5)
+    got = c.to_qasm()
+
+    assert got == want, "\n".join(
+        f"{a!r:45} | {b!r}" for a, b in zip(got.splitlines(),
+                                            want.splitlines()))
