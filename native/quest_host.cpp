@@ -118,6 +118,22 @@ int qh_write_state_csv(const char* path, const double* re, const double* im,
     return std::fclose(f) ? 3 : 0;
 }
 
+// appends rows without touching existing content — lets a caller stream a
+// huge register to disk in bounded-memory chunks (first chunk via
+// qh_write_state_csv, rest via this)
+int qh_append_state_csv(const char* path, const double* re, const double* im,
+                        long long num_amps) {
+    FILE* f = std::fopen(path, "a");
+    if (!f) return 1;
+    for (long long i = 0; i < num_amps; i++) {
+        if (std::fprintf(f, "%.12f, %.12f\n", re[i], im[i]) < 0) {
+            std::fclose(f);
+            return 2;
+        }
+    }
+    return std::fclose(f) ? 3 : 0;
+}
+
 // reads up to num_amps rows into re/im; skips a leading header line if
 // present. Returns the number of rows read, or -1 on open failure.
 long long qh_read_state_csv(const char* path, double* re, double* im,

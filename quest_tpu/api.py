@@ -797,15 +797,34 @@ def copyStateFromGPU(qureg: Qureg) -> None:
 def reportState(qureg: Qureg) -> None:
     """Write all amplitudes to state_rank_0.csv
     (ref reportState, QuEST_common.c:215-231). Uses the native CSV writer
-    (native/quest_host.cpp) when built, else pure Python."""
+    (native/quest_host.cpp) when built, else pure Python. The register is
+    fetched from device in <=2^20-amplitude slices, so host memory stays
+    bounded even for a 30q state (a full f64 host copy would be 16 GB)."""
     from quest_tpu import native as _native
-    planes = np.asarray(qureg.state.amps, dtype=np.float64)
-    if _native.write_state_csv("state_rank_0.csv", planes[0], planes[1]):
-        return
-    with open("state_rank_0.csv", "w") as f:
+    amps = qureg.state.amps
+    total = qureg.state.num_amps
+    chunk = min(total, 1 << 20)
+    path = "state_rank_0.csv"
+    use_native = _native.available()
+    f = None if use_native else open(path, "w")
+    if f is not None:
         f.write("real, imag\n")
-        for r, i in zip(planes[0], planes[1]):
-            f.write(f"{r:.12f}, {i:.12f}\n")
+    try:
+        for lo in range(0, total, chunk):
+            hi = min(lo + chunk, total)
+            planes = np.asarray(amps[:, lo:hi], dtype=np.float64)
+            if use_native:
+                ok = (_native.write_state_csv(path, planes[0], planes[1])
+                      if lo == 0 else
+                      _native.append_state_csv(path, planes[0], planes[1]))
+                if not ok:
+                    raise OSError(f"native CSV writer failed at offset {lo}")
+            else:
+                for r, i in zip(planes[0], planes[1]):
+                    f.write(f"{r:.12f}, {i:.12f}\n")
+    finally:
+        if f is not None:
+            f.close()
 
 
 def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None,
@@ -829,14 +848,9 @@ def initStateDebug(qureg: Qureg) -> None:
 
 def initStateOfSingleQubit(qureg: Qureg, qubitId: int, outcome: int) -> None:
     """Uniform superposition over basis states with bit `qubitId` == outcome
-    (ref statevec_initStateOfSingleQubit, QuEST_cpu.c:1513-1555)."""
-    _val.validate_target(qureg.state, qubitId)
-    _val.validate_outcome(outcome)
-    n = qureg.state.num_state_qubits
-    norm = 1.0 / np.sqrt((1 << n) / 2.0)
-    k = np.arange(1 << n)
-    re = np.where(((k >> qubitId) & 1) == outcome, norm, 0.0)
-    qureg._set(_state.init_state_from_amps(qureg.state, re, np.zeros_like(re)))
+    (ref statevec_initStateOfSingleQubit, QuEST_cpu.c:1513-1555). Device-side
+    construction — no 2^n host materialization at 30q."""
+    qureg._set(_state.init_state_of_single_qubit(qureg.state, qubitId, outcome))
 
 
 def initStateFromSingleFile(qureg: Qureg, filename: str,

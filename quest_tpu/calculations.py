@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from quest_tpu import precision
 from quest_tpu import validation as val
 from quest_tpu.ops import gates
 from quest_tpu.state import Qureg
@@ -27,16 +28,18 @@ from quest_tpu.state import Qureg
 
 @jax.jit
 def _sum_sq(amps):
-    # ref statevec_calcTotalProb: Kahan-summed sum |a|^2; on TPU a single
-    # fused reduction (f32 accumulation is exact enough at test scale, and
-    # f64 planes are available when the reference's 1e-13 envelope is
-    # required).
-    return jnp.sum(amps * amps)
+    # ref statevec_calcTotalProb: Kahan-summed sum |a|^2. The TPU-native
+    # analogue of the Kahan discipline is an f64 accumulator (the convert
+    # fuses into the reduce — no f64-sized buffer exists); at 2^30 f32
+    # amplitudes a plain f32 reduction can drift ~1e-4.
+    acc = precision.accum_dtype(amps.dtype)
+    return jnp.sum(jnp.square(amps.astype(acc)))
 
 
 @partial(jax.jit, static_argnames=("dim",))
 def _total_prob_density(amps, *, dim):
-    return jnp.sum(jnp.diagonal(amps[0].reshape((dim, dim))))
+    acc = precision.accum_dtype(amps.dtype)
+    return jnp.sum(jnp.diagonal(amps[0].reshape((dim, dim))).astype(acc))
 
 
 def calc_total_prob(q: Qureg) -> float:
@@ -48,11 +51,14 @@ def calc_total_prob(q: Qureg) -> float:
 
 @jax.jit
 def _inner(bra, ket):
-    """<bra|ket> = sum conj(b) k as a stacked (re, im) pair."""
-    br, bi = bra[0], bra[1]
-    kr, ki = ket[0], ket[1]
+    """<bra|ket> = sum conj(b) k as a stacked (re, im) pair, accumulated
+    in f64 (ref Kahan sums, QuEST_cpu_distributed.c:35-51); result is
+    cast back to the plane dtype."""
+    acc = precision.accum_dtype(bra.dtype)
+    br, bi = bra[0].astype(acc), bra[1].astype(acc)
+    kr, ki = ket[0].astype(acc), ket[1].astype(acc)
     return jnp.stack([jnp.sum(br * kr + bi * ki),
-                      jnp.sum(br * ki - bi * kr)])
+                      jnp.sum(br * ki - bi * kr)]).astype(bra.dtype)
 
 
 def calc_inner_product(bra: Qureg, ket: Qureg) -> complex:
@@ -111,7 +117,7 @@ def calc_fidelity(q: Qureg, pure: Qureg) -> float:
 
 @jax.jit
 def _hs_dist_sq(a, b):
-    d = a - b
+    d = (a - b).astype(precision.accum_dtype(a.dtype))
     return jnp.sum(d * d)
 
 
@@ -159,15 +165,18 @@ def _expec_pauli_sum(amps, coeffs, *, codes, n, density):
     and the weighted sum compile into a single dispatch (the reference
     loops clone+apply+innerProduct per term, QuEST_common.c:479-491 — one
     workspace pass per term is kept, but without per-term dispatch)."""
-    total = jnp.zeros((), dtype=amps.dtype)
+    acc = precision.accum_dtype(amps.dtype)
+    total = jnp.zeros((), dtype=acc)
     for i, term in enumerate(codes):
         w = _pauli_prod_amps(amps, n, term)
         if density:
             dim = 1 << (n // 2)
-            term_val = jnp.sum(jnp.diagonal(w[0].reshape((dim, dim))))
+            term_val = jnp.sum(
+                jnp.diagonal(w[0].reshape((dim, dim))).astype(acc))
         else:
-            term_val = jnp.sum(amps[0] * w[0] + amps[1] * w[1])  # Re<q|w>
-        total = total + coeffs[i] * term_val
+            term_val = jnp.sum((amps[0] * w[0]
+                                + amps[1] * w[1]).astype(acc))  # Re<q|w>
+        total = total + coeffs[i].astype(acc) * term_val
     return total
 
 

@@ -323,7 +323,16 @@ class Circuit:
             elif op.kind == "allones":
                 term = complex(op.operand)
                 qubits = tuple(targets) + tuple(controls)
-                if abs(term + 1.0) < 1e-14:
+                if any(s == 0 for s in cstates):
+                    # a control-on-0 all-ones phase is NOT symmetric in
+                    # (targets, controls) — keep the control states and
+                    # anchor the diag on a condition-on-1 TARGET qubit
+                    log.record_multi_state_controlled_unitary(
+                        np.diag([1.0, term]),
+                        tuple(targets[:-1]) + tuple(controls),
+                        (1,) * (len(targets) - 1) + tuple(cstates),
+                        targets[-1])
+                elif abs(term + 1.0) < 1e-14:
                     log.record_gate("z", qubits[-1], qubits[:-1])
                 else:
                     log.record_gate("phase", qubits[-1], qubits[:-1],

@@ -96,7 +96,16 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
     a subprocess bounds the wait; on failure the CURRENT process is
     switched to the CPU platform (jax.config, the only override that
     works after the container's sitecustomize pre-captures env vars) so
-    callers still produce a result."""
+    callers still produce a result.
+
+    ORDERING CONTRACT: call this BEFORE anything that initializes the JAX
+    backend (jax.devices(), any jit execution, device_put). Once this
+    process has committed to a backend the probe can neither time-bound
+    the hang (the in-process jax.devices() below IS the risky call) nor
+    rebind jax_platforms — the already-initialized branch exists only to
+    make late calls harmless, not useful. Current call sites honoring the
+    contract: bench.py:main (first call), __graft_entry__.entry/
+    dryrun_multichip (before any mesh/array work), scripts/*."""
     import subprocess
     import sys
     import time as _time

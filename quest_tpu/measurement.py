@@ -18,6 +18,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from quest_tpu import precision
 from quest_tpu import random_ as rng
@@ -28,6 +29,7 @@ from quest_tpu.state import Qureg
 
 @partial(jax.jit, static_argnames=("n", "qubit", "density"))
 def _prob_of_zero(amps, *, n, qubit, density):
+    acc = precision.accum_dtype(amps.dtype)
     if density:
         # probability from the diagonal: rho[k,k] with bit `qubit` of k == 0
         # (ref densmatr_findProbabilityOfZeroLocal, QuEST_cpu.c:3111-3157)
@@ -35,11 +37,11 @@ def _prob_of_zero(amps, *, n, qubit, density):
         d = jnp.diagonal(amps[0].reshape((dim, dim)))  # diag is transpose-proof
         k = jnp.arange(dim)
         keep = ((k >> qubit) & 1) == 0
-        return jnp.sum(jnp.where(keep, d, 0.0))
+        return jnp.sum(jnp.where(keep, d, 0.0).astype(acc)).astype(amps.dtype)
     pre, post = 1 << (n - 1 - qubit), 1 << qubit
     re = amps[0].reshape(pre, 2, post)[:, 0, :]
     im = amps[1].reshape(pre, 2, post)[:, 0, :]
-    return jnp.sum(re * re + im * im)
+    return jnp.sum((re * re + im * im).astype(acc)).astype(amps.dtype)
 
 
 @partial(jax.jit, static_argnames=("n", "qubit", "density"))
@@ -134,6 +136,41 @@ def measure_functional(q: Qureg, qubit: int, key) -> Tuple[Qureg, jax.Array, jax
     return q.replace_amps(amps), outcome, prob
 
 
+def _stable_cdf(probs):
+    """Cumulative sum with bounded rounding error at the 2^30 scale.
+
+    A plain f32 cumsum over 2^30 probabilities accumulates a random-walk
+    drift of order sqrt(N)*eps ~ 1e-3, which visibly biases tail samples
+    (the reference sidesteps this with f64 Kahan sums,
+    QuEST_cpu_distributed.c:64-117). TPU-native fix: split into ~sqrt(N)
+    blocks, cumsum each block in the plane dtype, and carry the running
+    block totals in an f64 exclusive scan. The f64 carry array is only
+    sqrt(N) long; the output stays in the plane dtype, so memory and
+    bandwidth match the naive cumsum. Error is then bounded by the
+    WITHIN-block drift (~sqrt(sqrt(N))*eps per unit of block mass)."""
+    N = probs.shape[0]
+    k = (N - 1).bit_length()
+    if N <= (1 << 14) or (1 << k) != N:
+        acc = precision.accum_dtype(probs.dtype)
+        return jnp.cumsum(probs.astype(acc)).astype(probs.dtype)
+    B = 1 << (k // 2)
+    within = jnp.cumsum(probs.reshape(B, N // B), axis=1)
+    acc = precision.accum_dtype(probs.dtype)
+    totals = within[:, -1].astype(acc)
+    carry = jnp.concatenate([jnp.zeros((1,), dtype=acc),
+                             jnp.cumsum(totals)[:-1]])
+    # the add happens in the accumulator dtype: the exact sequence is then
+    # monotone and rounding to the plane dtype preserves monotonicity
+    # (searchsorted requires a sorted CDF); the converts fuse elementwise,
+    # so nothing accumulator-sized is materialized
+    out = (within.astype(acc) + carry[:, None]).astype(probs.dtype).reshape(-1)
+    if np.dtype(acc) == np.dtype(probs.dtype):
+        # no wider accumulator (x64 off): repair possible 1-ulp boundary
+        # inversions with a running max
+        out = jax.lax.cummax(out)
+    return out
+
+
 @partial(jax.jit, static_argnames=("n", "density", "num_shots"))
 def _sample_traced(amps, key, *, n, density, num_shots):
     if density:
@@ -143,7 +180,7 @@ def _sample_traced(amps, key, *, n, density, num_shots):
         probs = amps[0] * amps[0] + amps[1] * amps[1]
     # inverse-CDF sampling: O(2^n + shots) memory (categorical would
     # materialize a (shots, 2^n) Gumbel tensor)
-    cdf = jnp.cumsum(probs)
+    cdf = _stable_cdf(probs)
     u = jax.random.uniform(key, (num_shots,), dtype=probs.dtype) * cdf[-1]
     return jnp.searchsorted(cdf, u, side="right").astype(jnp.int32)
 

@@ -49,24 +49,42 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
+            _bind(lib)
         except OSError:
             return None
-        lib.qh_init_genrand.argtypes = [ctypes.c_uint32]
-        lib.qh_init_by_array.argtypes = [
-            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int]
-        lib.qh_genrand_int32.restype = ctypes.c_uint32
-        lib.qh_genrand_real1.restype = ctypes.c_double
-        lib.qh_write_state_csv.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong, ctypes.c_int]
-        lib.qh_write_state_csv.restype = ctypes.c_int
-        lib.qh_read_state_csv.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong]
-        lib.qh_read_state_csv.restype = ctypes.c_longlong
+        except AttributeError:
+            # stale prebuilt library missing a newer symbol: rebuild once
+            # (cheap no-op when fresh), then retry; degrade to the Python
+            # fallbacks rather than crash if it still doesn't bind
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _bind(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
 
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.qh_init_genrand.argtypes = [ctypes.c_uint32]
+    lib.qh_init_by_array.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int]
+    lib.qh_genrand_int32.restype = ctypes.c_uint32
+    lib.qh_genrand_real1.restype = ctypes.c_double
+    lib.qh_write_state_csv.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong, ctypes.c_int]
+    lib.qh_write_state_csv.restype = ctypes.c_int
+    lib.qh_append_state_csv.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong]
+    lib.qh_append_state_csv.restype = ctypes.c_int
+    lib.qh_read_state_csv.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong]
+    lib.qh_read_state_csv.restype = ctypes.c_longlong
 
 def available() -> bool:
     return _load() is not None
@@ -110,6 +128,20 @@ def write_state_csv(path: str, re: np.ndarray, im: np.ndarray,
         path.encode(), re.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         im.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), re.size,
         1 if header else 0)
+    return rc == 0
+
+
+def append_state_csv(path: str, re: np.ndarray, im: np.ndarray) -> bool:
+    """Append rows to an existing CSV (bounded-memory streaming of a huge
+    register: first chunk via write_state_csv, rest via this)."""
+    lib = _load()
+    if lib is None:
+        return False
+    re = np.ascontiguousarray(re, dtype=np.float64)
+    im = np.ascontiguousarray(im, dtype=np.float64)
+    rc = lib.qh_append_state_csv(
+        path.encode(), re.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        im.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), re.size)
     return rc == 0
 
 

@@ -92,7 +92,10 @@ def norm_control_states(controls, control_states):
     normalize through here first."""
     if controls and not control_states:
         return (1,) * len(controls)
-    assert len(controls) == len(control_states), (controls, control_states)
+    if len(controls) != len(control_states):
+        from quest_tpu import validation as val
+        val._err("Invalid control state: must give exactly one bit per "
+                 "control qubit.")
     return tuple(control_states)
 
 

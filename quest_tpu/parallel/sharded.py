@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from quest_tpu import cplx
 from quest_tpu.env import AMP_AXIS
+from quest_tpu import validation as val
 from quest_tpu.ops import apply as A
 from quest_tpu.state import Qureg
 
@@ -353,7 +354,7 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     g = int(math.log2(D))
     local_n = n - g
     if local_n < 1:
-        raise ValueError("register too small for mesh")
+        val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     flat = flatten_ops(ops, n, density)
     items = F.plan(flat, n, bands=_shard_bands(n, local_n))
 
@@ -399,7 +400,7 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
     g = int(math.log2(D))
     local_n = n - g
     if local_n < 1:
-        raise ValueError("register too small for mesh")
+        val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     if not PB.usable(local_n):
         return compile_circuit_sharded_banded(ops, n, density, mesh, donate)
 
@@ -480,7 +481,7 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
     g = int(math.log2(D))
     local_n = n - g
     if local_n < 1:
-        raise ValueError("register too small for mesh")
+        val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     if not density and any(op.kind == "superop" for op in ops):
         from quest_tpu.validation import QuESTError
         raise QuESTError(

@@ -95,6 +95,19 @@ def enable_compile_cache(path: str = "/tmp/jax_cache_quest_tpu",
                       min_compile_secs)
 
 
+def accum_dtype(plane_dtype=None):
+    """Accumulator dtype for full-register reductions (norms, overlaps,
+    probability sums, sampling CDFs). The reference Kahan-sums its f64
+    reductions (QuEST_cpu_distributed.c:64-117); the TPU-native analogue
+    is to accumulate in f64 regardless of the plane dtype — the convert
+    fuses into the reduce, so nothing f64-sized is ever materialized.
+    Falls back to the plane dtype when x64 is disabled (then the chunked
+    CDF in measurement.py still bounds the error pairwise)."""
+    if jax.config.jax_enable_x64:
+        return np.dtype(np.float64)
+    return np.dtype(plane_dtype) if plane_dtype is not None else np.dtype(np.float32)
+
+
 def real_eps(dtype) -> float:
     """Numerical tolerance for the given amplitude dtype."""
     return _REAL_EPS[np.dtype(dtype)]

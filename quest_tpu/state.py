@@ -213,6 +213,27 @@ def init_debug_state(qureg: Qureg) -> Qureg:
         jnp.stack([(2.0 * k) / 10.0, (2.0 * k + 1.0) / 10.0]))
 
 
+@partial(jax.jit, static_argnames=("n", "qubit", "outcome", "rdt"))
+def _single_qubit_outcome_planes(*, n, qubit, outcome, rdt):
+    norm = 1.0 / np.sqrt(1 << (n - 1))
+    pre, post = 1 << (n - 1 - qubit), 1 << qubit
+    re = jnp.zeros((pre, 2, post), dtype=rdt).at[:, outcome, :].set(norm)
+    return jnp.stack([re.reshape(-1), jnp.zeros((1 << n,), dtype=rdt)])
+
+
+def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> Qureg:
+    """Uniform superposition over basis states whose bit `qubit` equals
+    `outcome` (ref statevec_initStateOfSingleQubit, QuEST_cpu.c:1513-1555).
+    Built ON DEVICE in one fused buffer — the whole point at 30q, where a
+    host-side arange/where would materialize 2^n indices in host RAM."""
+    validation.validate_state_vector(qureg)
+    validation.validate_target(qureg, qubit)
+    validation.validate_outcome(outcome)
+    return qureg.replace_amps(_single_qubit_outcome_planes(
+        n=qureg.num_state_qubits, qubit=qubit, outcome=outcome,
+        rdt=qureg.real_dtype))
+
+
 def init_pure_state(qureg: Qureg, pure: Qureg) -> Qureg:
     """Set qureg to the pure state |psi> (statevec copy) or |psi><psi|
     (ref densmatr_initPureState, QuEST_cpu.c / QuEST.c:139-146)."""

@@ -61,7 +61,12 @@ def kraus(amps, key, n, targets, ops: Sequence) -> Tuple:
     key, sub = jax.random.split(key)
     ws = [A.apply_matrix(amps, n, cplx.pack(K), targets) for K in ops]
     ps = jnp.stack([jnp.sum(w[0] * w[0] + w[1] * w[1]) for w in ws])
-    k = jax.random.categorical(sub, jnp.log(ps + 1e-30))
+    # zero-probability branches are masked OUT (-inf logit), not
+    # epsilon-floored: a flat epsilon could still draw an impossible
+    # branch (p exactly 0) with probability ~eps*k
+    logits = jnp.where(ps > 0, jnp.log(jnp.maximum(ps, jnp.finfo(ps.dtype).tiny)),
+                       -jnp.inf)
+    k = jax.random.categorical(sub, logits)
     onehot = jax.nn.one_hot(k, len(ops), dtype=amps.dtype)
     w = ws[0] * onehot[0]
     for i in range(1, len(ops)):
@@ -79,7 +84,8 @@ def unitary_mixture(amps, key, n, targets, probs, unitaries) -> Tuple:
     targets = _targets_tuple(targets)
     probs = np.asarray(probs, dtype=np.float64)
     key, sub = jax.random.split(key)
-    k = jax.random.categorical(sub, jnp.log(jnp.asarray(probs) + 1e-30))
+    logits = np.where(probs > 0, np.log(np.maximum(probs, 1e-300)), -np.inf)
+    k = jax.random.categorical(sub, jnp.asarray(logits))
     branches = [
         (lambda a, U=np.asarray(U, dtype=np.complex128):
          A.apply_matrix(a, n, cplx.pack(U), targets))

@@ -1,0 +1,127 @@
+"""Reduction accuracy at scale: f64 accumulation + blocked CDF.
+
+The reference Kahan-sums every full-register reduction in double
+(QuEST_cpu_distributed.c:64-117); a naive f32 reduction/cumsum at the
+2^24-2^30 scale drifts by sqrt(N)*eps ~ 1e-4..1e-3, which biases
+inverse-CDF sampling toward/away from the tail. These tests pin the
+failure mode with a sequential-f32 oracle and verify the framework's
+accumulators stay inside a much tighter envelope.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import calculations as calc
+from quest_tpu import measurement as meas
+
+
+SCALE = 1 << 22  # big enough that sequential-f32 drift is measurable
+
+
+def _seq_f32_cumsum(x32):
+    """The failure mode under test: strictly sequential f32 accumulation
+    (what a naive scan compiles to). numpy's cumsum is sequential."""
+    return np.cumsum(x32, dtype=np.float32)
+
+
+def test_f32_sequential_cumsum_provably_biases():
+    """Establish the premise: sequential f32 CDF at 2^22 scale is off by
+    far more than f32 quantization (so the fix below is load-bearing)."""
+    rng = np.random.default_rng(7)
+    p64 = rng.random(SCALE)
+    p64 /= p64.sum()
+    p32 = p64.astype(np.float32)
+    oracle = np.cumsum(p32.astype(np.float64))
+    drift = np.max(np.abs(_seq_f32_cumsum(p32) - oracle))
+    assert drift > 2e-5, f"premise failed: sequential drift only {drift}"
+
+
+def test_stable_cdf_bounds_the_drift():
+    rng = np.random.default_rng(7)
+    p64 = rng.random(SCALE)
+    p64 /= p64.sum()
+    p32 = p64.astype(np.float32)
+    oracle = np.cumsum(p32.astype(np.float64))
+    seq_drift = np.max(np.abs(_seq_f32_cumsum(p32) - oracle))
+
+    ours = np.asarray(meas._stable_cdf(jnp.asarray(p32)), dtype=np.float64)
+    our_drift = np.max(np.abs(ours - oracle))
+    # within a few ulps of the f32 output quantization, and far better
+    # than the sequential scan
+    assert our_drift < 1e-6
+    assert our_drift < seq_drift / 20
+    # monotone: searchsorted needs a sorted CDF
+    assert np.all(np.diff(ours) >= 0)
+
+
+def test_stable_cdf_small_and_nonpow2_paths():
+    for n in (5, 1000, 1 << 14):
+        p = np.random.default_rng(n).random(n)
+        p = (p / p.sum()).astype(np.float32)
+        got = np.asarray(meas._stable_cdf(jnp.asarray(p)))
+        np.testing.assert_allclose(got, np.cumsum(p.astype(np.float64)),
+                                   rtol=0, atol=1e-5)
+
+
+def test_calc_total_prob_f64_accumulation():
+    """A 2^22-amplitude f32 state normalized in f64 must report total
+    probability within ~f64-reduction error of 1, not f32-drift error."""
+    n = 22
+    rng = np.random.default_rng(3)
+    re = rng.standard_normal(1 << n)
+    im = rng.standard_normal(1 << n)
+    norm = np.sqrt((re * re + im * im).sum())
+    re, im = re / norm, im / norm
+    q = qt.create_qureg(n)
+    q = q.replace_amps(jnp.stack([jnp.asarray(re, dtype=jnp.float32),
+                                  jnp.asarray(im, dtype=jnp.float32)]))
+    # f32 amplitude quantization perturbs the true norm by ~sqrt(N)*eps
+    # *per-element relative* -> ~1e-7 relative on the SUM; the reduction
+    # itself must not add f32 drift on top.
+    true = (re.astype(np.float32).astype(np.float64) ** 2
+            + im.astype(np.float32).astype(np.float64) ** 2).sum()
+    assert abs(calc.calc_total_prob(q) - true) < 1e-6
+
+
+def test_inner_product_matches_f64_oracle():
+    n = 18
+    rng = np.random.default_rng(5)
+
+    def mk():
+        v = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+        return v / np.linalg.norm(v)
+
+    a, b = mk(), mk()
+    qa = qt.create_qureg(n)
+    qa = qa.replace_amps(jnp.stack([jnp.asarray(a.real, dtype=jnp.float32),
+                                    jnp.asarray(a.imag, dtype=jnp.float32)]))
+    qb = qt.create_qureg(n)
+    qb = qb.replace_amps(jnp.stack([jnp.asarray(b.real, dtype=jnp.float32),
+                                    jnp.asarray(b.imag, dtype=jnp.float32)]))
+    a32 = a.real.astype(np.float32).astype(np.float64) \
+        + 1j * a.imag.astype(np.float32).astype(np.float64)
+    b32 = b.real.astype(np.float32).astype(np.float64) \
+        + 1j * b.imag.astype(np.float32).astype(np.float64)
+    oracle = np.vdot(a32, b32)
+    got = calc.calc_inner_product(qa, qb)
+    assert abs(got - oracle) < 1e-6
+
+
+def test_sample_tail_unbiased():
+    """Distribution with all mass in the LAST bin after 2^20-1 tiny bins:
+    a drifting CDF whose total lands above/below 1.0 mis-assigns tail
+    draws; the stable CDF must hit the tail bin for every draw."""
+    n = 20
+    eps_mass = 1e-12  # all tiny bins together hold ~1e-6 of the mass
+    probs = np.full(1 << n, eps_mass, dtype=np.float64)
+    probs[-1] = 1.0 - probs[:-1].sum()
+    amp = np.sqrt(probs)
+    q = qt.create_qureg(n)
+    q = q.replace_amps(jnp.stack([jnp.asarray(amp, dtype=jnp.float32),
+                                  jnp.zeros(1 << n, dtype=jnp.float32)]))
+    import jax
+    samples = np.asarray(meas.sample(q, 512, jax.random.PRNGKey(0)))
+    frac_tail = (samples == (1 << n) - 1).mean()
+    assert frac_tail > 0.99, f"tail bin hit only {frac_tail:.3f} of draws"
