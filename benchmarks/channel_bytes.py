@@ -1,0 +1,96 @@
+"""Measure ICI traffic of distributed density-matrix channels.
+
+The reference's distributed density backend packs and exchanges HALF-chunks
+for outer-qubit channels (exchangePairStateVectorHalves,
+QuEST_cpu_distributed.c:511-542, used by mixDamping/mixDepolarising
+:545-697) — 0.5 chunk-sizes on the wire per channel. quest_tpu routes
+distributed superoperators through the generic machinery; this script
+reports what each path actually puts on the wire, by compiling a damping
+channel on an inner and an outer qubit over the virtual 8-device mesh and
+summing the collective-permute operand bytes in the optimized HLO.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/channel_bytes.py
+Prints one JSON object; also used by tests/test_distributed.py to pin the
+outer-channel byte budget.
+"""
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _setup():
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+_DTYPE_BYTES = {"f32": 4, "f64": 8, "bf16": 2, "s32": 4, "u32": 4,
+                "pred": 1, "c64": 8, "c128": 16}
+
+_CP_RE = re.compile(
+    r"(\w+)\[([\d,]*)\][^=]*\bcollective-permute(?:-start)?\(")
+
+
+def collective_permute_bytes(hlo_text: str) -> int:
+    """Total bytes a single execution moves through collective-permutes,
+    summed over instructions (each appears once in the unrolled program)."""
+    total = 0
+    for m in _CP_RE.finditer(hlo_text):
+        dtype, dims = m.group(1), m.group(2)
+        if dtype not in _DTYPE_BYTES:
+            continue
+        size = 1
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        total += size * _DTYPE_BYTES[dtype]
+    return total
+
+
+def measure(n: int = 6, prob: float = 0.3):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.env import AMP_AXIS
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.sharded import compile_circuit_sharded
+
+    mesh = make_amp_mesh(8)
+    D = mesh.devices.size
+    state_qubits = 2 * n                       # doubled register
+    chunk_bytes = 2 * 4 * (1 << state_qubits) // D   # re+im f32 planes
+
+    results = {"n": n, "devices": int(D), "chunk_bytes": chunk_bytes,
+               "reference_halfchunk_bytes": chunk_bytes // 2}
+    amps = jnp.zeros((2, 1 << state_qubits), dtype=jnp.float32).at[0, 0].set(1.0)
+    amps = jax.device_put(amps, NamedSharding(mesh, P(None, AMP_AXIS)))
+
+    for chan in ("damping", "dephasing", "depolarising"):
+        for label, t in (("inner", 0), ("outer", n - 1)):
+            c = getattr(Circuit(n), chan)(t, prob)
+            step = compile_circuit_sharded(c.ops, state_qubits, density=True,
+                                           mesh=mesh, donate=False)
+            hlo = step.lower(amps).compile().as_text()
+            b = collective_permute_bytes(hlo)
+            results[f"{chan}_{label}_bytes"] = b
+            if label == "outer":
+                results[f"{chan}_outer_vs_ref"] = round(b / (chunk_bytes / 2), 3)
+    return results
+
+
+if __name__ == "__main__":
+    _setup()
+    print(json.dumps(measure()))

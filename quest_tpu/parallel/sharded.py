@@ -105,8 +105,48 @@ def _swap_global_local(chunk, dev, D, gbit, l, local_n):
 
 def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
     """General k-qubit matrix gate on the local chunk, distributing over
-    global target qubits when needed."""
+    global target qubits when needed. Concrete operands with global
+    targets are specialized by STRUCTURE before falling back to generic
+    swap-to-local (the analogue of the reference's per-channel distributed
+    kernels, QuEST_cpu_distributed.c:545-697):
+
+    - diagonal matrix (dephasing-class superops, diagonal gates): routed
+      as a diagonal op — ZERO communication. NOTE this deliberately
+      exempts diagonal operands from the E_CANNOT_FIT_MULTI_QUBIT_MATRIX
+      fit check below: the reference rejects any dense-form matrix whose
+      global targets exceed the free local slots
+      (QuEST_validation.c:121) because its kernels must relabel; the
+      diagonal path needs no relabeling, so the same call SUCCEEDS here
+      — a strict capability extension, tested in
+      test_distributed.py::test_diagonal_matrix_exempt_from_fit_check;
+    - two targets with exactly one global (outer-qubit channels whose
+      column-space copy crosses the shard boundary, and crossing 2q
+      gates): ONE direct pair exchange, shipping only the slices the
+      cross-block actually reads (half-chunk for damping- AND
+      depolarising-class channels — their cross-blocks each read one
+      row-slice — full chunk for dense cross-blocks like generic
+      crossing 2q unitaries; either way at most half of swap-to-local's
+      swap-in + swap-out round trip).
+
+    Measured (benchmarks/channel_bytes.py, 8-device mesh): outer-qubit
+    damping 4096 -> 2048 bytes per channel; dephasing 4096 -> 0."""
     glob_targets = [t for t in targets if t >= local_n]
+
+    if (glob_targets and not controls and isinstance(m_pair[0], np.ndarray)):
+        sup = np.asarray(m_pair[0]) + 1j * np.asarray(m_pair[1])
+        dim = 1 << len(targets)
+        sup = sup.reshape(dim, dim)
+        if np.count_nonzero(sup - np.diag(np.diagonal(sup))) == 0:
+            return _diagonal_op(chunk, dev, local_n=local_n,
+                                d_pair=cplx.pack(np.diagonal(sup)),
+                                targets=targets, controls=(), cstates=())
+        if len(targets) == 2 and len(glob_targets) == 1:
+            jg = list(targets).index(glob_targets[0])
+            t = targets[1 - jg]
+            if t < local_n:
+                return _pair_exchange_2t(
+                    chunk, dev, D=D, local_n=local_n, sup=sup, t=t, jg=jg,
+                    gbit=glob_targets[0] - local_n)
 
     if not glob_targets:
         loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
@@ -260,6 +300,67 @@ def _all_ones_op(chunk, dev, *, local_n, term_pair, qubits):
     return jnp.stack([re * tre - im * tim, re * tim + im * tre])
 
 
+def _pair_exchange_2t(chunk, dev, *, D, local_n, sup, t, jg, gbit):
+    """Two-target operator with local target `t` and the other target on
+    device bit `gbit` (matrix index bit `jg`): split the 4x4 operator by
+    the global index bit into same-block and cross-block 2x2s, exchange
+    only what the cross-block reads."""
+    rdt = chunk.dtype
+    g = (dev >> gbit) & 1
+
+    def sub(out_v, in_v):
+        rows = [i for i in range(4) if ((i >> jg) & 1) == out_v]
+        cols = [j for j in range(4) if ((j >> jg) & 1) == in_v]
+        return sup[np.ix_(rows, cols)]
+
+    same = [sub(0, 0), sub(1, 1)]
+    cross = [sub(0, 1), sub(1, 0)]
+    # which input values of bit t does each parity's cross-block read?
+    need = [sorted(set(np.nonzero(np.abs(cross[gv]) > 0)[1].tolist()))
+            for gv in (0, 1)]
+
+    def tr(mats):  # traced per-device 2x2 (re, im) pair
+        p0, p1 = cplx.pack(mats[0]), cplx.pack(mats[1])
+        sel = (g == 0)
+        return (jnp.where(sel, jnp.asarray(p0[0], rdt), jnp.asarray(p1[0], rdt)),
+                jnp.where(sel, jnp.asarray(p0[1], rdt), jnp.asarray(p1[1], rdt)))
+
+    new = A.apply_matrix(chunk, local_n, tr(same), (t,))
+
+    if all(len(nd) <= 1 for nd in need):
+        # half-chunk exchange: each device ships the single row-slice its
+        # partner reads (ref exchangePairStateVectorHalves semantics)
+        nv = [nd[0] if nd else 0 for nd in need]
+        dims, axis_of = A.seg_view(local_n, (t,))
+        ax = 1 + axis_of[t]
+        tview = chunk.reshape((2,) + dims)
+        send_idx = jnp.where(g == 0, nv[1], nv[0])
+        moving = lax.dynamic_slice_in_dim(tview, send_idx, 1, axis=ax)
+        recv = lax.ppermute(moving, AMP_AXIS, _pair_perm(D, gbit))
+        # cross contribution: out(r) += cross[g][r, need[g]] * recv
+        col = [np.asarray(cross[gv])[:, nv[gv]] for gv in (0, 1)]
+        shape = [1] * len(dims)
+        shape[axis_of[t]] = 2
+
+        def coef(part):
+            a = jnp.asarray(part(col[0]), rdt).reshape(shape)
+            b = jnp.asarray(part(col[1]), rdt).reshape(shape)
+            return jnp.where(g == 0, a, b)
+
+        cre, cim = coef(np.real), coef(np.imag)
+        rre, rim = recv[0], recv[1]
+        add_re = cre * rre - cim * rim
+        add_im = cre * rim + cim * rre
+        out = new.reshape((2,) + dims)
+        out = out.at[0].add(add_re).at[1].add(add_im)
+        return out.reshape(2, -1)
+
+    # dense cross-block (generic crossing 2q unitaries; 1q channels all
+    # take the half-chunk branch above): one full-chunk exchange
+    recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
+    return new + A.apply_matrix(recv, local_n, tr(cross), (t,))
+
+
 def _apply_gateop(chunk, dev, *, D, local_n, density, op):
     """One GateOp (possibly + its conjugate column-space copy for density
     registers, ref QuEST.c:8-10) on the local chunk."""
@@ -268,7 +369,8 @@ def _apply_gateop(chunk, dev, *, D, local_n, density, op):
 
     if op.kind == "superop":
         # channel superoperator on [targets, targets+N]: one matrix op on
-        # the doubled register, both spaces at once (no dual)
+        # the doubled register, both spaces at once (no dual); _matrix_op
+        # specializes by structure (diagonal / single-crossing-target)
         from quest_tpu.ops.matrices import superop_targets
         return _matrix_op(chunk, dev, D=D, local_n=local_n,
                           m_pair=cplx.pack(op.operand),
