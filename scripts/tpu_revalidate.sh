@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # One-shot revalidation after TPU access returns (the axon tunnel drops
-# occasionally): on-chip smoke tests, the headline bench, and the 30q
-# RCS wall-clock, in the order that surfaces failures fastest.
+# occasionally): on-chip certification sweep, the headline bench, and the
+# 30q RCS wall-clock, in the order that surfaces failures fastest.
+# Smoke-test measurements ([smoke-metric] lines) are teed into
+# benchmarks/oncip_certification.log as round evidence.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -9,11 +11,13 @@ echo "== devices =="
 timeout 300 python -c "import jax; print(jax.devices())" || {
     echo "TPU still unreachable"; exit 1; }
 
-echo "== on-chip smoke tests =="
-QUEST_TEST_PLATFORM=axon timeout 1500 python -m pytest tests/test_tpu_smoke.py -q || exit 1
+echo "== on-chip certification sweep (tests/test_tpu_smoke.py) =="
+QUEST_TEST_PLATFORM=axon timeout 3000 python -m pytest tests/test_tpu_smoke.py -q 2>&1 \
+    | tee /tmp/tpu_smoke_out.log || exit 1
+grep "smoke-metric" /tmp/tpu_smoke_out.log > benchmarks/oncip_certification.log || true
 
 echo "== headline bench =="
-timeout 1500 python bench.py || exit 1
+timeout 1800 python bench.py || exit 1
 
 echo "== 30q depth-20 RCS wall-clock (benchmarks/run.py rcs) =="
-timeout 1500 python -u benchmarks/run.py rcs || exit 1
+timeout 1800 python -u benchmarks/run.py rcs || exit 1
