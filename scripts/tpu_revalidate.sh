@@ -11,6 +11,13 @@ echo "== devices =="
 timeout 300 python -c "import jax; print(jax.devices())" || {
     echo "TPU still unreachable"; exit 1; }
 
+echo "== pre-warm persistent compile cache =="
+timeout 2400 python scripts/tpu_prewarm.py || echo "prewarm incomplete (continuing)"
+
+echo "== compile-latency profile (cold vs warm) =="
+timeout 2400 python scripts/profile_compile.py 30 20 || true
+timeout 600 python scripts/profile_compile.py 30 20 || true
+
 echo "== on-chip certification sweep (tests/test_tpu_smoke.py) =="
 QUEST_TEST_PLATFORM=axon timeout 3000 python -m pytest tests/test_tpu_smoke.py -q 2>&1 \
     | tee /tmp/tpu_smoke_out.log || exit 1
