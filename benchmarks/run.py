@@ -203,16 +203,61 @@ def bench_qft_sharded():
           devices=d)
 
 
+# -- 6. trajectory noise (beyond the BASELINE five) --------------------------
+
+
+def bench_trajectories():
+    """Noisy-circuit shots via stochastic Kraus unraveling, vmapped over
+    a shot batch — statevector memory per shot where the reference needs
+    the 4^n density register (quest_tpu/trajectories.py). Reported as
+    noisy shots/sec; the density-register equivalent at this size would
+    square the memory."""
+    from quest_tpu import trajectories as T
+    from quest_tpu.circuit import random_circuit
+    from quest_tpu.state import basis_planes
+
+    n = 20 if _on_tpu() else 12
+    shots = 64 if _on_tpu() else 16
+    depth = 4
+    c = random_circuit(n, depth=depth, seed=13)
+
+    def shot(key):
+        amps = basis_planes(0, n=n, rdt=jnp.float32)
+        amps = c.compiled(n, density=False, donate=False)(amps)
+        for q in (0, n // 2, n - 1):
+            amps, key, _ = T.damping(amps, key, n, q, 0.05)
+        return amps[0, 0]
+
+    run = jax.jit(jax.vmap(shot))
+    keys = jax.random.split(jax.random.key(1), shots)
+    out = run(keys)
+    _sync(out)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = run(keys)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    _emit("trajectories", f"noisy RCS shots @ {n}q (3 damping channels)",
+          shots / dt, "shots/sec", shots=shots)
+
+
 ALL = {
     "tutorial": bench_tutorial,
     "rcs": bench_rcs,
     "genunitary": bench_general_unitaries,
     "channels": bench_channels,
     "qft": bench_qft_sharded,
+    "trajectories": bench_trajectories,
 }
 
 
 def main(argv):
+    # bound the wait on a dead TPU tunnel and fall back loudly to CPU
+    # (run.py hung here pre-probe; see env.ensure_live_backend). A caller
+    # that already pinned a platform (conftest, CI) is unaffected.
+    from quest_tpu.env import ensure_live_backend
+    ensure_live_backend()
     names = argv or list(ALL)
     for name in names:
         ALL[name]()
