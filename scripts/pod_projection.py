@@ -1,0 +1,134 @@
+"""North-star projection: 40-qubit depth-20 RCS on a 256-chip pod.
+
+BASELINE.json's north star is "40q depth-20 RCS wall-clock faster than
+MPI+CUDA QuEST on 32xA100, on TPU v5p-256". No pod is attached to this
+container, so this script does the strongest thing short of one: it
+builds the EXACT 40-qubit, 256-device program through the production
+sharded engine, lowers it to StableHLO over a 256-virtual-device mesh
+(tracing allocates no state), and derives the wall-clock from the
+program's OWN collective/pass schedule plus stated hardware constants.
+
+Outputs one JSON object; assumptions are fields, not prose, so the
+projection recomputes under different constants
+(--hbm/--ici GB/s). See docs/POD_PROJECTION.md for the analysis,
+including why the reference side of the north star is infeasible as
+stated (QuEST cannot hold 2^40 amplitudes on 32 A100s at any precision).
+
+Run: python scripts/pod_projection.py  (spawns a 256-device subprocess)
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r'''
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json, re, sys, time
+import numpy as np, jax.numpy as jnp
+sys.path.insert(0, %(repo)r)
+from jax.sharding import Mesh
+from quest_tpu.circuit import flatten_ops, random_circuit
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.ops import fusion as F
+from quest_tpu.parallel.sharded import (_shard_bands,
+                                        compile_circuit_sharded_banded)
+
+n, depth, D = %(n)d, %(depth)d, %(D)d
+c = random_circuit(n, depth=depth, seed=7, entangler="cz")
+devs = jax.devices()
+assert len(devs) == D
+mesh = Mesh(np.array(devs), (AMP_AXIS,))
+g = int(np.log2(D))
+local_n = n - g
+
+t0 = time.time()
+step = compile_circuit_sharded_banded(c.ops, n, density=False, mesh=mesh,
+                                      donate=False)
+lowered = jax.jit(step).lower(jax.ShapeDtypeStruct((2, 1 << n), jnp.float32))
+txt = lowered.as_text()
+lower_s = time.time() - t0
+
+# collective_permute ops and their operand element counts (per device)
+cp_elems = []
+for m in re.finditer(r"stablehlo\.collective_permute.*?tensor<([0-9x]+)xf32>",
+                     txt):
+    dims = [int(d) for d in m.group(1).split("x")]
+    e = 1
+    for d in dims:
+        e *= d
+    cp_elems.append(e)
+
+# local band passes from the same plan the engine compiled
+items = F.plan(flatten_ops(c.ops, n, False), n,
+               bands=_shard_bands(n, local_n))
+band_passes = sum(1 for it in items if isinstance(it, F.BandOp)
+                  and it.ql < local_n)
+global_items = sum(1 for it in items if isinstance(it, F.BandOp)
+                   and it.ql >= local_n)
+diag_items = len(items) - band_passes - global_items
+
+print(json.dumps({
+    "gates": len(c.ops), "lower_s": round(lower_s, 2),
+    "hlo_bytes": len(txt),
+    "collective_permutes": len(cp_elems),
+    "ici_bytes_per_device_per_step": int(sum(cp_elems) * 4),
+    "local_band_passes": band_passes, "global_qubit_items": global_items,
+    "diag_items": diag_items, "local_n": local_n, "g": g,
+}))
+'''
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=40)
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--devices", type=int, default=256)
+    ap.add_argument("--hbm", type=float, default=2765.0,
+                    help="per-chip HBM GB/s (default: v5p)")
+    ap.add_argument("--ici", type=float, default=450.0,
+                    help="per-chip ICI egress GB/s (default: conservative "
+                    "v5p 3D-torus estimate)")
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.devices}")
+    code = WORKER % {"repo": REPO, "n": args.n, "depth": args.depth,
+                     "D": args.devices}
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        print(r.stderr[-3000:], file=sys.stderr)
+        raise SystemExit(1)
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+
+    chunk_gb = 2 * 4 * (1 << args.n) / args.devices / 1e9
+    # each local band pass reads+writes the chunk; each collective also
+    # costs ~1 read+write to apply the received half
+    hbm_gb = (rec["local_band_passes"] + rec["collective_permutes"]) \
+        * 2 * chunk_gb
+    ici_gb = rec["ici_bytes_per_device_per_step"] / 1e9
+    t_hbm = hbm_gb / args.hbm
+    t_ici = ici_gb / args.ici
+    rec.update({
+        "n": args.n, "depth": args.depth, "devices": args.devices,
+        "chunk_gb": round(chunk_gb, 2),
+        "assumed_hbm_gbps": args.hbm, "assumed_ici_gbps": args.ici,
+        "hbm_gb_per_device": round(hbm_gb, 1),
+        "ici_gb_per_device": round(ici_gb, 1),
+        "t_hbm_s": round(t_hbm, 2), "t_ici_s": round(t_ici, 2),
+        "projected_wall_clock_s": round(max(t_hbm, t_ici) + 0.2 * min(
+            t_hbm, t_ici), 2),  # collectives overlap compute imperfectly
+    })
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
