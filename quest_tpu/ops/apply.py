@@ -84,6 +84,20 @@ def bit_tensor(ndims: int, axis: int):
     return jnp.arange(2).reshape(shape)
 
 
+def parity_sign(ndims: int, axis_of, qubits, dtype):
+    """(-1)^{parity of the listed qubits' bits} as a broadcast product of
+    per-axis (+1, -1) vectors — no 2^k table, no permutation. Returns
+    None for an empty qubit list. The ONE home of this idiom
+    (apply_parity_phase, the Pauli flip-form in calculations.py)."""
+    sign = None
+    for q in qubits:
+        shape = [1] * ndims
+        shape[axis_of[q]] = 2
+        vec = jnp.array([1.0, -1.0], dtype=dtype).reshape(shape)
+        sign = vec if sign is None else sign * vec
+    return sign
+
+
 def norm_control_states(controls, control_states):
     """Empty `control_states` means all-ones. The ONE place this
     normalization lives: a silent zip truncation against default-empty
@@ -529,12 +543,7 @@ def apply_parity_phase(
     re = amps[0].reshape(dims)
     im = amps[1].reshape(dims)
     rdt = amps.dtype
-    sign = None
-    for q in targets:
-        shape = [1] * len(dims)
-        shape[axis_of[q]] = 2
-        vec = jnp.array([1.0, -1.0], dtype=rdt).reshape(shape)
-        sign = vec if sign is None else sign * vec
+    sign = parity_sign(len(dims), axis_of, targets, rdt)
     half = jnp.asarray(angle, dtype=rdt) / 2.0
     cosf = jnp.cos(half)          # even in sign
     sinf = jnp.sin(half) * sign   # odd in sign
