@@ -200,16 +200,55 @@ def _expec_pauli_sum(amps, coeffs, *, codes, n, density):
     acc = precision.accum_dtype(amps.dtype)
     total = jnp.zeros((), dtype=acc)
     for i, term in enumerate(codes):
-        w = _pauli_prod_amps(amps, n, term)
         if density:
-            dim = 1 << (n // 2)
-            term_val = jnp.sum(
-                jnp.diagonal(w[0].reshape((dim, dim))).astype(acc))
+            term_val = _pauli_term_trace(amps, n // 2, term).astype(acc)
         else:
+            w = _pauli_prod_amps(amps, n, term)
             term_val = jnp.sum((amps[0] * w[0]
                                 + amps[1] * w[1]).astype(acc))  # Re<q|w>
         total = total + coeffs[i].astype(acc) * term_val
     return total
+
+
+def _pauli_term_trace(amps, N, term):
+    """Re Tr(P rho) reading only the 2^N entries the trace touches.
+
+    Tr(P rho) = sum_k coef(k) rho[k, k^x] with coef(k) =
+    i^{ny} (-1)^{parity(k & zy)} — a FLIPPED DIAGONAL of the stored
+    matrix, so the whole term costs one strided gather over 2^N entries
+    instead of a full 4^N-amplitude pass (the reference clones the 4^N
+    register and applies the string factor-by-factor,
+    QuEST_common.c:479-491)."""
+    from quest_tpu.ops import apply as A
+
+    x_bits = tuple(q for q, p in enumerate(term) if p in (1, 2))
+    zy_bits = tuple(q for q, p in enumerate(term) if p in (2, 3))
+    ny = sum(1 for p in term if p == 2)
+    dim = 1 << N
+    # stored layout: flat = row + col*2^N, so the row-major (dim, dim)
+    # view M has M[a, b] = rho[row=b, col=a]; we need M[k^x, k]
+    re = amps[0].reshape((dim, dim))
+    im = amps[1].reshape((dim, dim))
+    if x_bits:
+        x_desc = tuple(sorted(x_bits, reverse=True))
+        dims_a, axis_of_a = A.seg_view(N, x_desc)
+        axes = [axis_of_a[q] for q in x_bits]
+        shape = tuple(dims_a) + (dim,)
+        re = jnp.flip(re.reshape(shape), axis=axes).reshape((dim, dim))
+        im = jnp.flip(im.reshape(shape), axis=axes).reshape((dim, dim))
+    rdiag = jnp.diagonal(re)
+    idiag = jnp.diagonal(im)
+    if zy_bits:
+        zy_desc = tuple(sorted(zy_bits, reverse=True))
+        dims_k, axis_of_k = A.seg_view(N, zy_desc)
+        sign = A.parity_sign(len(dims_k), axis_of_k, zy_bits, amps.dtype)
+        sign = jnp.broadcast_to(sign, dims_k).reshape(-1)
+        rdiag = rdiag * sign
+        idiag = idiag * sign
+    # Re(i^{ny} * (rdiag + i idiag)): quarter-turn selects the plane
+    k = ny % 4
+    part = (rdiag, -idiag, -rdiag, idiag)[k]
+    return jnp.sum(part)
 
 
 def calc_expec_pauli_sum(q: Qureg, all_codes, coeffs) -> float:

@@ -139,6 +139,29 @@ def test_calc_expec_pauli_sum(rng):
     assert got == pytest.approx(want, abs=1e-8)
 
 
+def test_calc_expec_pauli_sum_density(rng):
+    """Tr(sum_t c_t P_t rho) via the flipped-diagonal fast path, against
+    the dense oracle — including odd-#Y strings (the phase-plane
+    selection) and the identity string."""
+    base = [
+        [2, 0, 0],            # single Y (odd #Y)
+        [2, 2, 3],            # two Ys + Z
+        [1, 3, 0],            # X, Z
+        [0, 0, 0],            # identity
+        [2, 1, 2],            # Y X Y
+    ]
+    codes = np.zeros((len(base), N), dtype=int)
+    codes[:, :3] = base
+    coeffs = rng.normal(size=len(codes))
+    rho = oracle.random_density(N, rng)
+    want = 0.0
+    for term, c in zip(codes, coeffs):
+        op = _pauli_prod_matrix(N, list(range(N)), term)
+        want += c * np.trace(op @ rho).real
+    got = C.calc_expec_pauli_sum(load_dm(rho), codes, coeffs)
+    assert got == pytest.approx(want, abs=1e-8)
+
+
 @pytest.mark.parametrize("qubit", range(N))
 @pytest.mark.parametrize("outcome", [0, 1])
 def test_calc_prob_of_outcome(qubit, outcome, rng):
