@@ -147,48 +147,10 @@ def calc_expec_pauli_prod(q: Qureg, targets: Sequence[int],
 
 
 def _pauli_prod_amps(amps, n, term):
-    """P|psi> for a whole Pauli string in ONE fused elementwise pass.
-
-    A Pauli string is not a chain of matrices — it is a single bit-flip
-    permutation (its X/Y factors) times a per-index sign (its Z/Y
-    factors) times the global phase (-i)^{#Y}:
-
-        (P psi)[j] = (-i)^{ny} * (-1)^{parity(j & zy)} * psi[j ^ x]
-
-    so the image costs one flip+sign+scale pass on the planes — no
-    matmuls, no per-factor passes (the reference clones the register and
-    applies the factors gate-by-gate per term, QuEST_common.c:479-491;
-    a k-factor term there is k full-state passes, here it is one). The
-    flip lowers to an axis reverse on the segment view, which XLA fuses
-    with the sign multiply and the surrounding reduction."""
+    """P|psi> in one fused flip-form pass (see ops.apply.apply_pauli_string
+    — the single home of the Pauli flip/sign/phase algebra)."""
     from quest_tpu.ops import apply as A
-
-    x_bits = tuple(q for q, p in enumerate(term) if p in (1, 2))
-    zy_bits = tuple(q for q, p in enumerate(term) if p in (2, 3))
-    ny = sum(1 for p in term if p == 2)
-    if not x_bits and not zy_bits:
-        return amps
-    involved = tuple(sorted(set(x_bits) | set(zy_bits), reverse=True))
-    dims, axis_of = A.seg_view(n, involved)
-    re = amps[0].reshape(dims)
-    im = amps[1].reshape(dims)
-    axes = [axis_of[q] for q in x_bits]
-    if axes:
-        re = jnp.flip(re, axis=axes)
-        im = jnp.flip(im, axis=axes)
-    sign = A.parity_sign(len(dims), axis_of, zy_bits, amps.dtype)
-    if sign is not None:
-        re = re * sign
-        im = im * sign
-    # global phase (-i)^{ny}: a quarter-turn plane rotation, not a multiply
-    k = ny % 4
-    if k == 1:      # * -i
-        re, im = im, -re
-    elif k == 2:    # * -1
-        re, im = -re, -im
-    elif k == 3:    # * i
-        re, im = -im, re
-    return jnp.stack([re.reshape(-1), im.reshape(-1)])
+    return A.apply_pauli_string(amps, n, term)
 
 
 @partial(jax.jit, static_argnames=("codes", "n", "density"))

@@ -84,6 +84,47 @@ def bit_tensor(ndims: int, axis: int):
     return jnp.arange(2).reshape(shape)
 
 
+def apply_pauli_string(amps, n, term):
+    """P|psi> for a whole Pauli string in ONE fused elementwise pass.
+
+    A Pauli string is a bit-flip permutation (its X/Y factors) times a
+    per-index sign (its Z/Y factors) times the global phase (-i)^{#Y}:
+
+        (P psi)[j] = (-i)^{ny} * (-1)^{parity(j & zy)} * psi[j ^ x]
+
+    One flip+sign+scale pass on the planes — no matmuls, no per-factor
+    passes (the reference applies the factors gate-by-gate,
+    QuEST_common.c:449-462). `term` is one Pauli code (0..3) per qubit.
+    Serves calc_expec_pauli_sum / apply_pauli_sum (calculations.py) and
+    the fused multi_rotate_pauli (gates.py)."""
+    x_bits = tuple(q for q, p in enumerate(term) if p in (1, 2))
+    zy_bits = tuple(q for q, p in enumerate(term) if p in (2, 3))
+    ny = sum(1 for p in term if p == 2)
+    if not x_bits and not zy_bits:
+        return amps
+    involved = tuple(sorted(set(x_bits) | set(zy_bits), reverse=True))
+    dims, axis_of = seg_view(n, involved)
+    re = amps[0].reshape(dims)
+    im = amps[1].reshape(dims)
+    axes = [axis_of[q] for q in x_bits]
+    if axes:
+        re = jnp.flip(re, axis=axes)
+        im = jnp.flip(im, axis=axes)
+    sign = parity_sign(len(dims), axis_of, zy_bits, amps.dtype)
+    if sign is not None:
+        re = re * sign
+        im = im * sign
+    # global phase (-i)^{ny}: a quarter-turn plane rotation, not a multiply
+    k = ny % 4
+    if k == 1:      # * -i
+        re, im = im, -re
+    elif k == 2:    # * -1
+        re, im = -re, -im
+    elif k == 3:    # * i
+        re, im = -im, re
+    return jnp.stack([re.reshape(-1), im.reshape(-1)])
+
+
 def parity_sign(ndims: int, axis_of, qubits, dtype):
     """(-1)^{parity of the listed qubits' bits} as a broadcast product of
     per-axis (+1, -1) vectors — no 2^k table, no permutation. Returns

@@ -346,42 +346,55 @@ def multi_rotate_z(q: Qureg, qubits: Sequence[int], angle) -> Qureg:
         targets=tuple(int(x) for x in qubits), density=q.is_density))
 
 
+@partial(jax.jit, static_argnames=("n", "term", "conj"))
+def _pauli_rot_worker(amps, angle, *, n, term, conj):
+    """exp(-i angle/2 * P) = cos(angle/2) I - i sin(angle/2) P applied as
+    ONE fused pass: the P image is the flip-form apply_pauli_string (no
+    basis-rotation passes). conj=True applies the complex conjugate
+    (the density dual): conj(P) = (-1)^{#Y} P, so only sin's sign
+    changes."""
+    rdt = amps.dtype
+    half = jnp.asarray(angle, dtype=rdt) / 2.0
+    c = jnp.cos(half)
+    s = jnp.sin(half)
+    if conj:
+        ny = sum(1 for p in term if p == 2)
+        s = -s if ny % 2 == 0 else s
+    w = A.apply_pauli_string(amps, n, term)
+    # psi*c - i*s*(P psi):  re = c re + s w_im ; im = c im - s w_re
+    return jnp.stack([c * amps[0] + s * w[1], c * amps[1] - s * w[0]])
+
+
 def multi_rotate_pauli(q: Qureg, targets: Sequence[int], paulis: Sequence[int],
                        angle) -> Qureg:
-    """exp(-i angle/2 * P1 x P2 x ...) via basis rotation + multiRotateZ
-    (ref statevec_multiRotatePauli, QuEST_common.c:410-447)."""
+    """exp(-i angle/2 * P1 x P2 x ...) in ONE fused pass per register
+    side: cos(a/2) psi - i sin(a/2) P psi, with P psi the flip-form
+    Pauli-string image (ops.apply.apply_pauli_string). The reference
+    rotates each X/Y target's basis, multiRotateZs, and rotates back —
+    2k+1 full-state passes (statevec_multiRotatePauli,
+    QuEST_common.c:410-447); here the whole exponential is one pass.
+    All-identity strings are a no-op, exactly like the reference's
+    'does nothing if there are no qubits to rotate' (:435-436)."""
     val.validate_multi_targets(q, targets)
     val.validate_pauli_targets(targets, paulis)
     val.validate_pauli_codes(paulis)
-    fac = 1.0 / np.sqrt(2.0)
-    # (alpha, beta) as (re, im) float 4-tuples:
-    # Rx(pi/2)* rotates Z -> Y : alpha = fac, beta = -i fac
-    rx = (fac, 0.0, 0.0, -fac)
-    # Ry(-pi/2) rotates Z -> X : alpha = fac, beta = -fac
-    ry = (fac, 0.0, -fac, 0.0)
-    rx_undo = (fac, 0.0, 0.0, fac)
-    ry_undo = (fac, 0.0, fac, 0.0)
-    z_targets = []
+    n = q.num_state_qubits
+    term = [0] * n
     for t, p in zip(targets, paulis):
-        p = int(p)
-        if p == 0:
-            continue
-        z_targets.append(int(t))
-        if p == 1:
-            q = _run(q, ry, (t,), builder=_build_compact)
-        elif p == 2:
-            q = _run(q, rx, (t,), builder=_build_compact)
-    if z_targets:
-        q = q.replace_amps(_parity_phase_worker(
-            q.amps, jnp.asarray(float(angle)), n=q.num_state_qubits,
-            targets=tuple(z_targets), density=q.is_density))
-    for t, p in zip(targets, paulis):
-        p = int(p)
-        if p == 1:
-            q = _run(q, ry_undo, (t,), builder=_build_compact)
-        elif p == 2:
-            q = _run(q, rx_undo, (t,), builder=_build_compact)
-    return q
+        term[int(t)] = int(p)
+    if not any(term):
+        return q
+    angle = jnp.asarray(float(angle))
+    amps = _pauli_rot_worker(q.amps, angle, n=n, term=tuple(term),
+                             conj=False)
+    if q.is_density:
+        shift = n // 2
+        dual = [0] * n
+        for t, p in zip(targets, paulis):
+            dual[int(t) + shift] = int(p)
+        amps = _pauli_rot_worker(amps, angle, n=n, term=tuple(dual),
+                                 conj=True)
+    return q.replace_amps(amps)
 
 
 # -- multi-qubit unitaries ---------------------------------------------------
