@@ -254,6 +254,33 @@ class Circuit:
     def multi_rotate_z(self, targets, angle):
         return self._add("parity", tuple(targets), float(angle))
 
+    def multi_rotate_pauli(self, targets, paulis, angle):
+        """exp(-i angle/2 * P1 x P2 x ...) as basis rotations around a
+        parity phase (ref statevec_multiRotatePauli,
+        QuEST_common.c:410-447). In a traced circuit this decomposition
+        is the right form: the 1q basis changes compose into the
+        surrounding band operators and the parity core is
+        communication-free on every engine (the eager gates path uses
+        the one-pass flip-form instead, gates.multi_rotate_pauli)."""
+        f = 1.0 / np.sqrt(2.0)
+        to_z = {1: np.array([[f, f], [-f, f]]),          # Ry(-pi/2)
+                2: np.array([[f, -1j * f], [-1j * f, f]])}  # Rx(pi/2)*
+        z_targets = []
+        for t, p in zip(targets, paulis):
+            p = int(p)
+            if p == 0:
+                continue
+            z_targets.append(int(t))
+            if p in to_z:
+                self._add("matrix", (int(t),), to_z[p])
+        if z_targets:
+            self._add("parity", tuple(z_targets), float(angle))
+        for t, p in zip(targets, paulis):
+            p = int(p)
+            if p in to_z:
+                self._add("matrix", (int(t),), to_z[p].conj().T)
+        return self
+
     def sqrt_swap(self, q1, q2):
         return self._add("matrix", (q1, q2), M.SQRT_SWAP)
 
