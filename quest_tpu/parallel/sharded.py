@@ -440,7 +440,8 @@ def _band_op_sharded(chunk, dev, *, D, local_n, bop):
 
 
 def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
-                                   mesh: Mesh, donate: bool = True):
+                                   mesh: Mesh, donate: bool = True,
+                                   lazy: bool = False):
     """Band-fusion engine over the mesh: the same planner that drives the
     single-chip engines (quest_tpu/ops/fusion.py), with bands aligned to
     the shard boundary. Commuting gate runs on local qubits compose into
@@ -448,7 +449,13 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     qubit (ONE ppermute pair exchange each — the reference would exchange
     once per gate, QuEST_cpu_distributed.c:846-881); cross-shard 2q
     unitaries KAK-decompose so their entangling content travels as
-    communication-free parity phases."""
+    communication-free parity phases. lazy=True additionally rewrites the
+    flat list through lazy qubit relabeling (parallel/relabel.py) before
+    band planning — measured COUNTERPRODUCTIVE here (1152 -> 1856 B on
+    the deep-global testbed): run composition already amortizes global
+    exchanges to ~one per qubit per layer, and the inserted SWAPs break
+    band runs apart. Kept for experimentation; the win lives on the
+    per-gate engine (2304 -> 896 B, same testbed)."""
     from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
 
@@ -458,6 +465,9 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     flat = flatten_ops(ops, n, density)
+    if lazy:
+        from quest_tpu.parallel.relabel import lazy_relabel_ops
+        flat = lazy_relabel_ops(flat, n, local_n)
     items = F.plan(flat, n, bands=_shard_bands(n, local_n))
 
     def run(chunk):
@@ -575,10 +585,16 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
 
 
 def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
-                            donate: bool = True):
+                            donate: bool = True, lazy: bool = False):
     """Compile a gate sequence into ONE shard_map program over the mesh —
     the explicit, reference-faithful distributed schedule. Returns a jitted
-    fn: sharded (2, 2^n) planes -> sharded (2, 2^n) planes."""
+    fn: sharded (2, 2^n) planes -> sharded (2, 2^n) planes.
+
+    lazy=True first rewrites the (flattened) op list through lazy qubit
+    relabeling (quest_tpu.parallel.relabel): global-target gates swap
+    their qubit local and LEAVE it there, amortizing exchanges across
+    depth (~2x less ICI on deep circuits; the reference swap-dances
+    every gate, QuEST_cpu_distributed.c:1441-1483)."""
     D = int(mesh.devices.size)
     g = int(math.log2(D))
     local_n = n - g
@@ -589,7 +605,14 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
         raise QuESTError(
             "Invalid operation: noise channels require a density-matrix "
             "register")
-    ops = tuple(ops)
+    if lazy:
+        from quest_tpu.circuit import flatten_ops
+        from quest_tpu.parallel.relabel import lazy_relabel_ops
+        ops = tuple(lazy_relabel_ops(flatten_ops(ops, n, density), n,
+                                     local_n))
+        density = False  # duals are explicit in the flattened list
+    else:
+        ops = tuple(ops)
 
     def run(chunk):
         chunk = chunk.reshape(2, -1)

@@ -1,0 +1,105 @@
+"""Lazy qubit relabeling (quest_tpu/parallel/relabel.py).
+
+Correctness: the rewritten op list produces identical amplitudes through
+the sharded engines on the 8-device mesh — including the restore, so the
+register leaves in standard order. Traffic: a deep circuit rotating
+global qubits each layer must move LESS through collective-permutes than
+the swap-dance schedule (the whole point of the pass).
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from benchmarks.channel_bytes import collective_permute_bytes
+from quest_tpu.circuit import Circuit, flatten_ops, random_circuit
+from quest_tpu.parallel import make_amp_mesh, shard_qureg
+from quest_tpu.parallel.relabel import lazy_relabel_ops
+from quest_tpu.parallel.sharded import (compile_circuit_sharded,
+                                        compile_circuit_sharded_banded)
+from quest_tpu.state import to_dense
+
+N = 6
+DTYPE = np.complex128
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_amp_mesh(8)
+
+
+def _deep_global_circuit(n, depth):
+    """RCS-shaped: every layer rotates EVERY qubit (incl. globals) and
+    entangles with CZs — the worst case for per-gate swap-dancing."""
+    rng = np.random.default_rng(5)
+    c = Circuit(n)
+    for _ in range(depth):
+        for q in range(n):
+            c.rx(q, float(rng.uniform(0, 2 * np.pi)))
+            c.ry(q, float(rng.uniform(0, 2 * np.pi)))
+        for q in range(0, n - 1, 2):
+            c.cz(q, q + 1)
+    return c
+
+
+def _check_equiv(circ, mesh, density=False):
+    make = qt.create_density_qureg if density else qt.create_qureg
+    nq = circ.num_qubits
+    q1 = qt.init_debug_state(make(nq, dtype=DTYPE))
+    q2 = qt.init_debug_state(make(nq, dtype=DTYPE))
+    n = q1.num_state_qubits
+    plain = compile_circuit_sharded(circ.ops, n, density, mesh, donate=False)
+    lazy = compile_circuit_sharded(circ.ops, n, density, mesh, donate=False,
+                                   lazy=True)
+    a = to_dense(shard_qureg(q1, mesh).replace_amps(
+        plain(shard_qureg(q1, mesh).amps)))
+    b = to_dense(shard_qureg(q2, mesh).replace_amps(
+        lazy(shard_qureg(q2, mesh).amps)))
+    np.testing.assert_allclose(a, b, atol=1e-12, rtol=0)
+
+
+def test_lazy_equivalence_random_circuits(mesh):
+    for seed in (3, 11):
+        _check_equiv(random_circuit(N, depth=5, seed=seed), mesh)
+
+
+def test_lazy_equivalence_deep_global(mesh):
+    _check_equiv(_deep_global_circuit(N, 4), mesh)
+
+
+def test_lazy_equivalence_density_channels(mesh):
+    c = Circuit(3).h(2).damping(2, 0.2).cnot(0, 2).depolarising(1, 0.1)
+    _check_equiv(c, mesh, density=True)
+
+
+def test_lazy_equivalence_banded_engine(mesh):
+    c = random_circuit(N, depth=5, seed=7)
+    q1 = qt.init_debug_state(qt.create_qureg(N, dtype=DTYPE))
+    plain = compile_circuit_sharded_banded(c.ops, N, False, mesh,
+                                           donate=False)
+    lazy = compile_circuit_sharded_banded(c.ops, N, False, mesh,
+                                          donate=False, lazy=True)
+    s = shard_qureg(q1, mesh).amps
+    np.testing.assert_allclose(np.asarray(plain(s)), np.asarray(lazy(s)),
+                               atol=1e-12, rtol=0)
+
+
+def test_lazy_reduces_collective_traffic(mesh):
+    import jax
+
+    c = _deep_global_circuit(N, 6)
+    amps = shard_qureg(qt.create_qureg(N, dtype=DTYPE), mesh).amps
+
+    def bytes_of(lazy):
+        step = compile_circuit_sharded(c.ops, N, False, mesh, donate=False,
+                                       lazy=lazy)
+        return collective_permute_bytes(step.lower(amps).compile().as_text())
+
+    plain, lazy = bytes_of(False), bytes_of(True)
+    assert lazy < plain, (plain, lazy)
+    assert lazy <= 0.67 * plain, f"expected >=1.5x reduction: {plain} -> {lazy}"
+
+
+def test_rewrite_is_identity_when_all_local(mesh):
+    flat = flatten_ops(random_circuit(N, depth=3, seed=2).ops, N, False)
+    assert lazy_relabel_ops(flat, N, N) == list(flat)
