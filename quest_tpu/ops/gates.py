@@ -457,14 +457,22 @@ def apply_pauli_prod(q: Qureg, targets: Sequence[int], paulis: Sequence[int]) ->
     """Left-multiply by a product of Pauli operators (possibly non-trace-
     preserving on density matrices; ref statevec_applyPauliProd,
     QuEST_common.c:450-461). NOTE: on density registers this multiplies the
-    ROW space only (P rho, not P rho P+), exactly like the reference."""
+    ROW space only (P rho, not P rho P+), exactly like the reference.
+    One fused flip-form pass regardless of factor count (the reference
+    applies one kernel per factor)."""
     val.validate_pauli_targets(targets, paulis)
+    term = [0] * q.num_state_qubits
     for t, p in zip(targets, paulis):
-        p = int(p)
-        if p == 0:
-            continue
-        q = _run(q, M.PAULIS[p], (int(t),), dual=False, static=True)
-    return q
+        term[int(t)] = int(p)
+    if not any(term):
+        return q
+    return q.replace_amps(_pauli_string_worker(
+        q.amps, n=q.num_state_qubits, term=tuple(term)))
+
+
+@partial(jax.jit, static_argnames=("n", "term"))
+def _pauli_string_worker(amps, *, n, term):
+    return A.apply_pauli_string(amps, n, term)
 
 
 @jax.jit
