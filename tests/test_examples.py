@@ -1,0 +1,23 @@
+"""The example programs' logic stays correct (scaled-down where the
+full example is benchmark-sized)."""
+
+import numpy as np
+
+
+def test_grover_scaled():
+    import jax
+
+    import quest_tpu as qt
+    from examples.grover_search import grover_circuit
+    from quest_tpu import measurement as meas
+
+    n, marked = 8, 0b10110010
+    dim = 1 << n
+    theta = np.arcsin(1.0 / np.sqrt(dim))
+    k = int(np.round(np.pi / (4 * theta) - 0.5))
+    q = grover_circuit(n, marked, k).apply_banded(qt.create_qureg(n))
+    p = float(q.amps[0, marked]) ** 2 + float(q.amps[1, marked]) ** 2
+    want = np.sin((2 * k + 1) * theta) ** 2
+    assert abs(p - want) < 1e-4
+    shots = np.asarray(meas.sample(q, 16, jax.random.PRNGKey(1)))
+    assert (shots == marked).mean() > 0.9
