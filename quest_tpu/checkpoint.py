@@ -90,9 +90,30 @@ def _orbax():
         ) from e
 
 
-def save_sharded(qureg: Qureg, directory: str) -> None:
+class PendingCheckpoint:
+    """Handle for an in-flight async checkpoint: `wait()` blocks until
+    the files are durable. The state array was snapshotted at save time
+    (orbax holds the device buffers), so the caller may keep mutating
+    the register while the write streams out."""
+
+    def __init__(self, ckptr):
+        self._ckptr = ckptr
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+
+
+def save_sharded(qureg: Qureg, directory: str,
+                 block: bool = True) -> PendingCheckpoint:
     """Checkpoint the device array WITHOUT gathering to one host: each
-    shard writes its own slice (orbax/tensorstore OCDBT)."""
+    shard writes its own slice (orbax/tensorstore OCDBT).
+
+    block=False returns immediately with a PendingCheckpoint while the
+    write streams in the background — simulation continues overlapping
+    the IO (the TPU-native pattern for multi-GB states; the snapshot is
+    consistent even if the register keeps evolving, because the
+    functional engine never mutates buffers in place unless donated —
+    do NOT donate the checkpointed array before wait())."""
     ocp = _orbax()
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
@@ -101,7 +122,10 @@ def save_sharded(qureg: Qureg, directory: str) -> None:
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(directory, _ORBAX_DIR), {"amps": qureg.amps},
                force=True)
-    ckptr.wait_until_finished()
+    pending = PendingCheckpoint(ckptr)
+    if block:
+        pending.wait()
+    return pending
 
 
 def load_sharded(directory: str, env=None, dtype=None) -> Qureg:

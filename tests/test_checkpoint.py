@@ -64,3 +64,29 @@ def test_sharded_checkpoint_roundtrip(tmp_path, rng):
     ckpt.save_sharded(q, str(tmp_path / "ock"))
     q2 = ckpt.load_sharded(str(tmp_path / "ock"))
     np.testing.assert_array_equal(to_dense(q2), to_dense(q))
+
+
+def test_async_sharded_checkpoint(tmp_path):
+    """save_sharded(block=False): the write streams while the register
+    keeps evolving; wait() makes it durable; the loaded state is the
+    PRE-continuation snapshot."""
+    import quest_tpu as qt
+    ck = ckpt
+    from quest_tpu.circuit import random_circuit
+    from quest_tpu.parallel import shard_qureg
+    from quest_tpu.state import to_dense
+
+    from quest_tpu.parallel import make_amp_mesh
+    mesh = make_amp_mesh(8)
+    n = 6
+    q = qt.init_debug_state(shard_qureg(qt.create_qureg(n), mesh))
+    q = random_circuit(n, depth=2, seed=4).apply(q)
+    snapshot = to_dense(q)
+    pending = ck.save_sharded(q, str(tmp_path / "async"), block=False)
+    # keep simulating while the write streams (no donation of q.amps)
+    q2 = random_circuit(n, depth=2, seed=5).apply(q)
+    assert q2 is not q
+    pending.wait()
+    restored = ck.load_sharded(str(tmp_path / "async"))
+    np.testing.assert_allclose(to_dense(restored), snapshot,
+                               atol=1e-6, rtol=0)
