@@ -324,6 +324,30 @@ class Circuit:
         """Symmetric controlled phase e^{i angle} on all-ones of qubits."""
         return self._add("allones", tuple(qubits), np.exp(1j * float(angle)))
 
+    def inverse(self) -> "Circuit":
+        """The adjoint circuit: ops reversed, each operand conjugate-
+        transposed (matrix -> U+, diagonal/allones -> conjugate, parity
+        -> negated angle). Controls/control-states are preserved (the
+        adjoint of a controlled U is the same-controlled U+). Circuits
+        containing noise channels are not invertible and raise. No
+        reference analogue (QuEST has no circuit object); enables
+        uncomputation patterns like QPE's inverse QFT."""
+        inv = Circuit(self.num_qubits)
+        for op in reversed(self.ops):
+            if op.kind == "superop":
+                from quest_tpu.validation import QuESTError
+                raise QuESTError(
+                    "Invalid operation: a circuit containing noise "
+                    "channels has no inverse.")
+            if op.kind == "matrix":
+                operand = np.asarray(op.operand).conj().T
+            elif op.kind in ("diagonal", "allones"):
+                operand = np.conj(op.operand)
+            else:                      # parity: exp(-i a/2 Z..Z)
+                operand = -op.operand
+            inv.ops.append(dataclasses.replace(op, operand=operand))
+        return inv
+
     def to_qasm(self) -> str:
         """OPENQASM 2.0 text of this circuit, through the same logger the
         eager API records with (quest_tpu/qasm.py; ref QuEST_qasm.c).

@@ -21,3 +21,42 @@ def test_grover_scaled():
     assert abs(p - want) < 1e-4
     shots = np.asarray(meas.sample(q, 16, jax.random.PRNGKey(1)))
     assert (shots == marked).mean() > 0.9
+
+
+def test_circuit_inverse_is_identity():
+    """C.inverse() after C restores the debug state on every op kind
+    (matrix/diagonal/parity/allones, controls included)."""
+    import quest_tpu as qt
+    from quest_tpu.circuit import Circuit, random_circuit
+    from quest_tpu.state import to_dense
+
+    n = 5
+    c = random_circuit(n, depth=4, seed=9)
+    c.multi_rotate_z((0, 2, 4), 0.7).cphase(0.3, 1, 3).s(2)
+    q0 = qt.init_debug_state(qt.create_qureg(n, dtype=np.complex128))
+    want = to_dense(q0)
+    q = c.inverse().apply(c.apply(q0))
+    np.testing.assert_allclose(to_dense(q), want, atol=1e-12, rtol=0)
+
+
+def test_circuit_inverse_rejects_noise():
+    import pytest
+
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.validation import QuESTError
+
+    with pytest.raises(QuESTError, match="no inverse"):
+        Circuit(2).h(0).damping(1, 0.1).inverse()
+
+
+def test_qpe_scaled():
+    import jax
+
+    import quest_tpu as qt
+    from examples.phase_estimation import qpe_circuit
+    from quest_tpu import measurement as meas
+
+    t, phi = 5, 11 / 32
+    q = qpe_circuit(t, phi).apply(qt.create_qureg(t + 1))
+    shots = np.asarray(meas.sample(q, 16, jax.random.PRNGKey(2)))
+    assert np.all((shots & ((1 << t) - 1)) == 11)
