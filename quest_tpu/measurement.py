@@ -185,14 +185,68 @@ def _sample_traced(amps, key, *, n, density, num_shots):
     return jnp.searchsorted(cdf, u, side="right").astype(jnp.int32)
 
 
+def _sample_sharded_body(amps, key, *, n, density, num_shots, D):
+    """Per-shard inverse-CDF sampling: local CDFs + a D-scalar all_gather
+    carry (the only cross-shard traffic). Every device draws the SAME
+    uniforms; the cumsum of shard totals (identical everywhere) defines a
+    consistent, gap-free ownership partition, and each shard resolves its
+    own shots with a local searchsorted. ICI cost: D scalars + one psum
+    over (num_shots,) ints — the state NEVER gathers (GSPMD would have
+    compiled the naive path to a single-device program, an impossible
+    8+ TB gather at pod scale)."""
+    from quest_tpu.env import AMP_AXIS
+
+    dev = jax.lax.axis_index(AMP_AXIS)
+    if density:
+        dim = 1 << (n // 2)
+        cols_local = amps.shape[1] // dim
+        mat = amps[0].reshape(cols_local, dim)
+        idx = dev * cols_local + jnp.arange(cols_local)
+        probs = jnp.take_along_axis(mat, idx[:, None], axis=1)[:, 0]
+    else:
+        probs = amps[0] * amps[0] + amps[1] * amps[1]
+    local_cdf = _stable_cdf(probs)
+    totals = jax.lax.all_gather(local_cdf[-1], AMP_AXIS)      # (D,)
+    acc = precision.accum_dtype(probs.dtype)
+    cuml = jnp.cumsum(totals.astype(acc))
+    lo = jnp.where(dev > 0, cuml[jnp.maximum(dev - 1, 0)], 0.0)
+    hi = cuml[dev]
+    grand = cuml[-1]
+    u = jax.random.uniform(key, (num_shots,), dtype=acc) * grand
+    mine = (u >= lo) & (u < hi)
+    loc = jnp.searchsorted(local_cdf,
+                           (u - lo).astype(local_cdf.dtype), side="right")
+    loc = jnp.minimum(loc, probs.shape[0] - 1)
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    glob = (dev.astype(idt) * probs.shape[0] + loc.astype(idt))
+    return jax.lax.psum(jnp.where(mine, glob, 0), AMP_AXIS)
+
+
 def sample(q: Qureg, num_shots: int, key) -> jax.Array:
     """Draw `num_shots` full-register computational-basis samples WITHOUT
     collapsing the state — one device-side categorical draw over the
     probability distribution. The reference can only sample by repeated
     measure() calls that destroy the state (its RCS-style workloads
     re-prepare the state per shot); batched sampling is the TPU-native
-    replacement. Returns an int array of basis-state indices."""
+    replacement. Sharded registers sample in place: per-shard CDFs with a
+    scalar carry, no state gather. Returns an int array of basis-state
+    indices."""
     if num_shots < 1:
         raise val.QuESTError("Invalid number of shots: must be positive.")
+    sh = getattr(q.amps, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from quest_tpu.env import AMP_AXIS
+
+        if AMP_AXIS in mesh.axis_names:
+            body = partial(_sample_sharded_body, n=q.num_state_qubits,
+                           density=q.is_density, num_shots=num_shots,
+                           D=int(mesh.devices.size))
+            run = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
+                out_specs=P()))
+            return run(q.amps, key)
     return _sample_traced(q.amps, key, n=q.num_state_qubits,
                           density=q.is_density, num_shots=num_shots)
