@@ -165,15 +165,29 @@ def clone(qureg: Qureg) -> Qureg:
 # ---------------------------------------------------------------------------
 
 
+
+def _init_amps(qureg: Qureg, amps) -> Qureg:
+    """Install freshly built planes, PRESERVING the register's sharding.
+    Every init_* builds a new array (functional design), which would
+    otherwise land on the default device and silently de-shard a
+    mesh-sharded register — after which every downstream op compiles as
+    a single-device program (measured: GSPMD gathers the full state).
+    The ONE place init results are committed."""
+    sh = getattr(qureg.amps, "sharding", None)
+    if getattr(sh, "mesh", None) is not None:
+        amps = jax.device_put(amps, sh)
+    return qureg.replace_amps(amps)
+
+
 def init_blank_state(qureg: Qureg) -> Qureg:
     """All amplitudes zero (an unnormalized, unphysical state)."""
-    return qureg.replace_amps(
-        _planes(qureg.num_state_qubits, qureg.real_dtype))
+    return _init_amps(qureg,
+                      _planes(qureg.num_state_qubits, qureg.real_dtype))
 
 
 def init_zero_state(qureg: Qureg) -> Qureg:
     """|0...0> or |0..0><0..0|."""
-    return qureg.replace_amps(_basis_planes(
+    return _init_amps(qureg, _basis_planes(
         0, n=qureg.num_state_qubits, rdt=qureg.real_dtype))
 
 
@@ -187,7 +201,7 @@ def init_plus_state(qureg: Qureg) -> Qureg:
     rdt = qureg.real_dtype
     re = jnp.full((qureg.num_amps,), val, dtype=rdt)
     im = jnp.zeros((qureg.num_amps,), dtype=rdt)
-    return qureg.replace_amps(jnp.stack([re, im]))
+    return _init_amps(qureg, jnp.stack([re, im]))
 
 
 def init_classical_state(qureg: Qureg, state_index: int) -> Qureg:
@@ -197,7 +211,7 @@ def init_classical_state(qureg: Qureg, state_index: int) -> Qureg:
         flat = state_index + (state_index << qureg.num_qubits)
     else:
         flat = state_index
-    return qureg.replace_amps(_basis_planes(
+    return _init_amps(qureg, _basis_planes(
         flat, n=qureg.num_state_qubits, rdt=qureg.real_dtype))
 
 
@@ -209,8 +223,8 @@ def init_debug_state(qureg: Qureg) -> Qureg:
     """
     rdt = qureg.real_dtype
     k = jnp.arange(qureg.num_amps, dtype=rdt)
-    return qureg.replace_amps(
-        jnp.stack([(2.0 * k) / 10.0, (2.0 * k + 1.0) / 10.0]))
+    return _init_amps(qureg,
+                      jnp.stack([(2.0 * k) / 10.0, (2.0 * k + 1.0) / 10.0]))
 
 
 @partial(jax.jit, static_argnames=("n", "qubit", "outcome", "rdt"))
@@ -229,7 +243,7 @@ def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> Qureg:
     validation.validate_state_vector(qureg)
     validation.validate_target(qureg, qubit)
     validation.validate_outcome(outcome)
-    return qureg.replace_amps(_single_qubit_outcome_planes(
+    return _init_amps(qureg, _single_qubit_outcome_planes(
         n=qureg.num_state_qubits, qubit=qubit, outcome=outcome,
         rdt=qureg.real_dtype))
 
@@ -240,14 +254,14 @@ def init_pure_state(qureg: Qureg, pure: Qureg) -> Qureg:
     validation.validate_pure_state_args(qureg, pure)
     rdt = qureg.real_dtype
     if not qureg.is_density:
-        return qureg.replace_amps(pure.amps.astype(rdt))
+        return _init_amps(qureg, pure.amps.astype(rdt))
     re, im = pure.amps[0].astype(rdt), pure.amps[1].astype(rdt)
     # rho[r, c] = psi_r conj(psi_c); flat index r + c*2^N = column-major,
     # i.e. row-major of rho^T
     rho_re = jnp.outer(re, re) + jnp.outer(im, im)
     rho_im = jnp.outer(im, re) - jnp.outer(re, im)
-    return qureg.replace_amps(
-        jnp.stack([rho_re.T.reshape(-1), rho_im.T.reshape(-1)]))
+    return _init_amps(qureg,
+                      jnp.stack([rho_re.T.reshape(-1), rho_im.T.reshape(-1)]))
 
 
 def _host_pair(reals, imags, rdt):
@@ -265,8 +279,8 @@ def init_state_from_amps(qureg: Qureg, reals, imags) -> Qureg:
     if reals.size != qureg.num_amps:
         raise validation.QuESTError(
             "Invalid number of amplitudes: must match the register size")
-    return qureg.replace_amps(
-        jnp.asarray(_host_pair(reals, imags, qureg.real_dtype)))
+    return _init_amps(qureg,
+                      jnp.asarray(_host_pair(reals, imags, qureg.real_dtype)))
 
 
 def set_amps(qureg: Qureg, start_index: int, reals, imags) -> Qureg:

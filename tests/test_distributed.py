@@ -557,3 +557,29 @@ def test_sharded_sample_matches_distribution(mesh, rng):
     freq = np.bincount(shots, minlength=1 << N) / 4096
     p = np.abs(v) ** 2
     assert np.max(np.abs(freq - p)) < 5 * np.sqrt(p.max() / 4096)
+
+
+@pytest.mark.parametrize("init", ["zero", "plus", "classical", "debug",
+                                  "blank", "single_qubit"])
+def test_init_preserves_sharding(mesh, init):
+    """Every init_* keeps a mesh-sharded register SHARDED. Fresh arrays
+    used to land on the default device, silently de-sharding the
+    register — after which every downstream op compiled as a
+    single-device program (a full-state gather at pod scale)."""
+    q = shard_qureg(qt.create_qureg(N, dtype=DTYPE), mesh)
+    if init == "zero":
+        q = qt.init_zero_state(q)
+    elif init == "plus":
+        q = qt.init_plus_state(q)
+    elif init == "classical":
+        q = qt.init_classical_state(q, 7)
+    elif init == "debug":
+        q = qt.init_debug_state(q)
+    elif init == "blank":
+        q = qt.init_blank_state(q)
+    else:
+        from quest_tpu.state import init_state_of_single_qubit
+        q = init_state_of_single_qubit(q, 2, 1)
+    assert getattr(q.amps.sharding, "mesh", None) is not None, (
+        f"{init} de-sharded the register")
+    assert q.amps.sharding.mesh.devices.size == 8
