@@ -48,3 +48,26 @@ def test_csv_roundtrip(tmp_path):
     np.testing.assert_allclose(got[1], im, atol=1e-11)
     # short read returns None
     assert native.read_state_csv(path, n + 1) is None
+
+
+def test_csv_chunked_append_roundtrip(tmp_path):
+    """write + append produce one coherent CSV (the bounded-memory
+    streaming path reportState uses for huge registers)."""
+    import numpy as np
+
+    from quest_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native runtime not built")
+    path = str(tmp_path / "state.csv")
+    rng = np.random.default_rng(0)
+    re = rng.standard_normal(300)
+    im = rng.standard_normal(300)
+    assert native.write_state_csv(path, re[:100], im[:100])
+    assert native.append_state_csv(path, re[100:200], im[100:200])
+    assert native.append_state_csv(path, re[200:], im[200:])
+    back = native.read_state_csv(path, 300)
+    assert back is not None
+    np.testing.assert_allclose(back[0], re, atol=1e-12)
+    np.testing.assert_allclose(back[1], im, atol=1e-12)
