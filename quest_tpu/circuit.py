@@ -39,8 +39,9 @@ def dual_of(op: GateOp, shift: int):
     operand on targets/controls shifted by N (ref QuEST.c:8-10). The ONE
     place the dual rules live — used by the XLA path, the fused-engine
     expansion, and anything else that flattens density circuits.
-    Superoperators already act on both spaces: no dual (returns None)."""
-    if op.kind == "superop":
+    Superoperators already act on both spaces: no dual (returns None);
+    measurements handle the density register directly (no dual)."""
+    if op.kind in ("superop", "measure", "measure_dm"):
         return None
     if op.kind == "parity":
         return dataclasses.replace(
@@ -110,6 +111,21 @@ def flatten_ops(ops, n: int, density: bool) -> List[GateOp]:
             flat.append(dataclasses.replace(
                 op, kind="matrix",
                 targets=M.superop_targets(op.targets, n // 2)))
+            continue
+        if op.kind == "measure":
+            # the measurement worker handles the density register itself
+            # (diagonal probability + both-space collapse); tag it so the
+            # flat executors, which otherwise run with density=False,
+            # know which math to use. The tagged op CLAIMS both the qubit
+            # and its column-space dual (targets[0] stays the logical
+            # qubit): the fusion planner must not commute a later gate's
+            # dual back across the collapse.
+            if density:
+                q0 = op.targets[0]
+                flat.append(dataclasses.replace(
+                    op, kind="measure_dm", targets=(q0, q0 + n // 2)))
+            else:
+                flat.append(op)
             continue
         flat.append(op)
         if density:
@@ -254,6 +270,29 @@ class Circuit:
     def multi_rotate_z(self, targets, angle):
         return self._add("parity", tuple(targets), float(angle))
 
+    def measure(self, qubit):
+        """MID-CIRCUIT measurement of `qubit` in the computational basis:
+        the outcome is drawn inside the traced program (jax.random key,
+        branchless collapse — quest_tpu.measurement._measure_traced) and
+        returned as a device value. Circuits containing measurements run
+        through compiled_measured / apply_measured, which take a PRNG key
+        and return the outcome sequence alongside the state. The
+        reference can only measure eagerly between kernel launches
+        (statevec_measureWithStats, QuEST_common.c:360-366); here a
+        dynamic circuit stays ONE compiled program."""
+        return self._add("measure", (int(qubit),), None)
+
+    def _measure_count(self) -> int:
+        return sum(1 for op in self.ops if op.kind == "measure")
+
+    def _reject_measure(self, what: str):
+        if self._measure_count():
+            from quest_tpu.validation import QuESTError
+            raise QuESTError(
+                f"Invalid operation: this circuit contains mid-circuit "
+                f"measurements; use compiled_measured/apply_measured "
+                f"instead of {what}.")
+
     def multi_rotate_pauli(self, targets, paulis, angle):
         """exp(-i angle/2 * P1 x P2 x ...) as basis rotations around a
         parity phase (ref statevec_multiRotatePauli,
@@ -324,6 +363,90 @@ class Circuit:
         """Symmetric controlled phase e^{i angle} on all-ones of qubits."""
         return self._add("allones", tuple(qubits), np.exp(1j * float(angle)))
 
+    def compiled_measured(self, n: int, density: bool, donate: bool = True,
+                          engine: str = "banded"):
+        """Compiled DYNAMIC circuit: returns fn(amps, key) ->
+        (amps, outcomes) where outcomes is an int32 array of the
+        mid-circuit measurement results in program order. The whole
+        dynamic circuit — gates, outcome draws, branchless collapses —
+        is ONE XLA program (the reference must come back to the host
+        between measurements). engine: 'banded' (band-fusion between
+        measurement barriers; the fusion planner treats a measurement
+        as an opaque item that commutes only with disjoint-qubit ops)
+        or 'xla' (per-gate)."""
+        if engine not in ("banded", "xla"):
+            raise ValueError(f"engine must be 'banded' or 'xla', got {engine!r}")
+        if not self._measure_count():
+            from quest_tpu.validation import QuESTError
+            raise QuESTError(
+                "Invalid operation: compiled_measured requires at least "
+                "one mid-circuit measurement; use compiled() instead.")
+        key_ = ("measured", engine, n, density, donate,
+                precision.matmul_precision())
+        fn = self._compiled.get(key_)
+        if fn is not None:
+            return fn
+
+        flat = flatten_ops(self.ops, n, density)
+
+        def measure_item(amps, key, op):
+            from quest_tpu import measurement as meas
+            key, sub = jax.random.split(key)
+            amps, outcome, _ = meas._measure_traced(
+                amps, sub, n=n, qubit=op.targets[0],
+                density=op.kind == "measure_dm")
+            return amps, key, outcome.astype(jnp.int32)
+
+        if engine == "banded":
+            from quest_tpu.ops import fusion as F
+            items = F.plan(flat, n)
+
+            def run(amps, key):
+                outs = []
+                for it in items:
+                    if isinstance(it, F.BandOp):
+                        amps = A.apply_band(amps, n, (it.gre, it.gim),
+                                            it.ql, it.w, it.preds)
+                    elif isinstance(it, F.DiagItem):
+                        amps = _apply_one(amps, n, it.op)
+                    elif it.op.kind in ("measure", "measure_dm"):
+                        amps, key, oc = measure_item(amps, key, it.op)
+                        outs.append(oc)
+                    else:
+                        amps = _apply_op(amps, n, False, it.op)
+                return amps, jnp.stack(outs)
+        else:
+            def run(amps, key):
+                outs = []
+                for op in flat:
+                    if op.kind in ("measure", "measure_dm"):
+                        amps, key, oc = measure_item(amps, key, op)
+                        outs.append(oc)
+                    else:
+                        amps = _apply_one(amps, n, op)
+                return amps, jnp.stack(outs)
+
+        fn = jax.jit(run, donate_argnums=(0,) if donate else ())
+        self._compiled[key_] = fn
+        return fn
+
+    def apply_measured(self, q: Qureg, key, donate: bool = False,
+                       engine: str = "banded"):
+        """Apply a dynamic circuit: (new register, outcomes int32 array
+        in program order). `key` is a jax.random key; identical keys
+        reproduce identical trajectories."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        if not self._measure_count():
+            from quest_tpu.validation import QuESTError
+            raise QuESTError(
+                "Invalid operation: apply_measured requires at least one "
+                "mid-circuit measurement; use apply() instead.")
+        fn = self.compiled_measured(q.num_state_qubits, q.is_density,
+                                    donate, engine)
+        amps, outcomes = fn(q.amps, key)
+        return q.replace_amps(amps), outcomes
+
     def inverse(self) -> "Circuit":
         """The adjoint circuit: ops reversed, each operand conjugate-
         transposed (matrix -> U+, diagonal/allones -> conjugate, parity
@@ -334,11 +457,12 @@ class Circuit:
         uncomputation patterns like QPE's inverse QFT."""
         inv = Circuit(self.num_qubits)
         for op in reversed(self.ops):
-            if op.kind == "superop":
+            if op.kind in ("superop", "measure"):
                 from quest_tpu.validation import QuESTError
                 raise QuESTError(
-                    "Invalid operation: a circuit containing noise "
-                    "channels has no inverse.")
+                    "Invalid operation: a circuit containing "
+                    + ("measurements" if op.kind == "measure" else
+                       "noise channels") + " has no inverse.")
             if op.kind == "matrix":
                 operand = np.asarray(op.operand).conj().T
             elif op.kind in ("diagonal", "allones"):
@@ -364,6 +488,9 @@ class Circuit:
         for op in self.ops:
             targets, controls = op.targets, op.controls
             cstates = op.cstates or (1,) * len(controls)
+            if op.kind == "measure":
+                log.record_measurement(targets[0])
+                continue
             if op.kind == "parity":
                 if len(targets) == 1 and not controls:
                     log.record_gate("rz", targets[0], (), (op.operand,))
@@ -429,6 +556,7 @@ class Circuit:
 
     def trace(self, amps, n: int, density: bool):
         """Apply all ops to raw amplitudes inside an existing trace."""
+        self._reject_measure("trace")
         if not density and any(op.kind == "superop" for op in self.ops):
             from quest_tpu.validation import QuESTError
             raise QuESTError(
@@ -440,6 +568,7 @@ class Circuit:
 
     def compiled(self, n: int, density: bool, donate: bool = True,
                  iters: int = 1):
+        self._reject_measure("compiled")
         key = (n, density, donate, iters,
                precision.matmul_precision())
         fn = self._compiled.get(key)
@@ -468,6 +597,7 @@ class Circuit:
         contraction (apply_band). Diagonal/parity ops stay elementwise and
         XLA fuses them into the neighbouring passes. A layer of n
         single-qubit gates costs ~ceil(n/7) memory passes instead of n."""
+        self._reject_measure("compiled_banded")
         key = ("banded", n, density, donate, iters,
                precision.matmul_precision())
         fn = self._compiled.get(key)
@@ -488,6 +618,7 @@ class Circuit:
     def banded_trace(self, amps, n: int, density: bool):
         """Apply the band-fusion plan to raw amplitudes inside an existing
         trace (the un-jitted core of compiled_banded)."""
+        self._reject_measure("banded_trace")
         from quest_tpu.ops import fusion as F
         items = F.plan(self._flat_ops(n, density), n)
         return _apply_banded_items(amps, n, items)
@@ -507,6 +638,7 @@ class Circuit:
         HBM pass; band ops above the block top and cross-band unitaries
         run through the XLA band path between segments. `interpret=True`
         runs the kernels in the Pallas interpreter (for CPU testing)."""
+        self._reject_measure("compiled_fused")
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
         key = ("fused", n, density, donate, interpret, iters,
@@ -582,6 +714,7 @@ class Circuit:
         """Compiled explicit-distribution program (one shard_map over the
         whole circuit, reference-style ppermute schedule — see
         quest_tpu.parallel.sharded)."""
+        self._reject_measure("compiled_sharded")
         from quest_tpu.parallel import sharded as S
         key = ("sharded", n, density, id(mesh), int(mesh.devices.size),
                donate, precision.matmul_precision())
@@ -595,6 +728,7 @@ class Circuit:
                                 donate: bool = True):
         """Band-fusion engine over the device mesh (one shard_map program;
         see quest_tpu.parallel.sharded.compile_circuit_sharded_banded)."""
+        self._reject_measure("compiled_sharded_banded")
         from quest_tpu.parallel import sharded as S
         key = ("sharded-banded", n, density, id(mesh),
                int(mesh.devices.size), donate,
@@ -613,6 +747,7 @@ class Circuit:
         mega-kernel segments between explicit ppermute exchanges; see
         quest_tpu.parallel.sharded.compile_circuit_sharded_fused)."""
         from quest_tpu.parallel import sharded as S
+        self._reject_measure("compiled_sharded_fused")
         key = ("sharded-fused", n, density, id(mesh),
                int(mesh.devices.size), donate, interpret,
                precision.matmul_precision())

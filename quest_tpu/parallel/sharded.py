@@ -462,6 +462,7 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     D = int(mesh.devices.size)
     g = int(math.log2(D))
     local_n = n - g
+    _reject_measure_ops(ops)
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     flat = flatten_ops(ops, n, density)
@@ -511,6 +512,7 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
     D = int(mesh.devices.size)
     g = int(math.log2(D))
     local_n = n - g
+    _reject_measure_ops(ops)
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     if not PB.usable(local_n):
@@ -584,6 +586,19 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def _reject_measure_ops(ops):
+    """Mid-circuit measurement needs psum'd probabilities and key
+    threading the explicit schedules don't carry; one shared rejection
+    for all three sharded compilers."""
+    if any(op.kind in ("measure", "measure_dm") for op in ops):
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid operation: mid-circuit measurement is not supported "
+            "on the explicit sharded engines; use Circuit.apply_measured "
+            "on one chip, or the eager measurement API (which distributes "
+            "via GSPMD) between sharded circuit steps.")
+
+
 def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
                             donate: bool = True, lazy: bool = False):
     """Compile a gate sequence into ONE shard_map program over the mesh —
@@ -598,6 +613,7 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
     D = int(mesh.devices.size)
     g = int(math.log2(D))
     local_n = n - g
+    _reject_measure_ops(ops)
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     if not density and any(op.kind == "superop" for op in ops):

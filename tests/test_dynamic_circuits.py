@@ -1,0 +1,154 @@
+"""Mid-circuit measurement in traced circuits (Circuit.measure).
+
+The reference can only measure eagerly between kernel launches; here the
+whole dynamic circuit — gates, outcome draws, branchless collapses — is
+one compiled program taking a PRNG key and returning the outcome
+sequence. Checks: physics (Bell correlations, collapse renormalization,
+repeat-measurement consistency), engine equivalence, density registers,
+determinism per key, and the guard rails on the static-only entry points.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuit import Circuit, random_circuit
+from quest_tpu.state import to_dense
+from quest_tpu.validation import QuESTError
+
+
+def test_bell_outcomes_correlate():
+    """Measure both halves of a Bell pair: outcomes random but EQUAL."""
+    c = Circuit(2).h(0).cnot(0, 1).measure(0).measure(1)
+    seen = set()
+    for s in range(40):
+        q, outs = c.apply_measured(qt.create_qureg(2), jax.random.PRNGKey(s))
+        outs = np.asarray(outs)
+        assert outs[0] == outs[1]
+        seen.add(int(outs[0]))
+    assert seen == {0, 1}, "both outcomes should occur over 40 keys"
+
+
+def test_repeat_measurement_is_consistent():
+    """Measuring the same qubit twice gives the same outcome (collapse)."""
+    c = Circuit(1).h(0).measure(0).measure(0)
+    for s in range(20):
+        _, outs = c.apply_measured(qt.create_qureg(1), jax.random.PRNGKey(s))
+        outs = np.asarray(outs)
+        assert outs[0] == outs[1]
+
+
+def test_post_measurement_state_is_collapsed_and_normalized():
+    c = Circuit(3).h(0).h(1).h(2).measure(1)
+    q, outs = c.apply_measured(qt.create_qureg(3), jax.random.PRNGKey(4))
+    v = to_dense(q)
+    assert abs(np.vdot(v, v) - 1.0) < 1e-6
+    oc = int(np.asarray(outs)[0])
+    k = np.arange(8)
+    dead = np.abs(v[((k >> 1) & 1) != oc])
+    assert np.max(dead) < 1e-7, "amplitudes of the other branch must vanish"
+
+
+def test_engines_agree_per_key():
+    """banded and xla dynamic engines draw identical trajectories from
+    the same key (same split sequence, same collapse)."""
+    c = random_circuit(5, depth=2, seed=3)
+    c.measure(2)
+    for op in random_circuit(5, depth=1, seed=4).ops:
+        c.ops.append(op)
+    c.measure(0).measure(4)
+    key = jax.random.PRNGKey(11)
+    q1, o1 = c.apply_measured(qt.create_qureg(5), key, engine="banded")
+    q2, o2 = c.apply_measured(qt.create_qureg(5), key, engine="xla")
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_allclose(to_dense(q1), to_dense(q2), atol=1e-6)
+
+
+def test_density_register_measurement():
+    """Mid-circuit measurement on a density register: outcome stats from
+    the diagonal, both-space collapse, trace renormalized."""
+    from quest_tpu import calculations as calc
+
+    c = Circuit(2).h(0).cnot(0, 1).dephasing(0, 0.25).measure(0).measure(1)
+    ones = 0
+    for s in range(30):
+        q, outs = c.apply_measured(qt.create_density_qureg(2),
+                                   jax.random.PRNGKey(s))
+        outs = np.asarray(outs)
+        assert outs[0] == outs[1]          # dephasing keeps ZZ correlation
+        ones += int(outs[0])
+        assert abs(calc.calc_total_prob(q) - 1.0) < 1e-5
+    assert 5 < ones < 25                   # both outcomes occur
+
+
+def test_outcome_statistics_match_born_rule():
+    theta = 0.8
+    c = Circuit(1).ry(0, theta).measure(0)
+    fn = c.compiled_measured(1, False, donate=False)
+    keys = jax.random.split(jax.random.PRNGKey(0), 600)
+    outs = np.array([int(np.asarray(fn(qt.create_qureg(1).amps, k)[1])[0])
+                     for k in keys])
+    p1 = np.sin(theta / 2) ** 2
+    assert abs(outs.mean() - p1) < 0.06
+
+
+def test_static_entry_points_reject_measurement():
+    c = Circuit(2).h(0).measure(0)
+    q = qt.create_qureg(2)
+    with pytest.raises(QuESTError, match="apply_measured"):
+        c.apply(q)
+    with pytest.raises(QuESTError, match="apply_measured"):
+        c.compiled_banded(2, False)
+    with pytest.raises(QuESTError, match="no inverse"):
+        c.inverse()
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.sharded import compile_circuit_sharded
+    with pytest.raises(QuESTError, match="sharded"):
+        compile_circuit_sharded(c.ops, 2, False, make_amp_mesh(2))
+
+
+def test_measure_records_qasm():
+    qasm = Circuit(2).h(0).measure(0).to_qasm()
+    assert "measure q[0]" in qasm
+
+
+def test_fusion_does_not_reorder_across_measurement():
+    """An H before and after measuring the same qubit must NOT compose
+    (measurement is a barrier on its qubit): |0> -H-M-H- gives p(1)=1/2,
+    a composed H·H=I would give p(1)=0."""
+    c = Circuit(1).h(0).measure(0).h(0).measure(0)
+    outs = []
+    for s in range(60):
+        _, o = c.apply_measured(qt.create_qureg(1), jax.random.PRNGKey(s),
+                                engine="banded")
+        outs.append(int(np.asarray(o)[1]))
+    frac = np.mean(outs)
+    assert 0.25 < frac < 0.75, f"H fused across measurement? p(1)={frac}"
+
+
+def test_density_dual_does_not_cross_measurement():
+    """Regression (round-3 review): on a density register the fusion
+    planner must not commute a post-measurement gate's COLUMN-SPACE dual
+    (qubit q+N, a different band for N>=7) back across the collapse.
+    |0><0| -H-M-H-M-: the second outcome must be 50/50 and the banded
+    trajectory must equal the per-gate engine's for every key."""
+    n = 7
+    c = Circuit(n).h(0).measure(0).h(0).measure(0)
+    seconds = []
+    for s in range(40):
+        key = jax.random.PRNGKey(s)
+        q1, o1 = c.apply_measured(qt.create_density_qureg(n), key,
+                                  engine="banded")
+        q2, o2 = c.apply_measured(qt.create_density_qureg(n), key,
+                                  engine="xla")
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_allclose(to_dense(q1), to_dense(q2), atol=1e-6)
+        seconds.append(int(np.asarray(o1)[1]))
+    frac = np.mean(seconds)
+    assert 0.2 < frac < 0.8, f"second outcome biased: p(1)={frac}"
+
+
+def test_compiled_measured_requires_measurement():
+    with pytest.raises(QuESTError, match="at least one"):
+        Circuit(1).h(0).compiled_measured(1, False)
