@@ -41,7 +41,7 @@ def dual_of(op: GateOp, shift: int):
     expansion, and anything else that flattens density circuits.
     Superoperators already act on both spaces: no dual (returns None);
     measurements handle the density register directly (no dual)."""
-    if op.kind in ("superop", "measure", "measure_dm"):
+    if op.kind in ("superop", "measure", "measure_dm", "classical"):
         return None
     if op.kind == "parity":
         return dataclasses.replace(
@@ -124,6 +124,23 @@ def flatten_ops(ops, n: int, density: bool) -> List[GateOp]:
                 q0 = op.targets[0]
                 flat.append(dataclasses.replace(
                     op, kind="measure_dm", targets=(q0, q0 + n // 2)))
+            else:
+                flat.append(op)
+            continue
+        if op.kind == "classical":
+            inners, conds = op.operand
+            if density:
+                expanded, claim = [], []
+                for g in inners:
+                    expanded.append(g)
+                    claim += list(g.targets) + list(g.controls)
+                    d = dual_of(g, n // 2)
+                    if d is not None:
+                        expanded.append(d)
+                        claim += list(d.targets) + list(d.controls)
+                flat.append(dataclasses.replace(
+                    op, targets=tuple(dict.fromkeys(claim)),
+                    operand=(tuple(expanded), conds)))
             else:
                 flat.append(op)
             continue
@@ -282,11 +299,56 @@ class Circuit:
         dynamic circuit stays ONE compiled program."""
         return self._add("measure", (int(qubit),), None)
 
+    def gate_if(self, matrix, targets, when, controls=(), cstates=None):
+        """CLASSICALLY-CONTROLLED gate: apply `matrix` only when earlier
+        mid-circuit measurement outcomes match `when` — a (measurement
+        index, wanted bit) pair or a sequence of them (indices count
+        measure() calls in program order). The condition is a traced
+        predicate (branchless where-blend), so feedback stays inside the
+        ONE compiled program — the reference must round-trip to the host
+        for any feed-forward. Enables teleportation-class dynamic
+        circuits (examples/teleportation.py)."""
+        when = tuple(when)
+        if when and all(hasattr(w, "__len__") for w in when):
+            when = tuple(tuple(w) for w in when)
+        else:
+            when = (when,)
+        if not all(len(w) == 2 for w in when) or not when:
+            raise ValueError(
+                "gate_if condition must be a (measurement index, wanted "
+                "bit) pair or a non-empty sequence of such pairs")
+        n_meas = self._measure_count()
+        for idx, want in when:
+            if not (0 <= int(idx) < n_meas):
+                raise ValueError(
+                    f"gate_if condition references measurement {idx}, but "
+                    f"only {n_meas} measure() calls precede it")
+            if int(want) not in (0, 1):
+                raise ValueError("wanted outcome must be 0 or 1")
+        inner = GateOp("matrix", tuple(int(t) for t in targets),
+                       tuple(int(c) for c in controls),
+                       tuple(cstates) if cstates is not None
+                       else (1,) * len(controls),
+                       np.asarray(matrix, dtype=np.complex128))
+        return self._add(
+            "classical", inner.targets + inner.controls,
+            ((inner,), tuple((int(i), int(w)) for i, w in when)))
+
+    def x_if(self, target, when):
+        return self.gate_if(M.PAULI_X, (target,), when)
+
+    def z_if(self, target, when):
+        return self.gate_if(M.PAULI_Z, (target,), when)
+
     def _measure_count(self) -> int:
         return sum(1 for op in self.ops if op.kind == "measure")
 
+    def _dynamic_count(self) -> int:
+        return sum(1 for op in self.ops
+                   if op.kind in ("measure", "classical"))
+
     def _reject_measure(self, what: str):
-        if self._measure_count():
+        if self._dynamic_count():
             from quest_tpu.validation import QuESTError
             raise QuESTError(
                 f"Invalid operation: this circuit contains mid-circuit "
@@ -397,6 +459,19 @@ class Circuit:
                 density=op.kind == "measure_dm")
             return amps, key, outcome.astype(jnp.int32)
 
+        def classical_item(amps, outs, op):
+            # feed-forward: branchless where-blend under a traced
+            # predicate over earlier outcomes
+            inners, conds = op.operand
+            pred = None
+            for idx, want in conds:
+                p = outs[idx] == want
+                pred = p if pred is None else pred & p
+            new = amps
+            for g in inners:
+                new = _apply_one(new, n, g)
+            return jnp.where(pred, new, amps)
+
         if engine == "banded":
             from quest_tpu.ops import fusion as F
             items = F.plan(flat, n)
@@ -412,6 +487,8 @@ class Circuit:
                     elif it.op.kind in ("measure", "measure_dm"):
                         amps, key, oc = measure_item(amps, key, it.op)
                         outs.append(oc)
+                    elif it.op.kind == "classical":
+                        amps = classical_item(amps, outs, it.op)
                     else:
                         amps = _apply_op(amps, n, False, it.op)
                 return amps, jnp.stack(outs)
@@ -422,6 +499,8 @@ class Circuit:
                     if op.kind in ("measure", "measure_dm"):
                         amps, key, oc = measure_item(amps, key, op)
                         outs.append(oc)
+                    elif op.kind == "classical":
+                        amps = classical_item(amps, outs, op)
                     else:
                         amps = _apply_one(amps, n, op)
                 return amps, jnp.stack(outs)
@@ -457,12 +536,14 @@ class Circuit:
         uncomputation patterns like QPE's inverse QFT."""
         inv = Circuit(self.num_qubits)
         for op in reversed(self.ops):
-            if op.kind in ("superop", "measure"):
+            if op.kind in ("superop", "measure", "classical"):
                 from quest_tpu.validation import QuESTError
+                what = {"superop": "noise channels",
+                        "measure": "measurements",
+                        "classical": "classically-controlled gates"}
                 raise QuESTError(
-                    "Invalid operation: a circuit containing "
-                    + ("measurements" if op.kind == "measure" else
-                       "noise channels") + " has no inverse.")
+                    f"Invalid operation: a circuit containing "
+                    f"{what[op.kind]} has no inverse.")
             if op.kind == "matrix":
                 operand = np.asarray(op.operand).conj().T
             elif op.kind in ("diagonal", "allones"):
@@ -490,6 +571,11 @@ class Circuit:
             cstates = op.cstates or (1,) * len(controls)
             if op.kind == "measure":
                 log.record_measurement(targets[0])
+                continue
+            if op.kind == "classical":
+                log.record_comment(
+                    "Here a classically-controlled gate was applied "
+                    f"(conditions on measurements {list(op.operand[1])})")
                 continue
             if op.kind == "parity":
                 if len(targets) == 1 and not controls:

@@ -208,6 +208,14 @@ def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List
         controls = tuple(op.controls)
         cstates = tuple(op.cstates) if op.cstates else (1,) * len(controls)
 
+        if op.kind in ("measure", "measure_dm", "classical"):
+            # dynamic-circuit items: opaque to fusion (a measurement or a
+            # classically-conditioned gate commutes only with ops on
+            # disjoint qubits; targets already claim density duals)
+            items.append(PassOp(op, frozenset(targets),
+                                frozenset(targets) | frozenset(controls)))
+            continue
+
         if op.kind in ("parity", "allones"):
             # single-band phase ops fold into the band operator as diagonal
             # embeddings (an rz or a neighbour CZ costs nothing once the

@@ -590,7 +590,7 @@ def _reject_measure_ops(ops):
     """Mid-circuit measurement needs psum'd probabilities and key
     threading the explicit schedules don't carry; one shared rejection
     for all three sharded compilers."""
-    if any(op.kind in ("measure", "measure_dm") for op in ops):
+    if any(op.kind in ("measure", "measure_dm", "classical") for op in ops):
         from quest_tpu.validation import QuESTError
         raise QuESTError(
             "Invalid operation: mid-circuit measurement is not supported "

@@ -152,3 +152,53 @@ def test_density_dual_does_not_cross_measurement():
 def test_compiled_measured_requires_measurement():
     with pytest.raises(QuESTError, match="at least one"):
         Circuit(1).h(0).compiled_measured(1, False)
+
+
+def test_classical_feedback_teleportation():
+    """Feed-forward corrections recover the exact input state on every
+    outcome branch (the scaled copy of examples/teleportation.py)."""
+    from examples.teleportation import teleport_circuit, THETA, PHI
+
+    want = np.array([np.cos(THETA / 2),
+                     np.sin(THETA / 2) * np.exp(1j * PHI)])
+    c = teleport_circuit()
+    branches = set()
+    for s in range(16):
+        q, outs = c.apply_measured(
+            qt.create_qureg(3, dtype=np.complex128), jax.random.PRNGKey(s))
+        o = tuple(int(x) for x in np.asarray(outs))
+        branches.add(o)
+        v = to_dense(q).reshape(2, 2, 2)
+        bob = v[:, o[1], o[0]]
+        assert abs(np.vdot(want, bob)) ** 2 > 1 - 1e-12, o
+    assert len(branches) >= 3
+
+
+def test_gate_if_validates_conditions():
+    c = Circuit(2).h(0)
+    with pytest.raises(ValueError, match="measurement"):
+        c.x_if(1, (0, 1))              # no measurement recorded yet
+    c.measure(0)
+    with pytest.raises(ValueError, match="0 or 1"):
+        c.x_if(1, (0, 2))
+    c.x_if(1, (0, 1))                  # now legal
+
+
+def test_classical_on_density_register():
+    """Feedback applies BOTH the gate and its column-space dual under the
+    predicate: teleportation on a density register gives Tr(rho_bob
+    |want><want|) = 1 on every branch."""
+    from examples.teleportation import teleport_circuit, THETA, PHI
+
+    want = np.array([np.cos(THETA / 2),
+                     np.sin(THETA / 2) * np.exp(1j * PHI)])
+    c = teleport_circuit()
+    for s in range(8):
+        q, outs = c.apply_measured(
+            qt.create_density_qureg(3, dtype=np.complex128),
+            jax.random.PRNGKey(s))
+        o = tuple(int(x) for x in np.asarray(outs))
+        rho = to_dense(q).reshape(2, 2, 2, 2, 2, 2)   # [r2,r1,r0, c2,c1,c0]
+        rho_bob = rho[:, o[1], o[0], :, o[1], o[0]]
+        fid = np.real(want.conj() @ rho_bob @ want)
+        assert fid > 1 - 1e-12, (o, fid)
