@@ -318,3 +318,23 @@ def test_kernel_bandwidth_floor():
     # reference serial CPU measured 150.6e6 amps/sec on this host
     # (benchmarks/reference_baseline.json) -> 35.9 gates/s @ 22q
     assert gates_per_sec > 359, f"only {gates_per_sec:.0f} gates/s @ {n}q"
+
+
+def test_dynamic_circuit_on_chip():
+    """Mid-circuit measurement + classical feedback compiled for the
+    real chip: teleportation at fidelity 1 on whatever branch is drawn."""
+    from examples.teleportation import teleport_circuit, THETA, PHI
+
+    import quest_tpu as qt
+    from quest_tpu.state import to_dense
+
+    want = np.array([np.cos(THETA / 2),
+                     np.sin(THETA / 2) * np.exp(1j * PHI)])
+    c = teleport_circuit()
+    import jax as _jax
+    q, outs = c.apply_measured(qt.create_qureg(3), _jax.random.PRNGKey(5))
+    o = tuple(int(x) for x in np.asarray(outs))
+    v = to_dense(q).reshape(2, 2, 2)
+    bob = v[:, o[1], o[0]]
+    fid = abs(np.vdot(want, bob)) ** 2
+    assert fid > 1 - 1e-5, (o, fid)
