@@ -337,6 +337,14 @@ class Circuit:
     def x_if(self, target, when):
         return self.gate_if(M.PAULI_X, (target,), when)
 
+    def reset(self, qubit):
+        """Reset `qubit` to |0> mid-circuit: measure it and flip on
+        outcome 1 (the standard dynamic-circuit reset; destroys this
+        qubit's coherences, preserves the rest of the register). The
+        measurement outcome still appears in the returned sequence."""
+        self.measure(qubit)
+        return self.x_if(qubit, (self._measure_count() - 1, 1))
+
     def z_if(self, target, when):
         return self.gate_if(M.PAULI_Z, (target,), when)
 

@@ -202,3 +202,17 @@ def test_classical_on_density_register():
         rho_bob = rho[:, o[1], o[0], :, o[1], o[0]]
         fid = np.real(want.conj() @ rho_bob @ want)
         assert fid > 1 - 1e-12, (o, fid)
+
+
+def test_reset_returns_qubit_to_zero():
+    """reset(q) leaves q in |0> on every trajectory and preserves the
+    other qubits' populations (coherence with q is destroyed)."""
+    c = Circuit(2).h(0).h(1).reset(0)
+    for s in range(12):
+        q, _ = c.apply_measured(qt.create_qureg(2), jax.random.PRNGKey(s))
+        v = to_dense(q).reshape(2, 2)     # [q1, q0]
+        # q0 amplitude mass entirely in the 0 column
+        assert np.sum(np.abs(v[:, 1]) ** 2) < 1e-10
+        # q1 still in |+>: equal populations
+        pops = np.abs(v[:, 0]) ** 2
+        np.testing.assert_allclose(pops, [0.5, 0.5], atol=1e-6)
