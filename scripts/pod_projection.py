@@ -33,14 +33,16 @@ import json, re, sys, time
 import numpy as np, jax.numpy as jnp
 sys.path.insert(0, %(repo)r)
 from jax.sharding import Mesh
-from quest_tpu.circuit import flatten_ops, random_circuit
+from quest_tpu.circuit import flatten_ops, qft_circuit, random_circuit
 from quest_tpu.env import AMP_AXIS
 from quest_tpu.ops import fusion as F
 from quest_tpu.parallel.sharded import (_shard_bands,
                                         compile_circuit_sharded_banded)
 
 n, depth, D = %(n)d, %(depth)d, %(D)d
-c = random_circuit(n, depth=depth, seed=7, entangler="cz")
+circuit_kind = %(circuit)r
+c = (qft_circuit(n) if circuit_kind == "qft"
+     else random_circuit(n, depth=depth, seed=7, entangler="cz"))
 devs = jax.devices()
 assert len(devs) == D
 mesh = Mesh(np.array(devs), (AMP_AXIS,))
@@ -86,6 +88,7 @@ print(json.dumps({
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--circuit", choices=("rcs", "qft"), default="rcs")
     ap.add_argument("--n", type=int, default=40)
     ap.add_argument("--depth", type=int, default=20)
     ap.add_argument("--devices", type=int, default=256)
@@ -101,7 +104,7 @@ def main():
     env["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={args.devices}")
     code = WORKER % {"repo": REPO, "n": args.n, "depth": args.depth,
-                     "D": args.devices}
+                     "D": args.devices, "circuit": args.circuit}
     r = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, timeout=900)
     if r.returncode != 0:
@@ -118,6 +121,7 @@ def main():
     t_hbm = hbm_gb / args.hbm
     t_ici = ici_gb / args.ici
     rec.update({
+        "circuit": args.circuit,
         "n": args.n, "depth": args.depth, "devices": args.devices,
         "chunk_gb": round(chunk_gb, 2),
         "assumed_hbm_gbps": args.hbm, "assumed_ici_gbps": args.ici,
