@@ -50,6 +50,21 @@ from quest_tpu.validation import QuESTError
 from quest_tpu.ops import gates
 from quest_tpu import calculations
 from quest_tpu import measurement
+from quest_tpu.calculations import (
+    calc_expec_pauli_prod,
+    calc_expec_pauli_sum,
+    calc_fidelity,
+    calc_inner_product,
+    calc_purity,
+    calc_total_prob,
+)
+from quest_tpu.measurement import (
+    calc_prob_of_outcome,
+    collapse_to_outcome,
+    measure,
+    measure_with_stats,
+    sample,
+)
 from quest_tpu.circuit import Circuit
 from quest_tpu import qasm
 from quest_tpu import api
