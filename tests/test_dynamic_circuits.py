@@ -216,3 +216,22 @@ def test_reset_returns_qubit_to_zero():
         # q1 still in |+>: equal populations
         pops = np.abs(v[:, 0]) ** 2
         np.testing.assert_allclose(pops, [0.5, 0.5], atol=1e-6)
+
+
+def test_vmapped_dynamic_trajectories():
+    """compiled_measured vmaps over keys: batched noisy/dynamic shots as
+    ONE program (the trajectory pattern extended to feedback circuits)."""
+    c = Circuit(2).h(0).cnot(0, 1).measure(0).x_if(1, (0, 1))
+    fn = c.compiled_measured(2, False, donate=False)
+    amps0 = qt.create_qureg(2).amps
+    keys = jax.random.split(jax.random.PRNGKey(0), 64)
+    states, outs = jax.vmap(lambda k: fn(amps0, k))(keys)
+    outs = np.asarray(outs)[:, 0]
+    assert states.shape == (64, 2, 4)
+    assert 10 < outs.sum() < 54            # both outcomes occur
+    # after the feedback X, qubit 1 is ALWAYS |0...>: the Bell pair's
+    # correlated qubit got flipped back on the 1-branch
+    final = np.asarray(states)
+    for i in range(64):
+        v = (final[i, 0] + 1j * final[i, 1]).reshape(2, 2)  # [q1, q0]
+        assert np.sum(np.abs(v[1, :]) ** 2) < 1e-10, i
