@@ -222,7 +222,7 @@ def _sample_sharded_body(amps, key, *, n, density, num_shots, D):
     return jax.lax.psum(jnp.where(mine, glob, 0), AMP_AXIS)
 
 
-def sample(q: Qureg, num_shots: int, key) -> jax.Array:
+def sample(q: Qureg, num_shots: int, key=None) -> jax.Array:
     """Draw `num_shots` full-register computational-basis samples WITHOUT
     collapsing the state — one device-side categorical draw over the
     probability distribution. The reference can only sample by repeated
@@ -233,6 +233,10 @@ def sample(q: Qureg, num_shots: int, key) -> jax.Array:
     indices."""
     if num_shots < 1:
         raise val.QuESTError("Invalid number of shots: must be positive.")
+    if key is None:
+        # derive from the seeded host stream, so seedQuEST makes the whole
+        # program — including sampling — reproducible like the reference
+        key = jax.random.PRNGKey(int(rng.uniform() * (1 << 31)))
     sh = getattr(q.amps, "sharding", None)
     mesh = getattr(sh, "mesh", None)
     if mesh is not None and mesh.devices.size > 1:

@@ -138,3 +138,20 @@ def test_engine_peak_temp_bounded(engine):
     # measured 2.5x (banded) / 3x (pergate) state; the round-1 failure
     # mode held tens of full-state temps simultaneously
     assert got <= 5 * state, (got, state)
+
+
+def test_sample_without_key_is_seed_reproducible():
+    """sample(q, shots) with no key draws its seed from the seeded host
+    stream: seedQuEST makes sampling reproducible like the reference."""
+    import quest_tpu as qt
+    from quest_tpu import api as Q
+
+    q = qt.init_plus_state(qt.create_qureg(4))
+    Q.seedQuEST([123])
+    a = np.asarray(qt.sample(q, 32))
+    Q.seedQuEST([123])
+    b = np.asarray(qt.sample(q, 32))
+    np.testing.assert_array_equal(a, b)
+    Q.seedQuEST([124])
+    c = np.asarray(qt.sample(q, 32))
+    assert not np.array_equal(a, c)
