@@ -113,7 +113,11 @@ def measure(q: Qureg, qubit: int) -> Tuple[Qureg, int]:
 @partial(jax.jit, static_argnames=("n", "qubit", "density"))
 def _measure_traced(amps, key, *, n, qubit, density):
     p0 = _prob_of_zero(amps, n=n, qubit=qubit, density=density)
-    eps = jnp.asarray(precision.real_eps(jnp.float32), dtype=p0.dtype)
+    # degenerate-branch threshold at the REGISTER's precision (1e-5 f32 /
+    # 1e-13 f64, like the host path and the reference's REAL_EPS guard,
+    # QuEST_common.c:154-169) — the old hardcoded f32 eps would force the
+    # outcome of a legitimate p=1e-6 branch on an f64 register
+    eps = jnp.asarray(precision.real_eps(amps.dtype), dtype=p0.dtype)
     u = jax.random.uniform(key, dtype=p0.dtype)
     # force the outcome when one branch has (numerically) zero probability,
     # like the host path (ref generateMeasurementOutcome, QuEST_common.c:154)

@@ -235,3 +235,22 @@ def test_vmapped_dynamic_trajectories():
     for i in range(64):
         v = (final[i, 0] + 1j * final[i, 1]).reshape(2, 2)  # [q1, q0]
         assert np.sum(np.abs(v[1, :]) ** 2) < 1e-10, i
+
+
+def test_small_branch_probability_not_forced_at_f64():
+    """An f64 register measuring a branch with p=1e-6 must actually DRAW
+    (the f32 eps would have forced outcome 1 every time): over many keys
+    the rare branch appears at roughly its Born rate."""
+    theta = 2 * np.arcsin(np.sqrt(1e-2))   # p(1) = 1e-2, p(0) = 0.99
+    c = Circuit(1).ry(0, theta).measure(0)
+    fn = c.compiled_measured(1, False, donate=False)
+    amps0 = qt.create_qureg(1, dtype=np.complex128).amps
+    keys = jax.random.split(jax.random.PRNGKey(0), 2000)
+    _, outs = jax.vmap(lambda k: fn(amps0, k))(keys)
+    rate = float(np.asarray(outs)[:, 0].mean())
+    assert 0.004 < rate < 0.02, rate
+    # and a branch BELOW the f64 eps genuinely forces, like the host path
+    c2 = Circuit(1).measure(0)             # p(1) = 0 exactly
+    _, o = c2.apply_measured(qt.create_qureg(1, dtype=np.complex128),
+                             jax.random.PRNGKey(1))
+    assert int(np.asarray(o)[0]) == 0
