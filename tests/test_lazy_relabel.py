@@ -25,7 +25,7 @@ DTYPE = np.complex128
 
 @pytest.fixture(scope="module")
 def mesh():
-    return make_amp_mesh(8)
+    return make_amp_mesh(min(8, 1 << (len(__import__("jax").devices()).bit_length() - 1)))
 
 
 def _deep_global_circuit(n, depth):
