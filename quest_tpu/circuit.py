@@ -874,6 +874,36 @@ class Circuit:
         amps = jax.device_put(q.amps, MM.amp_sharding(mesh))
         return q.replace_amps(fn(amps))
 
+    def compiled_sharded_measured(self, n: int, density: bool, mesh,
+                                  donate: bool = True):
+        """Cached compile of the dynamic sharded program (see
+        quest_tpu.parallel.sharded.compile_circuit_sharded_measured)."""
+        from quest_tpu.parallel import sharded as S
+        key_ = ("sharded-measured", n, density, id(mesh),
+                int(mesh.devices.size), donate,
+                precision.matmul_precision())
+        fn = self._compiled.get(key_)
+        if fn is None:
+            fn = S.compile_circuit_sharded_measured(self.ops, n, density,
+                                                    mesh, donate)
+            self._compiled[key_] = fn
+        return fn
+
+    def apply_sharded_measured(self, q: Qureg, key, mesh,
+                               donate: bool = False):
+        """Dynamic circuit over the device mesh: (register, outcomes).
+        Mid-circuit measurement (psum probabilities, identical draws on
+        every device) and classical feedback inside ONE shard_map
+        program."""
+        from quest_tpu.parallel.mesh import amp_sharding
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        fn = self.compiled_sharded_measured(q.num_state_qubits,
+                                            q.is_density, mesh, donate)
+        amps = jax.device_put(q.amps, amp_sharding(mesh))
+        amps, outcomes = fn(amps, key)
+        return q.replace_amps(amps), outcomes
+
     def apply_sharded(self, q: Qureg, mesh, donate: bool = False) -> Qureg:
         """Apply via the explicit shard_map engine on a mesh-sharded register."""
         if self.num_qubits != q.num_qubits:

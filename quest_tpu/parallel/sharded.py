@@ -587,16 +587,16 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
 
 
 def _reject_measure_ops(ops):
-    """Mid-circuit measurement needs psum'd probabilities and key
-    threading the explicit schedules don't carry; one shared rejection
-    for all three sharded compilers."""
+    """The static sharded schedules don't thread keys/outcomes; dynamic
+    circuits have their own compiler. One shared rejection for the three
+    static sharded compilers."""
     if any(op.kind in ("measure", "measure_dm", "classical") for op in ops):
         from quest_tpu.validation import QuESTError
         raise QuESTError(
-            "Invalid operation: mid-circuit measurement is not supported "
-            "on the explicit sharded engines; use Circuit.apply_measured "
-            "on one chip, or the eager measurement API (which distributes "
-            "via GSPMD) between sharded circuit steps.")
+            "Invalid operation: this circuit contains mid-circuit "
+            "measurements; use compile_circuit_sharded_measured (or "
+            "Circuit.apply_sharded_measured) for dynamic circuits on the "
+            "mesh.")
 
 
 def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
@@ -640,6 +640,141 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
 
     sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
                             out_specs=P(None, AMP_AXIS))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def _measure_op_sharded(chunk, dev, key, *, D, local_n, qubit, density,
+                        eps):
+    """Mid-circuit measurement inside the shard_map schedule: local
+    partial probability + psum (the reference's MPI_Allreduce,
+    QuEST_cpu_distributed.c:1263-1277), identical outcome draw on every
+    device (same key), local branchless collapse — including GLOBAL
+    qubits, where a device's whole chunk lives on one side of the
+    butterfly and either renormalizes or zeroes."""
+    n = local_n + int(math.log2(D))
+    if density:
+        # diagonal probability: rho[k,k] with bit `qubit` of k == 0.
+        # col bits are the TOP half; this shard holds cols [c0, c0+cols)
+        dim = 1 << (n // 2)
+        cols_local = chunk.shape[1] // dim
+        c0 = dev * cols_local
+        mat = chunk[0].reshape(cols_local, dim)
+        idx = c0 + jnp.arange(cols_local)
+        diag = jnp.take_along_axis(mat, idx[:, None], axis=1)[:, 0]
+        keep = ((idx >> qubit) & 1) == 0
+        p0 = lax.psum(jnp.sum(jnp.where(keep, diag, 0.0)), AMP_AXIS)
+    elif qubit < local_n:
+        pre, post = 1 << (local_n - 1 - qubit), 1 << qubit
+        re = chunk[0].reshape(pre, 2, post)[:, 0, :]
+        im = chunk[1].reshape(pre, 2, post)[:, 0, :]
+        p0 = lax.psum(jnp.sum(re * re + im * im), AMP_AXIS)
+    else:
+        mybit = (dev >> (qubit - local_n)) & 1
+        local = jnp.sum(chunk * chunk)
+        p0 = lax.psum(jnp.where(mybit == 0, local, 0.0), AMP_AXIS)
+
+    key, sub = jax.random.split(key)
+    u = jax.random.uniform(sub, dtype=p0.dtype)
+    outcome = jnp.where(p0 < eps, 1,
+                        jnp.where(1.0 - p0 < eps, 0,
+                                  (u > p0).astype(jnp.int32)))
+    prob = jnp.maximum(jnp.where(outcome == 0, p0, 1.0 - p0), eps)
+
+    rdt = chunk.dtype
+    if density:
+        nq = n // 2
+        qubits = tuple(sorted({qubit, qubit + nq}, reverse=True))
+        dims, axis_of = A.seg_view(local_n, tuple(q for q in qubits
+                                                  if q < local_n))
+        mask = None
+        for q in qubits:
+            if q < local_n:
+                m = A.bit_tensor(len(dims), axis_of[q]) == outcome
+            else:
+                m = ((dev >> (q - local_n)) & 1) == outcome
+            mask = m if mask is None else mask & m
+        factor = jnp.where(mask, 1.0 / prob, 0.0).astype(rdt)
+        new = jnp.stack([chunk[0].reshape(dims) * factor,
+                         chunk[1].reshape(dims) * factor])
+        return new.reshape(2, -1), key, outcome
+    if qubit < local_n:
+        dims, axis_of = A.seg_view(local_n, (qubit,))
+        keep = A.bit_tensor(len(dims), axis_of[qubit]) == outcome
+        factor = keep.astype(rdt) * lax.rsqrt(prob).astype(rdt)
+        new = jnp.stack([chunk[0].reshape(dims) * factor,
+                         chunk[1].reshape(dims) * factor])
+        return new.reshape(2, -1), key, outcome
+    mybit = (dev >> (qubit - local_n)) & 1
+    factor = jnp.where(mybit == outcome,
+                       lax.rsqrt(prob), 0.0).astype(rdt)
+    return chunk * factor, key, outcome
+
+
+def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
+                                     mesh: Mesh, donate: bool = True):
+    """DYNAMIC circuit over the mesh: one shard_map program taking
+    (sharded planes, key) and returning (planes, outcomes) — mid-circuit
+    measurement (psum'd probabilities, identical draws everywhere, local
+    collapse even for device-index qubits) and classical feedback, at
+    pod scale. The reference must host-round-trip AND MPI-broadcast per
+    measurement; here the entire dynamic program is one compiled
+    dispatch."""
+    from quest_tpu import precision as _prec
+    from quest_tpu.circuit import flatten_ops
+
+    D = int(mesh.devices.size)
+    g = int(math.log2(D))
+    local_n = n - g
+    if local_n < 1:
+        val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
+    if density and (1 << (n // 2)) < D:
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid operation: dynamic density circuits need at least "
+            "one density-matrix column per device (2^numQubits >= mesh "
+            "size) so each shard can read its diagonal slice; use fewer "
+            "devices or the static engine + eager measurement.")
+    flat = flatten_ops(ops, n, density)
+    n_meas = sum(1 for op in flat
+                 if op.kind in ("measure", "measure_dm"))
+    if not n_meas:
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid operation: compile_circuit_sharded_measured requires "
+            "at least one mid-circuit measurement; use "
+            "compile_circuit_sharded instead.")
+
+    def run(chunk, key):
+        chunk = chunk.reshape(2, -1)
+        dev = lax.axis_index(AMP_AXIS)
+        eps = jnp.asarray(_prec.real_eps(chunk.dtype), dtype=chunk.dtype)
+        outs = []
+        for op in flat:
+            if op.kind in ("measure", "measure_dm"):
+                chunk, key, oc = _measure_op_sharded(
+                    chunk, dev, key, D=D, local_n=local_n,
+                    qubit=op.targets[0], density=op.kind == "measure_dm",
+                    eps=eps)
+                outs.append(oc)
+            elif op.kind == "classical":
+                inners, conds = op.operand
+                pred = None
+                for idx, want in conds:
+                    p = outs[idx] == want
+                    pred = p if pred is None else pred & p
+                new = chunk
+                for gop in inners:
+                    new = _apply_gateop(new, dev, D=D, local_n=local_n,
+                                        density=False, op=gop)
+                chunk = jnp.where(pred, new, chunk)
+            else:
+                chunk = _apply_gateop(chunk, dev, D=D, local_n=local_n,
+                                      density=False, op=op)
+        return chunk, jnp.stack(outs)
+
+    sharded = jax.shard_map(run, mesh=mesh,
+                            in_specs=(P(None, AMP_AXIS), P()),
+                            out_specs=(P(None, AMP_AXIS), P()))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

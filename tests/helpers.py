@@ -44,3 +44,10 @@ def check_gate(op, matrix, targets, tol, controls=(), cstates=None, dtype=np.com
     want = oracle.apply_to_density(ref_m, N, matrix, targets, controls, cstates)
     np.testing.assert_allclose(out, want, atol=10 * tol, rtol=0,
                                err_msg=f"density targets={targets} controls={controls}")
+
+
+def max_mesh_devices(cap: int = 8) -> int:
+    """Largest power-of-two device count available, capped — THE one home
+    of the mesh-sizing idiom for tests (the CI 2-device job shrinks it)."""
+    import jax
+    return min(cap, 1 << (len(jax.devices()).bit_length() - 1))

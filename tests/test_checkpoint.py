@@ -10,6 +10,7 @@ from quest_tpu import checkpoint as ckpt
 from quest_tpu.state import init_state_from_amps, to_dense
 
 from . import oracle
+from .helpers import max_mesh_devices
 from .helpers import N
 
 
@@ -77,7 +78,7 @@ def test_async_sharded_checkpoint(tmp_path):
     from quest_tpu.state import to_dense
 
     from quest_tpu.parallel import make_amp_mesh
-    mesh = make_amp_mesh(min(8, 1 << (len(__import__("jax").devices()).bit_length() - 1)))
+    mesh = make_amp_mesh(max_mesh_devices())
     n = 6
     q = qt.init_debug_state(shard_qureg(qt.create_qureg(n), mesh))
     q = random_circuit(n, depth=2, seed=4).apply(q)

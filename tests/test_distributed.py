@@ -19,6 +19,7 @@ from quest_tpu.parallel import make_amp_mesh, shard_qureg
 from quest_tpu.state import to_dense
 
 from . import oracle
+from .helpers import max_mesh_devices
 
 N = 6          # statevector qubits; with D=8 the top 3 are global
 ND = 3         # density-matrix qubits (6 state qubits)
@@ -31,7 +32,7 @@ def mesh():
     # "same tests, more ranks": 8 virtual devices by default (conftest),
     # but the CI 2-device job re-runs this file with a smaller mesh
     import jax
-    return make_amp_mesh(min(8, 1 << (len(jax.devices()).bit_length() - 1)))
+    return make_amp_mesh(max_mesh_devices())
 
 
 def run_both(circ: Circuit, mesh, density=False):
@@ -342,9 +343,9 @@ def test_banded_sharded_plan_composes(mesh):
 # -- fused (Pallas) sharded engine: local mega-kernel segments between
 #    ppermute exchanges, run in the interpreter on the CPU mesh ------------
 
-import jax as _jax
+from .helpers import max_mesh_devices as _mmd
 
-_AVAIL = 1 << (len(_jax.devices()).bit_length() - 1)
+_AVAIL = _mmd(cap=1 << 30)
 # local_n = 10 on the default mesh: the smallest kernel-tiled chunk.
 # Adapts when the CI 2-device job shrinks the mesh (interpret-mode cost
 # scales with the per-device chunk, not the register).

@@ -16,6 +16,7 @@ import quest_tpu as qt
 from quest_tpu.circuit import Circuit, random_circuit
 from quest_tpu.state import to_dense
 from quest_tpu.validation import QuESTError
+from .helpers import max_mesh_devices
 
 
 def test_bell_outcomes_correlate():
@@ -254,3 +255,72 @@ def test_small_branch_probability_not_forced_at_f64():
     _, o = c2.apply_measured(qt.create_qureg(1, dtype=np.complex128),
                              jax.random.PRNGKey(1))
     assert int(np.asarray(o)[0]) == 0
+
+
+def test_sharded_dynamic_matches_single_device():
+    """The sharded dynamic engine draws the same trajectory as the
+    single-device engine for every key — local AND global measured
+    qubits, with feedback, on the virtual mesh."""
+    from quest_tpu.parallel import make_amp_mesh
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    n = 6
+    c = random_circuit(n, depth=2, seed=6)
+    c.measure(n - 1)                   # global qubit on the mesh
+    c.x_if(0, (0, 1))
+    c.measure(0)                       # local qubit
+    for op in random_circuit(n, depth=1, seed=8).ops:
+        c.ops.append(op)
+    c.measure(n - 2)
+    for s in range(10):
+        key = jax.random.PRNGKey(s)
+        q1 = qt.create_qureg(n, dtype=np.complex128)
+        q2 = qt.create_qureg(n, dtype=np.complex128)
+        r1, o1 = c.apply_measured(q1, key, engine="xla")
+        r2, o2 = c.apply_sharded_measured(q2, key, mesh)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_allclose(to_dense(r1), to_dense(r2),
+                                   atol=1e-11, rtol=0)
+
+
+def test_sharded_dynamic_density():
+    """Density-register dynamic circuit over the mesh: trajectory and
+    state match the single-device engine per key."""
+    from quest_tpu.parallel import make_amp_mesh
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    c = Circuit(3).h(0).cnot(0, 2).dephasing(1, 0.2).measure(2).x_if(
+        0, (0, 1)).measure(0)
+    for s in range(6):
+        key = jax.random.PRNGKey(100 + s)
+        r1, o1 = c.apply_measured(
+            qt.create_density_qureg(3, dtype=np.complex128), key,
+            engine="xla")
+        r2, o2 = c.apply_sharded_measured(
+            qt.create_density_qureg(3, dtype=np.complex128), key, mesh)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_allclose(to_dense(r1), to_dense(r2),
+                                   atol=1e-10, rtol=0)
+
+
+def test_static_sharded_rejection_points_to_dynamic_engine():
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.sharded import compile_circuit_sharded
+
+    c = Circuit(4).h(0).measure(0)
+    with pytest.raises(QuESTError, match="apply_sharded_measured"):
+        compile_circuit_sharded(c.ops, 4, False, make_amp_mesh(2))
+
+def test_sharded_dynamic_density_granularity_error():
+    """A density register with fewer columns than devices gets a clear
+    QuESTError from the dynamic compiler (the static engine supports the
+    size; the diagonal read does not)."""
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.sharded import compile_circuit_sharded_measured
+
+    if max_mesh_devices() < 8:
+        pytest.skip("needs the 8-device mesh")
+    mesh = make_amp_mesh(8)
+    c = Circuit(2).h(0).measure(0)     # 2^2 = 4 columns < 8 devices
+    with pytest.raises(QuESTError, match="column per device"):
+        compile_circuit_sharded_measured(c.ops, 4, True, mesh)

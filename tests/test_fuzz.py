@@ -18,6 +18,7 @@ from quest_tpu.circuit import Circuit
 from quest_tpu.state import to_dense
 
 from . import oracle
+from .helpers import max_mesh_devices
 
 N = 6
 ND = 3
@@ -164,7 +165,7 @@ def test_fuzz_sharded_engines(seed):
                                             compile_circuit_sharded_banded)
     from quest_tpu.state import init_state_from_amps
 
-    mesh = make_amp_mesh(min(8, 1 << (len(__import__("jax").devices()).bit_length() - 1)))
+    mesh = make_amp_mesh(max_mesh_devices())
     rng = np.random.default_rng(3000 + seed)
     c, ops = _random_circuit(rng, N, depth=10)
     v0 = oracle.random_statevector(N, rng)

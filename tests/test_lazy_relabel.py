@@ -18,6 +18,7 @@ from quest_tpu.parallel.relabel import lazy_relabel_ops
 from quest_tpu.parallel.sharded import (compile_circuit_sharded,
                                         compile_circuit_sharded_banded)
 from quest_tpu.state import to_dense
+from .helpers import max_mesh_devices
 
 N = 6
 DTYPE = np.complex128
@@ -25,7 +26,7 @@ DTYPE = np.complex128
 
 @pytest.fixture(scope="module")
 def mesh():
-    return make_amp_mesh(min(8, 1 << (len(__import__("jax").devices()).bit_length() - 1)))
+    return make_amp_mesh(max_mesh_devices())
 
 
 def _deep_global_circuit(n, depth):
