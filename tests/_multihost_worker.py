@@ -72,3 +72,24 @@ total = float(jax.device_get(total))
 assert abs(total - 1.0) < 1e-5, total
 
 print(f"proc {PROC}: shards ok, psum norm {total:.8f}", flush=True)
+
+# dynamic circuit across processes: mid-circuit measurement draws the
+# SAME outcome on every host (psum'd probability, shared key) and the
+# feedback correction applies consistently
+from quest_tpu.circuit import Circuit  # noqa: E402
+from quest_tpu.parallel.sharded import (  # noqa: E402
+    compile_circuit_sharded_measured)
+
+dc = Circuit(n).h(0).cnot(0, n - 1).measure(n - 1).x_if(0, (0, 1))
+dc.measure(0)
+step_d = compile_circuit_sharded_measured(dc.ops, n, False, mesh,
+                                          donate=False)
+amps_d = jax.make_array_from_callback((2, 1 << n), sharding,
+                                      lambda idx: base[idx])
+out_d, outcomes = step_d(amps_d, jax.random.PRNGKey(7))
+outcomes = np.asarray(jax.device_get(outcomes))
+# Bell pair: after X-correction on the 1-branch, qubit 0 is |0> -> the
+# second measurement must read 0 on EVERY host, deterministically
+assert outcomes[1] == 0, outcomes
+print(f"proc {PROC}: dynamic circuit outcomes {outcomes.tolist()}",
+      flush=True)

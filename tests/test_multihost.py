@@ -43,3 +43,9 @@ def test_two_process_global_mesh():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert "shards ok" in out, out[-1000:]
+        assert "dynamic circuit outcomes" in out, out[-1000:]
+    # both processes drew the SAME outcome sequence
+    import re
+    seqs = {re.search(r"dynamic circuit outcomes (\[.*?\])", o).group(1)
+            for o in outs}
+    assert len(seqs) == 1, seqs
