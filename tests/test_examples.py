@@ -60,3 +60,25 @@ def test_qpe_scaled():
     q = qpe_circuit(t, phi).apply(qt.create_qureg(t + 1))
     shots = np.asarray(meas.sample(q, 16, jax.random.PRNGKey(2)))
     assert np.all((shots & ((1 << t) - 1)) == 11)
+
+
+def test_bit_flip_code_corrects_single_flips():
+    """Every single-flip syndrome decodes back to the exact codeword
+    (scaled copy of examples/bit_flip_code.py: deterministic flips)."""
+    import jax
+
+    import quest_tpu as qt
+    from examples.bit_flip_code import noise_and_correct, qec_circuit, THETA
+    from quest_tpu.state import to_dense
+
+    want = np.array([np.cos(THETA / 2), np.sin(THETA / 2)])
+    ideal = np.zeros((2, 2, 2), dtype=complex)
+    ideal[0, 0, 0], ideal[1, 1, 1] = want[0], want[1]
+    for flip_q in (None, 0, 1, 2):
+        flips = [q == flip_q for q in range(3)]
+        c = noise_and_correct(qec_circuit(), flips)
+        q, outs = c.apply_measured(
+            qt.create_qureg(5, dtype=np.complex128), jax.random.PRNGKey(3))
+        v = to_dense(q).reshape(4, 2, 2, 2)
+        anc = int(np.asarray(outs)[0]) + 2 * int(np.asarray(outs)[1])
+        assert abs(np.vdot(ideal, v[anc])) ** 2 > 1 - 1e-10, flip_q
