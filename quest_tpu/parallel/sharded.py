@@ -711,14 +711,18 @@ def _measure_op_sharded(chunk, dev, key, *, D, local_n, qubit, density,
 
 
 def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
-                                     mesh: Mesh, donate: bool = True):
+                                     mesh: Mesh, donate: bool = True,
+                                     banded: bool = False):
     """DYNAMIC circuit over the mesh: one shard_map program taking
     (sharded planes, key) and returning (planes, outcomes) — mid-circuit
     measurement (psum'd probabilities, identical draws everywhere, local
     collapse even for device-index qubits) and classical feedback, at
     pod scale. The reference must host-round-trip AND MPI-broadcast per
     measurement; here the entire dynamic program is one compiled
-    dispatch."""
+    dispatch. banded=True runs the gate stream through the band-fusion
+    planner (measurements act as commutation barriers on their qubits),
+    so local stretches between measurements compose into MXU
+    contractions exactly like the static banded engine."""
     from quest_tpu import precision as _prec
     from quest_tpu.circuit import flatten_ops
 
@@ -744,12 +748,24 @@ def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
             "at least one mid-circuit measurement; use "
             "compile_circuit_sharded instead.")
 
+    if banded:
+        from quest_tpu.ops import fusion as F
+        items = F.plan(flat, n, bands=_shard_bands(n, local_n))
+    else:
+        items = flat
+
     def run(chunk, key):
+        from quest_tpu.ops import fusion as F
         chunk = chunk.reshape(2, -1)
         dev = lax.axis_index(AMP_AXIS)
         eps = jnp.asarray(_prec.real_eps(chunk.dtype), dtype=chunk.dtype)
         outs = []
-        for op in flat:
+        for it in items:
+            if banded and isinstance(it, F.BandOp):
+                chunk = _band_op_sharded(chunk, dev, D=D, local_n=local_n,
+                                         bop=it)
+                continue
+            op = it.op if banded else it
             if op.kind in ("measure", "measure_dm"):
                 chunk, key, oc = _measure_op_sharded(
                     chunk, dev, key, D=D, local_n=local_n,

@@ -324,3 +324,33 @@ def test_sharded_dynamic_density_granularity_error():
     c = Circuit(2).h(0).measure(0)     # 2^2 = 4 columns < 8 devices
     with pytest.raises(QuESTError, match="column per device"):
         compile_circuit_sharded_measured(c.ops, 4, True, mesh)
+
+
+def test_sharded_dynamic_banded_matches_pergate():
+    """The band-fused sharded dynamic engine draws the same trajectory
+    as the per-gate one per key (fusion must respect the measurement
+    barriers on the mesh too)."""
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.sharded import compile_circuit_sharded_measured
+    from quest_tpu.parallel import shard_qureg
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    n = 6
+    c = random_circuit(n, depth=2, seed=16)
+    c.measure(n - 1).x_if(0, (0, 1))
+    for op in random_circuit(n, depth=1, seed=17).ops:
+        c.ops.append(op)
+    c.measure(1)
+    fa = compile_circuit_sharded_measured(c.ops, n, False, mesh,
+                                          donate=False)
+    fb = compile_circuit_sharded_measured(c.ops, n, False, mesh,
+                                          donate=False, banded=True)
+    for s in range(8):
+        key = jax.random.PRNGKey(40 + s)
+        amps = shard_qureg(qt.create_qureg(n, dtype=np.complex128),
+                           mesh).amps
+        a1, o1 = fa(amps, key)
+        a2, o2 = fb(amps, key)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                                   atol=1e-11, rtol=0)
