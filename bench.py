@@ -80,6 +80,32 @@ def _basis_state(shape):
     return basis_planes(0, n=n, rdt=jnp.float32, shape=shape)
 
 
+def banded_fits(n: int) -> bool:
+    """Whether the banded engine's XLA band-dot footprint fits this
+    device. The band dots need ~3x the state in HLO temps even under
+    remat (measured: 24 GB at 30q, six 4 GB dot_general buffers), so on a
+    16 GB v5e the 30q banded compile is a guaranteed OOM that still costs
+    ~20 min of XLA time before failing — skip it up front. Shared by the
+    bench ladder and scripts/tpu_prewarm.py so the measured 4x-state
+    constant lives in one place."""
+    try:
+        lim = (jax.local_devices()[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:
+        lim = None
+    need = 4 * 2 * 4 * (1 << n)  # state (2 f32 planes) + ~3x in temps
+    if lim is None:
+        _log(f"device reports no HBM limit; banded OOM gate is a no-op "
+             f"at n={n} (a too-big size will pay its full compile "
+             f"before failing)")
+        return True
+    if need > lim:
+        _log(f"engine banded skipped at n={n}: ~4x state "
+             f"({need / 2**30:.0f} GiB) exceeds device HBM "
+             f"({lim / 2**30:.1f} GiB)")
+        return False
+    return True
+
+
 def _warm_step(n: int):
     """Compile + warm the benchmark step through the fastest engine that
     works on this platform (jit errors only surface at first call, so the
@@ -95,6 +121,8 @@ def _warm_step(n: int):
         raise SystemExit(f"unknown engine(s) in QUEST_BENCH_ENGINES: {bad}")
     last = None
     for name in ladder:
+        if name == "banded" and on_tpu and not banded_fits(n):
+            continue
         circ = _build_circuit(n)
         t0 = time.perf_counter()
         try:

@@ -120,6 +120,26 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
         # rebound after init — nothing useful to do but report.
         return jax.devices()[0].platform
 
+    import os
+
+    # Tunneled (axon) backends ride a local TCP relay; when its port is
+    # not even listening the full-length probe below just burns its whole
+    # timeout (observed mid-round-3: the relay died between revalidation
+    # stages and two 240 s probes were wasted). The port answering does
+    # not prove the chip works, and the port NOT answering could be a
+    # nonstandard relay port — so the check only shortens the probe
+    # timeout, it never skips the probe. QUEST_AXON_PORT=0 disables.
+    if "axon" in os.environ.get("JAX_PLATFORMS", ""):
+        try:
+            port = int(os.environ.get("QUEST_AXON_PORT") or "8093")
+        except ValueError:
+            port = 8093   # unparseable value must not break the fallback path
+        if port and not _tcp_port_open("127.0.0.1", port):
+            timeout_s = min(timeout_s, 45)
+            print(f"[quest_tpu] axon relay port {port} not listening; "
+                  f"probe timeout shortened to {timeout_s}s",
+                  file=sys.stderr, flush=True)
+
     code = "import jax; print(jax.devices()[0].platform)"
     last_err = ""
     attempts = 3
@@ -142,6 +162,15 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
           f"CPU. Last probe error: {last_err}", file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")
     return "cpu"
+
+
+def _tcp_port_open(host: str, port: int, timeout_s: float = 3.0) -> bool:
+    import socket
+    try:
+        with socket.create_connection((host, port), timeout=timeout_s):
+            return True
+    except OSError:
+        return False
 
 
 def sync_array(x) -> None:

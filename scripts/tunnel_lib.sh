@@ -1,0 +1,18 @@
+# Shared axon-tunnel helpers, sourced by tpu_revalidate.sh and
+# tunnel_watch.sh. The relay port default (8093) and the
+# QUEST_AXON_PORT=0 "disable the port check" convention live HERE for
+# shell; quest_tpu/env.py:ensure_live_backend carries the same
+# convention for Python (kept in sync by tests/test_scripts.py).
+AXON_PORT="${QUEST_AXON_PORT:-8093}"
+
+tunnel_up() {
+    [ "$AXON_PORT" = "0" ] && return 0   # port check disabled
+    timeout 5 bash -c "exec 3<>/dev/tcp/127.0.0.1/$AXON_PORT" 2>/dev/null
+}
+
+# Probe JAX in a bounded subprocess and require a real accelerator:
+# a CPU-fallback jax prints CpuDevice and must NOT count as live.
+probe_tpu() {
+    timeout "${1:-180}" python -c "import jax; print(jax.devices())" \
+        | grep -qi "tpu\|axon"
+}

@@ -1,0 +1,69 @@
+"""Pin the shell/Python contract of the tunnel-resilience tooling.
+
+The axon relay port default and the QUEST_AXON_PORT=0 "disable"
+convention live in two languages (scripts/tunnel_lib.sh for shell,
+quest_tpu/env.py:ensure_live_backend for Python); these tests keep them
+in sync and pin the probe's graceful-degradation behavior without
+needing a TPU.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def test_default_port_in_sync():
+    lib = _read("scripts/tunnel_lib.sh")
+    envpy = _read("quest_tpu/env.py")
+    sh_port = re.search(r'QUEST_AXON_PORT:-(\d+)', lib).group(1)
+    py_port = re.search(r'QUEST_AXON_PORT"?\) or "(\d+)"', envpy).group(1)
+    assert sh_port == py_port == "8093"
+
+
+def test_shell_scripts_source_the_shared_lib():
+    for rel in ("scripts/tpu_revalidate.sh", "scripts/tunnel_watch.sh"):
+        body = _read(rel)
+        assert "tunnel_lib.sh" in body, f"{rel} must source tunnel_lib.sh"
+        # the port check must not be re-implemented locally
+        assert "/dev/tcp/" not in body, f"{rel} re-implements the port check"
+
+
+def test_tunnel_lib_port_zero_disables_check():
+    out = subprocess.run(
+        ["bash", "-c", ". scripts/tunnel_lib.sh; tunnel_up && echo YES"],
+        cwd=REPO, env={**os.environ, "QUEST_AXON_PORT": "0"},
+        capture_output=True, text=True, timeout=30)
+    assert out.stdout.strip() == "YES", out.stderr
+
+
+def test_tunnel_lib_dead_port_reports_down():
+    out = subprocess.run(
+        ["bash", "-c", ". scripts/tunnel_lib.sh; tunnel_up || echo DOWN"],
+        cwd=REPO, env={**os.environ, "QUEST_AXON_PORT": "1"},  # reserved port
+        capture_output=True, text=True, timeout=30)
+    assert out.stdout.strip() == "DOWN", out.stderr
+
+
+def test_probe_tolerates_empty_and_garbage_port(monkeypatch):
+    """ensure_live_backend must degrade, not crash, on any QUEST_AXON_PORT
+    value (empty string and non-numeric both reach the int parse)."""
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='axon';"
+        "from quest_tpu.env import ensure_live_backend;"
+        "print(ensure_live_backend(timeout_s=1))"
+    )
+    for bad in ("", "not-a-port"):
+        env = {**os.environ, "QUEST_AXON_PORT": bad,
+               "JAX_PLATFORMS": "axon"}
+        out = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, (bad, out.stderr[-500:])
+        assert out.stdout.strip().splitlines()[-1] == "cpu", (bad, out.stdout)
