@@ -92,6 +92,20 @@ def banded_fits(n: int) -> bool:
         lim = (jax.local_devices()[0].memory_stats() or {}).get("bytes_limit")
     except Exception:
         lim = None
+    if lim is None and os.environ.get("QUEST_HBM_BYTES"):
+        try:
+            lim = int(os.environ["QUEST_HBM_BYTES"])
+        except ValueError:
+            _log(f"ignoring malformed QUEST_HBM_BYTES="
+                 f"{os.environ['QUEST_HBM_BYTES']!r} (want bytes as int)")
+    if lim is None and jax.devices()[0].platform == "axon":
+        # the axon tunnel hides memory_stats; the tunneled chip is a
+        # single v5e core (15.75 GiB usable — read off the chip's own
+        # OOM report, r3). Without this the gate is a no-op and the 30q
+        # banded compile burns ~19 min before its guaranteed OOM.
+        lim = int(15.75 * 2**30)
+        _log(f"axon tunnel hides HBM stats; assuming v5e {lim/2**30:.2f} "
+             f"GiB (override via QUEST_HBM_BYTES)")
     need = 4 * 2 * 4 * (1 << n)  # state (2 f32 planes) + ~3x in temps
     if lim is None:
         _log(f"device reports no HBM limit; banded OOM gate is a no-op "

@@ -34,8 +34,11 @@ def _sync(x):
 
 
 def _emit(scenario, metric, value, unit, **extra):
+    # platform is evidence: scripts/tpu_revalidate.sh gates on it to tell
+    # a real on-chip measurement from a loud-but-successful CPU fallback
     print(json.dumps({"scenario": scenario, "metric": metric,
-                      "value": round(value, 3), "unit": unit, **extra}))
+                      "value": round(value, 3), "unit": unit,
+                      "platform": jax.devices()[0].platform, **extra}))
 
 
 def _on_tpu():

@@ -66,15 +66,19 @@ def main(n=30, depth=20):
     compiled = lowered.compile()
     rec["compile_s"] = round(time.perf_counter() - t0, 2)
 
+    # sync via sync_array (tiny native-layout slice): reading through
+    # .reshape(2, -1) forces a full relayout copy of the tiled state on
+    # device (8 GB at 30q -> OOM next to the live state on a 16 GB v5e),
+    # and jax.block_until_ready returns early on the axon tunnel
+    from quest_tpu.env import sync_array
     t0 = time.perf_counter()
     out = step(s)
-    import numpy as np
-    np.asarray(out.reshape(2, -1)[0, :1])
+    sync_array(out)
     rec["run1_s"] = round(time.perf_counter() - t0, 2)
 
     t0 = time.perf_counter()
     out = step(out)
-    np.asarray(out.reshape(2, -1)[0, :1])
+    sync_array(out)
     rec["steady_s"] = round(time.perf_counter() - t0, 3)
     del compiled
     print(json.dumps(rec))

@@ -22,22 +22,32 @@ require_tunnel() {
     fi
 }
 
-# A failed stage is only a REAL failure if the tunnel survived it; a relay
-# that died mid-stage makes any stage error retryable (exit 2).
+# A failed stage is only a REAL failure if the CHIP survived it: a relay
+# that died mid-stage, or a relay whose port still answers while the
+# backend lease is gone (port-up-but-chip-dead — the state tunnel_up
+# cannot see), both make the stage error retryable (exit 2) so the
+# watcher keeps using future uptime windows.
 fail_stage() {
     if ! tunnel_up; then
         echo "stage '$1' failed AND tunnel is down -> treating as mid-stage drop; aborting for retry"
         exit 2
     fi
-    echo "stage '$1' failed with the tunnel still up -> real failure"
+    if ! probe_tpu 120; then
+        echo "stage '$1' failed with the relay port open but no live accelerator behind it -> retryable outage"
+        exit 2
+    fi
+    echo "stage '$1' failed with the chip still live -> real failure"
     exit 1
 }
 
 echo "== devices =="
 require_tunnel devices
 # the probe must see a real accelerator: a CPU-fallback jax prints
-# CpuDevice and exits 0, which would run the whole ~2 h suite on host CPU
-probe_tpu 300 || fail_stage devices
+# CpuDevice and exits 0, which would run the whole ~2 h suite on host CPU.
+# A failed INITIAL probe is always a retryable outage (it IS the liveness
+# check — routing it through fail_stage could re-probe successfully and
+# then exit 1, permanently stopping the watcher on a transient).
+probe_tpu 300 || { echo "initial accelerator probe failed; retrying later"; exit 2; }
 
 echo "== pre-warm persistent compile cache =="
 require_tunnel prewarm
@@ -45,29 +55,50 @@ timeout 2400 python scripts/tpu_prewarm.py || echo "prewarm incomplete (continui
 
 echo "== compile-latency profile (cold vs warm) =="
 require_tunnel profile
+# port-up-but-chip-dead would run the whole profile on host CPU ('|| true'
+# swallows everything); require a live accelerator before spending 2400 s
+probe_tpu 120 || { echo "chip not live before profile stage"; exit 2; }
 timeout 2400 python scripts/profile_compile.py 30 20 || true
 require_tunnel profile-warm
 timeout 600 python scripts/profile_compile.py 30 20 || true
 
 echo "== on-chip certification sweep (tests/test_tpu_smoke.py) =="
 require_tunnel smoke
-QUEST_TEST_PLATFORM=axon timeout 3000 python -m pytest tests/test_tpu_smoke.py -q 2>&1 \
+# metrics are collected via QUEST_METRICS_FILE, NOT the captured stream:
+# pytest's fd-level capture swallows stderr from PASSING tests, so a
+# fully green sweep would leave zero [smoke-metric] lines in the tee
+# (bit in r3 — the evidence gate failed a perfect run)
+METRICS_FILE=/tmp/tpu_smoke_metrics.log
+: > "$METRICS_FILE"
+QUEST_METRICS_FILE="$METRICS_FILE" QUEST_TEST_PLATFORM=axon \
+    timeout 3000 python -m pytest tests/test_tpu_smoke.py -q 2>&1 \
     | tee /tmp/tpu_smoke_out.log || fail_stage smoke
 # a CPU-fallback run SKIPS every test and still exits 0; require real
 # on-chip evidence before touching the certification log, and never
 # truncate previously captured evidence with an empty file
-if ! grep -q "smoke-metric" /tmp/tpu_smoke_out.log; then
+if ! grep -q "smoke-metric" "$METRICS_FILE"; then
     echo "smoke run produced no [smoke-metric] evidence (CPU fallback or all skipped)"
     fail_stage smoke-evidence
 fi
-grep "smoke-metric" /tmp/tpu_smoke_out.log > benchmarks/oncip_certification.log
+grep "smoke-metric" "$METRICS_FILE" > benchmarks/oncip_certification.log
 
 echo "== headline bench =="
 require_tunnel bench
-timeout 1800 python bench.py || fail_stage bench
+timeout 1800 python bench.py | tee /tmp/bench_out.json || fail_stage bench
+# a backend death mid-run leaves the relay port open while bench degrades
+# loudly-but-successfully to host CPU; its JSON labels the platform —
+# require on-chip evidence, don't let a CPU number close the stage
+if grep -q '(cpu)' /tmp/bench_out.json; then
+    echo "bench ran on host CPU fallback, not the chip"
+    fail_stage bench-evidence
+fi
 
 echo "== 30q depth-20 RCS wall-clock (benchmarks/run.py rcs) =="
 require_tunnel rcs
-timeout 1800 python -u benchmarks/run.py rcs || fail_stage rcs
+timeout 1800 python -u benchmarks/run.py rcs | tee /tmp/rcs_out.json || fail_stage rcs
+if ! grep -q '"platform": "\(tpu\|axon\)"' /tmp/rcs_out.json; then
+    echo "rcs produced no on-chip evidence (platform != tpu/axon)"
+    fail_stage rcs-evidence
+fi
 
 echo "== revalidation COMPLETE =="

@@ -7,7 +7,15 @@ AXON_PORT="${QUEST_AXON_PORT:-8093}"
 
 tunnel_up() {
     [ "$AXON_PORT" = "0" ] && return 0   # port check disabled
-    timeout 5 bash -c "exec 3<>/dev/tcp/127.0.0.1/$AXON_PORT" 2>/dev/null
+    if timeout 5 bash -c "exec 3<>/dev/tcp/127.0.0.1/$AXON_PORT" 2>/dev/null; then
+        return 0
+    fi
+    # Same rule as quest_tpu/env.py: a dead DEFAULT port might just be a
+    # nonstandard relay setup, so fall through to a short real probe
+    # before declaring the tunnel down. An operator-set QUEST_AXON_PORT
+    # is trusted as-is (and keeps the check cheap).
+    [ -n "${QUEST_AXON_PORT:-}" ] && return 1
+    probe_tpu 60
 }
 
 # Probe JAX in a bounded subprocess and require a real accelerator:

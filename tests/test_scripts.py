@@ -45,14 +45,20 @@ def test_tunnel_lib_port_zero_disables_check():
 
 
 def test_tunnel_lib_dead_port_reports_down():
+    # bind-then-release an ephemeral port: deterministically dead, unlike
+    # a fixed low port something might actually be listening on
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
     out = subprocess.run(
         ["bash", "-c", ". scripts/tunnel_lib.sh; tunnel_up || echo DOWN"],
-        cwd=REPO, env={**os.environ, "QUEST_AXON_PORT": "1"},  # reserved port
+        cwd=REPO, env={**os.environ, "QUEST_AXON_PORT": str(dead_port)},
         capture_output=True, text=True, timeout=30)
     assert out.stdout.strip() == "DOWN", out.stderr
 
 
-def test_probe_tolerates_empty_and_garbage_port(monkeypatch):
+def test_probe_tolerates_empty_and_garbage_port():
     """ensure_live_backend must degrade, not crash, on any QUEST_AXON_PORT
     value (empty string and non-numeric both reach the int parse)."""
     code = (
