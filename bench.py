@@ -98,14 +98,17 @@ def banded_fits(n: int) -> bool:
         except ValueError:
             _log(f"ignoring malformed QUEST_HBM_BYTES="
                  f"{os.environ['QUEST_HBM_BYTES']!r} (want bytes as int)")
-    if lim is None and jax.devices()[0].platform == "axon":
-        # the axon tunnel hides memory_stats; the tunneled chip is a
-        # single v5e core (15.75 GiB usable — read off the chip's own
-        # OOM report, r3). Without this the gate is a no-op and the 30q
-        # banded compile burns ~19 min before its guaranteed OOM.
-        lim = int(15.75 * 2**30)
-        _log(f"axon tunnel hides HBM stats; assuming v5e {lim/2**30:.2f} "
-             f"GiB (override via QUEST_HBM_BYTES)")
+    if lim is None:
+        # stats hidden (the axon tunnel does this): assume the capacity
+        # of the recognized device family only — never guess for unknown
+        # hardware. v5e/v5-lite = 15.75 GiB usable (read off the chip's
+        # own OOM report, r3); without this the gate is a no-op and the
+        # 30q banded compile burns ~19 min before its guaranteed OOM.
+        kind = str(getattr(jax.devices()[0], "device_kind", "")).lower()
+        if "lite" in kind or "v5e" in kind:
+            lim = int(15.75 * 2**30)
+            _log(f"device hides HBM stats; assuming {lim/2**30:.2f} GiB "
+                 f"for device_kind={kind!r} (override via QUEST_HBM_BYTES)")
     need = 4 * 2 * 4 * (1 << n)  # state (2 f32 planes) + ~3x in temps
     if lim is None:
         _log(f"device reports no HBM limit; banded OOM gate is a no-op "

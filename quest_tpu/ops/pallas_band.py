@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import List, Sequence, Tuple
 
 import jax
@@ -513,27 +514,48 @@ def _cdot(contract, re, im, gre, gim, real_only):
     return t1 - t2, t3 - t1 - t2
 
 
-def _dot_precision():
-    """Mosaic lowers only DEFAULT and HIGHEST dot precisions; clamp the
-    session knob's HIGH (usable on the XLA band path) up to HIGHEST."""
+def _mxu_dot_general(a, b, dnums):
+    """State-amplitude dot at the session precision knob.
+
+    HIGHEST (default): one f32 dot = 6 bf16 MXU passes, ~3e-7 relative
+    error — full f32, matches the reference's PRECISION=1 envelope.
+    HIGH: the double-bf16 3-pass scheme (a = a_hi + a_lo rounded to
+    bf16, keep the three highest-order products, f32 accumulation) —
+    HALF the MXU passes of HIGHEST at ~5e-6 relative error per dot
+    (measured against an f64 oracle; docs/PRECISION.md). Mosaic does not
+    lower Precision.HIGH, so the split is done explicitly here; XLA's
+    own bf16_3x does the same thing on the banded/per-gate paths.
+    DEFAULT: one bf16 pass, ~1e-3 — exposed but not recommended."""
     p = precision.matmul_precision()
-    return jax.lax.Precision.HIGHEST if p == jax.lax.Precision.HIGH else p
+    f32 = jnp.float32
+    if p == jax.lax.Precision.HIGH:
+        bf = jnp.bfloat16
+        ah = a.astype(bf)
+        al = (a - ah.astype(f32)).astype(bf)
+        bh = b.astype(bf)
+        bl = (b - bh.astype(f32)).astype(bf)
+
+        def mm(x, y):
+            return jax.lax.dot_general(
+                x, y, dnums, preferred_element_type=f32,
+                precision=jax.lax.Precision.DEFAULT)
+        return mm(ah, bh) + mm(ah, bl) + mm(al, bh)
+    return jax.lax.dot_general(a, b, dnums, preferred_element_type=f32,
+                               precision=p)
+
+
+_DN_2D = (((1,), (0,)), ((), ()))   # plain 2-D matmul dimension numbers
 
 
 def _sublane_contract(d):
     """Contraction over the lowest log2(d) row bits of an (R, LANES)
     block: cheap (A, d, l) -> (d, A, l) relayout, one MXU dot, undo.
     Shared by the b1 MatStage and b1-op PairStage paths."""
-    f32 = jnp.float32
-    hi = _dot_precision()
-
     def contract(gg, x):
         rows = x.size // LANES
         a = rows // d
         xt = x.reshape(a, d, LANES).transpose(1, 0, 2).reshape(d, a * LANES)
-        out = jax.lax.dot_general(
-            gg, xt, (((1,), (0,)), ((), ())),
-            preferred_element_type=f32, precision=hi)
+        out = _mxu_dot_general(gg, xt, _DN_2D)
         return out.reshape(d, a, LANES).transpose(1, 0, 2).reshape(x.shape)
     return contract
 
@@ -541,14 +563,11 @@ def _sublane_contract(d):
 def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
     g = gref[...]
     gre, gim = g[0], g[1]
-    f32 = jnp.float32
     rows = geo.rows_eff
-    hi = _dot_precision()  # HIGHEST default: TPU dots
-    # otherwise run single bf16 passes and norm drifts ~1e-3 (see precision.py)
 
     if st.kind == "b0":
         def contract(gg, x):     # x (rows, LANES) @ G^T (LANES, LANES)
-            return jnp.dot(x, gg, preferred_element_type=f32, precision=hi)
+            return _mxu_dot_general(x, gg, _DN_2D)
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
     elif st.kind == "b1":
         contract = _sublane_contract(st.dim)
@@ -570,14 +589,10 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
         def contract(gg, x):
             if pre == 1:
                 xt = x.reshape(d, post)
-                out = jax.lax.dot_general(
-                    gg, xt, (((1,), (0,)), ((), ())),
-                    preferred_element_type=f32, precision=hi)
+                out = _mxu_dot_general(gg, xt, _DN_2D)
                 return out.reshape(x.shape)
             xt = x.reshape(pre, d, post).transpose(1, 0, 2)
-            out = jax.lax.dot_general(
-                gg, xt.reshape(d, pre * post), (((1,), (0,)), ((), ())),
-                preferred_element_type=f32, precision=hi)
+            out = _mxu_dot_general(gg, xt.reshape(d, pre * post), _DN_2D)
             return (out.reshape(d, pre, post).transpose(1, 0, 2)
                     .reshape(x.shape))
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
@@ -674,8 +689,6 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
                       row_ids):
     g = gref[...]                 # (2, 4, D, D) block operators
     rows = geo.rows_eff
-    f32 = jnp.float32
-    hi = _dot_precision()
 
     if st.op_kind == "sc":
         # both qubits on scattered axes: 4 input slices, 16 scalar cmuls
@@ -740,9 +753,8 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
 
         if st.op_kind == "lane":
             def block(gg, x):     # g packed pre-transposed: X @ G^T
-                return jnp.dot(x.reshape(-1, LANES), gg,
-                               preferred_element_type=f32,
-                               precision=hi).reshape(x.shape)
+                return _mxu_dot_general(
+                    x.reshape(-1, LANES), gg, _DN_2D).reshape(x.shape)
         else:                     # 'b1': sublane-axis contraction
             block = _sublane_contract(LANES)
 
@@ -789,11 +801,44 @@ def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
     out_ref[...] = jnp.stack([re, im]).reshape(shape)
 
 
+def _rows_eff_override():
+    """QUEST_ROWS_EFF_BITS block-size experiment knob, parsed ONCE at
+    import (mid-process changes are deliberately ignored: the value is
+    not part of any compiled-program cache key, so honoring them would
+    silently return stale kernels — sweep via subprocesses instead,
+    like scripts' block experiments do). Malformed/out-of-range values
+    fall back to the default, loudly."""
+    raw = os.environ.get("QUEST_ROWS_EFF_BITS")
+    if not raw:
+        return ROWS_EFF_BITS
+    try:
+        v = int(raw)
+    except ValueError:
+        import sys
+        print(f"[pallas_band] ignoring malformed QUEST_ROWS_EFF_BITS="
+              f"{raw!r} (want an int)", file=sys.stderr)
+        return ROWS_EFF_BITS
+    if not 3 <= v <= MAX_BLOCK_ROW_BITS:
+        import sys
+        print(f"[pallas_band] ignoring QUEST_ROWS_EFF_BITS={v} outside "
+              f"[3, {MAX_BLOCK_ROW_BITS}]", file=sys.stderr)
+        return ROWS_EFF_BITS
+    return v
+
+
+_ROWS_EFF_BITS_EFFECTIVE = None  # resolved lazily on first compile
+
+
 def compile_segment(stages: Sequence, n: int,
-                    rows_eff_bits: int = ROWS_EFF_BITS,
+                    rows_eff_bits: int | None = None,
                     interpret: bool = False):
     """Build fn(amps, mat_arrays) -> amps applying `stages` in one kernel
     launch (grid over the row axes outside the block)."""
+    global _ROWS_EFF_BITS_EFFECTIVE
+    if rows_eff_bits is None:
+        if _ROWS_EFF_BITS_EFFECTIVE is None:
+            _ROWS_EFF_BITS_EFFECTIVE = _rows_eff_override()
+        rows_eff_bits = _ROWS_EFF_BITS_EFFECTIVE
     total_row_bits = n - LANE_QUBITS
     rows_eff_bits = min(rows_eff_bits, total_row_bits)
     scat_bits = {st.bit for st in stages

@@ -68,6 +68,21 @@ def main():
     print(f"[prewarm] rcs 30q d20: {time.perf_counter()-t0:.1f}s",
           file=sys.stderr)
 
+    # QFT 30q: the certification sweep's coldest program (290.9 s cold,
+    # measured r3 — its all-to-all segment structure shares nothing with
+    # the RCS/bench kernels)
+    t0 = time.perf_counter()
+    try:
+        from quest_tpu.circuit import qft_circuit
+        step = qft_circuit(n).compiled_fused(n, density=False, donate=True)
+        s = step(basis_planes(0, n=n, rdt=jnp.float32,
+                              shape=fused_state_shape(n)))
+        del s, step
+        print(f"[prewarm] qft 30q: {time.perf_counter()-t0:.1f}s",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[prewarm] qft 30q FAILED: {e!r}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()

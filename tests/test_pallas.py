@@ -431,3 +431,51 @@ def test_deep_circuit_segment_stage_cap():
     assert len(segs) >= 2
     assert all(len(s[1]) <= PB.MAX_SEGMENT_STAGES + 1 for s in segs)
     check(c, n=n, tol=5e-5)
+
+
+class TestMatmulPrecisionTiers:
+    """The session precision knob on the fused engine: HIGHEST (default,
+    6-pass f32-exact) and HIGH (manual double-bf16 3-pass inside the
+    kernel — Mosaic lowers only DEFAULT/HIGHEST, so _mxu_dot_general
+    splits the operands itself at half the MXU passes, ~5e-6 relative
+    error per dot measured vs an f64 oracle)."""
+
+    def _run(self, tier):
+        from quest_tpu import precision as P
+        rng = np.random.default_rng(3)
+        n = 12
+        c = Circuit(n)
+        for d in range(3):
+            for q in range(n):
+                c.rx(q, float(rng.uniform(0, 2 * np.pi)))
+            for q in range(d % 2, n - 1, 2):
+                c.cz(q, q + 1)
+        old = P.matmul_precision()
+        P.set_matmul_precision(tier)
+        try:
+            q = qt.init_debug_state(qt.create_qureg(n))
+            return to_dense(c.apply_fused(q, interpret=True))
+        finally:
+            P.set_matmul_precision(old)
+
+    def test_high_tier_accuracy_envelope(self):
+        """HIGH must stay within ~1e-4 of the HIGHEST (f32-exact) result
+        on a depth-3 mixed circuit (per-dot 5e-6, accumulated) — far
+        inside the ~1e-3 drift single-pass bf16 (DEFAULT) shows."""
+        got = self._run("high")
+        want = self._run("highest")
+        scale = float(np.max(np.abs(want)))   # debug-state amps are large
+        err = float(np.max(np.abs(got - want))) / scale
+        assert err < 1e-4, f"HIGH tier drifted {err} (relative) from HIGHEST"
+        # the relative norm must be preserved to the same envelope
+        n_got = float(np.sum(np.abs(got.astype(np.complex128)) ** 2))
+        n_want = float(np.sum(np.abs(want.astype(np.complex128)) ** 2))
+        assert abs(n_got / n_want - 1.0) < 1e-4, (n_got, n_want)
+
+    def test_high_tier_actually_engages(self):
+        """The 3-pass path must produce DIFFERENT bits than HIGHEST:
+        a silent clamp back to 6-pass would make the knob a no-op (the
+        pre-r3 kernel did exactly that)."""
+        got = self._run("high")
+        want = self._run("highest")
+        assert float(np.max(np.abs(got - want))) > 0.0

@@ -365,3 +365,50 @@ def test_dynamic_circuit_on_chip():
     bob = v[:, o[1], o[0]]
     fid = abs(np.vdot(want, bob)) ** 2
     assert fid > 1 - 1e-5, (o, fid)
+
+
+def test_high_precision_tier_on_chip():
+    """QUEST_MATMUL_PRECISION=high (manual double-bf16 3-pass in the
+    kernel): measure throughput vs the HIGHEST default at 26q and pin the
+    accuracy envelope on real MXU hardware. The 3-pass scheme halves MXU
+    passes on the compute-bound fused path."""
+    from quest_tpu import precision as P
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.state import basis_planes, fused_state_shape
+
+    import jax.numpy as jnp
+
+    n = 26
+    rng = np.random.default_rng(5)
+    c = Circuit(n)
+    for i in range(16):
+        c.rx(1 + i % (n - 1), float(rng.uniform(0, 2 * np.pi)))
+
+    def measure(tier):
+        old = P.matmul_precision()
+        P.set_matmul_precision(tier)
+        try:
+            step = c.compiled_fused(n, density=False, donate=True, iters=8)
+            s = step(basis_planes(0, n=n, rdt=jnp.float32,
+                                  shape=fused_state_shape(n)))
+            sync_array(s)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                s = step(s)
+            sync_array(s)
+            gps = 16 * 8 * 3 / (time.perf_counter() - t0)
+            # one-shot copy for the accuracy check (first 128 amps)
+            head = np.asarray(jax.device_get(s[:, 0, :]))
+            return gps, head
+        finally:
+            P.set_matmul_precision(old)
+
+    gps_hi, head_hi = measure("highest")
+    gps_h3, head_h3 = measure("high")
+    scale = float(np.max(np.abs(head_hi))) or 1.0
+    err = float(np.max(np.abs(head_h3 - head_hi))) / scale
+    _metric("precision_high_vs_highest_26q",
+            gates_per_sec_highest=round(gps_hi, 1),
+            gates_per_sec_high=round(gps_h3, 1),
+            speedup=round(gps_h3 / gps_hi, 2), rel_err_head=err)
+    assert err < 1e-3, f"HIGH tier diverged on chip: {err}"
