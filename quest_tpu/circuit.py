@@ -804,6 +804,67 @@ class Circuit:
                                  interpret)
         return q.replace_amps(fn(q.amps))
 
+    def explain(self, density: bool = False) -> str:
+        """Human-readable fused-engine schedule: what compiled_fused will
+        actually execute, WITHOUT paying a compile — one line per part
+        (kernel segment with its stage mix, or XLA passthrough), then
+        totals: segments, distinct Mosaic kernels, HBM passes and the
+        estimated bytes one application moves. Performance introspection
+        the reference cannot offer (it executes gate by gate; there is
+        no schedule to explain)."""
+        self._reject_measure("explain")
+        from quest_tpu.ops import fusion as F
+        from quest_tpu.ops import pallas_band as PB
+
+        n = self.num_qubits * 2 if density else self.num_qubits
+        pass_bytes = 2 * 4 * (1 << n) * 2   # r+w of both f32 planes
+        lines = [f"fused schedule for {len(self.ops)} ops on "
+                 f"{self.num_qubits} qubits"
+                 + (f" (density: {n}-qubit register)" if density else "")]
+        if not PB.usable(n):
+            lines.append(f"  register below the kernel tier's minimum "
+                         f"({PB.LANE_QUBITS + 3} qubits): the banded XLA "
+                         f"engine runs instead")
+            return "\n".join(lines)
+
+        items = F.plan(self._flat_ops(n, density), n,
+                       bands=PB.plan_bands(n))
+        parts = PB.segment_plan(items, n)
+        kernels = set()
+        passes = 0
+        for i, part in enumerate(parts):
+            if part[0] == "segment":
+                _, stages, _arrays = part
+                kernels.add(tuple(stages))
+                passes += 1
+                mix = {}
+                for st in stages:
+                    name = type(st).__name__.removesuffix("Stage").lower()
+                    if hasattr(st, "kind"):
+                        name = f"{name}:{st.kind}"
+                    mix[name] = mix.get(name, 0) + 1
+                desc = " ".join(f"{k}x{v}" if v > 1 else k
+                                for k, v in mix.items())
+                lines.append(f"  [{i}] kernel segment  "
+                             f"{len(stages)} stages  ({desc})")
+            else:
+                it = part[1]
+                passes += 1
+                what = (f"band q{it.ql}..q{it.ql + it.w - 1}"
+                        if isinstance(it, F.BandOp) else
+                        "diagonal" if isinstance(it, F.DiagItem)
+                        else f"op {getattr(it.op, 'kind', '?')}")
+                lines.append(f"  [{i}] XLA passthrough  {what}")
+        moved = passes * pass_bytes
+        human = (f"{moved / 2**30:.2f} GiB" if moved >= 2**29
+                 else f"{moved / 2**20:.2f} MiB")
+        lines.append(
+            f"  total: {passes} HBM pass{'es' if passes != 1 else ''} "
+            f"({human} moved per application at {n}q), "
+            f"{sum(1 for p in parts if p[0] == 'segment')} segments, "
+            f"{len(kernels)} distinct kernels")
+        return "\n".join(lines)
+
     def compiled_sharded(self, n: int, density: bool, mesh, donate: bool = True):
         """Compiled explicit-distribution program (one shard_map over the
         whole circuit, reference-style ppermute schedule — see

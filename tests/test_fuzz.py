@@ -183,3 +183,26 @@ def test_fuzz_sharded_engines(seed):
         got = to_dense(load().replace_amps(step(load().amps)))
         np.testing.assert_allclose(got, want, atol=1e-11, rtol=0,
                                    err_msg=f"{label} seed={seed}")
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_high_precision_tier(seed):
+    """The HIGH (3-pass bf16) matmul tier through the fused engine vs the
+    dense oracle: per-dot ~5e-6 relative error must stay within a 1e-4
+    envelope over a full random mixed circuit."""
+    from quest_tpu import precision as P
+
+    rng = np.random.default_rng(4000 + seed)
+    n = 10   # >= the kernel tier's minimum register
+    c, ops = _random_circuit(rng, n)
+    v0 = oracle.random_statevector(n, rng)
+    want = _oracle_vector(ops, v0, n)
+    from quest_tpu.state import init_state_from_amps
+    q = init_state_from_amps(qt.create_qureg(n), v0.real, v0.imag)
+    old = P.matmul_precision()
+    P.set_matmul_precision("high")
+    try:
+        got = to_dense(c.apply_fused(q, interpret=True))
+    finally:
+        P.set_matmul_precision(old)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=0,
+                               err_msg=f"high-tier seed={seed}")

@@ -479,3 +479,29 @@ class TestMatmulPrecisionTiers:
         got = self._run("high")
         want = self._run("highest")
         assert float(np.max(np.abs(got - want))) > 0.0
+
+
+def test_explain_reports_schedule_without_compiling():
+    """Circuit.explain: the fused schedule as text — segments, stage
+    mixes, pass/kernel totals — with no jit/compile side effects."""
+    rng = np.random.default_rng(42)
+    c = Circuit(16)
+    for i in range(16):
+        c.rx(1 + i % 15, float(rng.uniform(0, 2 * np.pi)))
+    text = c.explain()
+    assert "kernel segment" in text and "mat:b0" in text
+    assert "1 segments, 1 distinct kernels" in text
+    assert not c._compiled            # planning only, nothing compiled
+
+    qft_text = qft_circuit(12).explain()
+    assert qft_text.count("kernel segment") >= 2
+
+    small = Circuit(6)
+    small.h(0)
+    assert "banded XLA engine" in small.explain()
+
+    dyn = Circuit(12)
+    dyn.h(0)
+    dyn.measure(0)
+    with pytest.raises(Exception):
+        dyn.explain()
