@@ -209,6 +209,14 @@ def _apply_op(amps, n, density, op: GateOp):
     return amps
 
 
+def _human_bytes(b: int) -> str:
+    if b >= 2**29:
+        return f"{b / 2**30:.2f} GiB"
+    if b >= 2**19:
+        return f"{b / 2**20:.2f} MiB"
+    return f"{b / 2**10:.2f} KiB"
+
+
 class Circuit:
     """Builder for a fixed gate sequence over `num_qubits` qubits.
 
@@ -856,14 +864,48 @@ class Circuit:
                         else f"op {getattr(it.op, 'kind', '?')}")
                 lines.append(f"  [{i}] XLA passthrough  {what}")
         moved = passes * pass_bytes
-        human = (f"{moved / 2**30:.2f} GiB" if moved >= 2**29
-                 else f"{moved / 2**20:.2f} MiB")
         lines.append(
             f"  total: {passes} HBM pass{'es' if passes != 1 else ''} "
-            f"({human} moved per application at {n}q), "
+            f"({_human_bytes(moved)} moved per application at {n}q), "
             f"{sum(1 for p in parts if p[0] == 'segment')} segments, "
             f"{len(kernels)} distinct kernels")
         return "\n".join(lines)
+
+    def explain_sharded(self, mesh, density: bool = False,
+                        engine: str = "banded") -> str:
+        """The distributed counterpart of explain(): lower (not compile)
+        the sharded program for `mesh` and report the communication
+        schedule XLA actually emitted — collective exchanges and their
+        per-device ICI bytes, psum reductions, local band passes — plus
+        the shard geometry. Derived from the lowered StableHLO, so it
+        cannot drift from the engine (quest_tpu.parallel.introspect).
+        The reference's exchange schedule is implicit in C control flow
+        (QuEST_cpu_distributed.c:481-509) and cannot be asked for."""
+        self._reject_measure("explain_sharded")
+        from quest_tpu.parallel.introspect import sharded_schedule
+
+        n = self.num_qubits * 2 if density else self.num_qubits
+        rec = sharded_schedule(self.ops, n, density, mesh, engine=engine)
+        if engine == "pergate":
+            plan_lines = [f"  local ops: {rec['local_ops']}",
+                          f"  device-qubit ops: {rec['global_ops']}"]
+        else:
+            plan_lines = [
+                f"  local band passes: {rec['local_band_passes']}",
+                f"  global-qubit items: {rec['global_qubit_items']}"]
+        return "\n".join([
+            f"sharded ({engine}) schedule for {len(self.ops)} ops on "
+            f"{self.num_qubits} qubits over {rec['devices']} devices"
+            + (f" (density: {n}-qubit register)" if density else ""),
+            f"  shard geometry: {rec['local_qubits']} local + "
+            f"{rec['global_qubits']} device qubits, "
+            f"{_human_bytes(rec['chunk_bytes'])} chunk per device",
+            *plan_lines,
+            f"  collective exchanges: {rec['collective_permutes']} "
+            f"({_human_bytes(rec['ici_bytes_per_device'])} ICI per device "
+            f"per application)",
+            f"  psum reductions: {rec['all_reduces']}",
+        ])
 
     def compiled_sharded(self, n: int, density: bool, mesh, donate: bool = True):
         """Compiled explicit-distribution program (one shard_map over the

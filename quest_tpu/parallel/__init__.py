@@ -27,10 +27,12 @@ Two execution paths, mirroring the reference's local/distributed split:
 
 from quest_tpu.parallel.mesh import make_amp_mesh, amp_sharding, shard_qureg
 from quest_tpu.parallel.sharded import apply_circuit_sharded
+from quest_tpu.parallel.introspect import sharded_schedule
 
 __all__ = [
     "make_amp_mesh",
     "amp_sharding",
     "shard_qureg",
     "apply_circuit_sharded",
+    "sharded_schedule",
 ]

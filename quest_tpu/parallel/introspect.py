@@ -1,0 +1,89 @@
+"""Collective-schedule introspection for the sharded engines.
+
+The numbers come from the StableHLO that XLA actually lowered for the
+given mesh — not from re-deriving the dispatch rules — so the report
+cannot drift from the engine. Tracing allocates no state: a 40q/256-dev
+schedule can be inspected on a laptop (scripts/pod_projection.py builds
+its north-star projection on exactly this).
+
+Reference analogue: none. The reference's exchange schedule is implicit
+in C control flow (exchangeStateVectors call sites,
+QuEST_cpu_distributed.c:481-509); there is nothing a user can ask for
+short of reading the source.
+"""
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def parse_collectives(stablehlo_text: str) -> dict:
+    """Counts and per-device payload bytes of cross-device collectives
+    in a lowered module's StableHLO text."""
+    cp_elems = []
+    for m in re.finditer(
+            r"stablehlo\.collective_permute.*?tensor<([0-9x]+)xf(32|64)>",
+            stablehlo_text):
+        dims = [int(d) for d in m.group(1).split("x")]
+        e = 1
+        for d in dims:
+            e *= d
+        cp_elems.append(e * (4 if m.group(2) == "32" else 8))
+    all_reduces = len(re.findall(r"stablehlo\.all_reduce", stablehlo_text))
+    return {
+        "collective_permutes": len(cp_elems),
+        "ici_bytes_per_device": int(sum(cp_elems)),
+        "all_reduces": all_reduces,
+    }
+
+
+def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
+                     engine: str = "banded") -> dict:
+    """Lower (don't compile) the sharded program for `mesh` and report
+    its communication schedule plus the local plan it rides on. `n` is
+    the STATE-qubit count (2x the logical count for density registers),
+    matching the compile_circuit_sharded* builders."""
+    from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.parallel import sharded as S
+
+    builders = {"banded": S.compile_circuit_sharded_banded,
+                "fused": S.compile_circuit_sharded_fused,
+                "pergate": S.compile_circuit_sharded}
+    if engine not in builders:
+        raise ValueError(f"engine must be one of {sorted(builders)}, "
+                         f"got {engine!r}")
+    D = int(mesh.devices.size)
+    g = D.bit_length() - 1
+    local_n = n - g
+    step = builders[engine](ops, n, density, mesh=mesh, donate=False)
+    lowered = jax.jit(step).lower(
+        jax.ShapeDtypeStruct((2, 1 << n), jnp.float32))
+    rec = parse_collectives(lowered.as_text())
+    rec.update({
+        "devices": D,
+        "local_qubits": local_n,
+        "global_qubits": g,
+        "engine": engine,
+        "chunk_bytes": 2 * 4 * (1 << n) // D,
+    })
+
+    flat = flatten_ops(ops, n, density)
+    if engine == "pergate":
+        # the per-gate engine runs one pass per op — band-plan stats
+        # would describe passes it never executes
+        rec["local_ops"] = sum(
+            1 for op in flat if max(op.targets) < local_n)
+        rec["global_ops"] = len(flat) - rec["local_ops"]
+    else:
+        items = F.plan(flat, n, bands=S._shard_bands(n, local_n))
+        rec["local_band_passes"] = sum(
+            1 for it in items
+            if isinstance(it, F.BandOp) and it.ql < local_n)
+        rec["global_qubit_items"] = sum(
+            1 for it in items
+            if isinstance(it, F.BandOp) and it.ql >= local_n)
+    return rec

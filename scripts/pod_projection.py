@@ -29,15 +29,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r'''
 import jax
 jax.config.update("jax_platforms", "cpu")
-import json, re, sys, time
-import numpy as np, jax.numpy as jnp
+import json, sys, time
+import numpy as np
 sys.path.insert(0, %(repo)r)
 from jax.sharding import Mesh
-from quest_tpu.circuit import flatten_ops, qft_circuit, random_circuit
+from quest_tpu.circuit import qft_circuit, random_circuit
 from quest_tpu.env import AMP_AXIS
-from quest_tpu.ops import fusion as F
-from quest_tpu.parallel.sharded import (_shard_bands,
-                                        compile_circuit_sharded_banded)
+from quest_tpu.parallel.introspect import sharded_schedule
 
 n, depth, D = %(n)d, %(depth)d, %(D)d
 circuit_kind = %(circuit)r
@@ -46,42 +44,18 @@ c = (qft_circuit(n) if circuit_kind == "qft"
 devs = jax.devices()
 assert len(devs) == D
 mesh = Mesh(np.array(devs), (AMP_AXIS,))
-g = int(np.log2(D))
-local_n = n - g
 
 t0 = time.time()
-step = compile_circuit_sharded_banded(c.ops, n, density=False, mesh=mesh,
-                                      donate=False)
-lowered = jax.jit(step).lower(jax.ShapeDtypeStruct((2, 1 << n), jnp.float32))
-txt = lowered.as_text()
+rec = sharded_schedule(c.ops, n, False, mesh, engine="banded")
 lower_s = time.time() - t0
-
-# collective_permute ops and their operand element counts (per device)
-cp_elems = []
-for m in re.finditer(r"stablehlo\.collective_permute.*?tensor<([0-9x]+)xf32>",
-                     txt):
-    dims = [int(d) for d in m.group(1).split("x")]
-    e = 1
-    for d in dims:
-        e *= d
-    cp_elems.append(e)
-
-# local band passes from the same plan the engine compiled
-items = F.plan(flatten_ops(c.ops, n, False), n,
-               bands=_shard_bands(n, local_n))
-band_passes = sum(1 for it in items if isinstance(it, F.BandOp)
-                  and it.ql < local_n)
-global_items = sum(1 for it in items if isinstance(it, F.BandOp)
-                   and it.ql >= local_n)
-diag_items = len(items) - band_passes - global_items
 
 print(json.dumps({
     "gates": len(c.ops), "lower_s": round(lower_s, 2),
-    "hlo_bytes": len(txt),
-    "collective_permutes": len(cp_elems),
-    "ici_bytes_per_device_per_step": int(sum(cp_elems) * 4),
-    "local_band_passes": band_passes, "global_qubit_items": global_items,
-    "diag_items": diag_items, "local_n": local_n, "g": g,
+    "collective_permutes": rec["collective_permutes"],
+    "ici_bytes_per_device_per_step": rec["ici_bytes_per_device"],
+    "local_band_passes": rec["local_band_passes"],
+    "global_qubit_items": rec["global_qubit_items"],
+    "local_n": rec["local_qubits"], "g": rec["global_qubits"],
 }))
 '''
 

@@ -13,31 +13,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r'''
 import jax
 jax.config.update("jax_platforms", "cpu")
-import json, re, sys
-import numpy as np, jax.numpy as jnp
+import json, sys
+import numpy as np
 sys.path.insert(0, %(repo)r)
 from jax.sharding import Mesh
-from quest_tpu.circuit import random_circuit, flatten_ops
+from quest_tpu.circuit import random_circuit
 from quest_tpu.env import AMP_AXIS
-from quest_tpu.ops import fusion as F
-from quest_tpu.parallel.sharded import (_shard_bands,
-                                        compile_circuit_sharded_banded)
+from quest_tpu.parallel.introspect import sharded_schedule
 
 n, D = 36, 64
 c = random_circuit(n, depth=2, seed=7, entangler="cz")
 mesh = Mesh(np.array(jax.devices()), (AMP_AXIS,))
-local_n = n - 6
-step = compile_circuit_sharded_banded(c.ops, n, density=False, mesh=mesh,
-                                      donate=False)
-txt = jax.jit(step).lower(
-    jax.ShapeDtypeStruct((2, 1 << n), jnp.float32)).as_text()
-lowered_cp = len(re.findall(r"stablehlo\.collective_permute", txt))
-items = F.plan(flatten_ops(c.ops, n, False), n,
-               bands=_shard_bands(n, local_n))
-planned_global = sum(1 for it in items if isinstance(it, F.BandOp)
-                     and it.ql >= local_n)
-print(json.dumps({"lowered_cp": lowered_cp,
-                  "planned_global": planned_global}))
+rec = sharded_schedule(c.ops, n, False, mesh, engine="banded")
+print(json.dumps({"lowered_cp": rec["collective_permutes"],
+                  "planned_global": rec["global_qubit_items"]}))
 '''
 
 
