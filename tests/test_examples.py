@@ -82,3 +82,61 @@ def test_bit_flip_code_corrects_single_flips():
         v = to_dense(q).reshape(4, 2, 2, 2)
         anc = int(np.asarray(outs)[0]) + 2 * int(np.asarray(outs)[1])
         assert abs(np.vdot(ideal, v[anc])) ** 2 > 1 - 1e-10, flip_q
+
+
+def test_shor_scaled():
+    """Order finding at reduced counting precision (t=6): the phase
+    distribution still concentrates on multiples of 2^t/r and the
+    continued-fraction decode recovers r=4 -> factors 3 x 5."""
+    import math
+
+    import jax
+
+    import quest_tpu as qt
+    from examples.shor_factoring import (mod_mult_matrix,
+                                         order_finding_circuit,
+                                         order_from_phase)
+    from quest_tpu import measurement as meas
+
+    # the permutation matrices really are unitary permutations
+    for b in (7, 4, 13, 1):
+        u = mod_mult_matrix(b, 15, 4)
+        assert np.allclose(u @ u.conj().T, np.eye(16))
+        assert np.all(u.sum(axis=0) == 1)
+
+    t = 6
+    q = order_finding_circuit(7, 15, t, 4).apply_banded(qt.create_qureg(t + 4))
+    shots = np.asarray(meas.sample(q, 64, jax.random.PRNGKey(4)))
+    counting = shots & ((1 << t) - 1)
+    assert np.mean(counting % ((1 << t) // 4) == 0) >= 0.9
+    r = next(o for o in (order_from_phase(int(y), t, 15, 7)
+                         for y in counting if y) if o)
+    assert r == 4
+    assert sorted((math.gcd(7 ** 2 - 1, 15), math.gcd(7 ** 2 + 1, 15))) == [3, 5]
+
+
+def test_qaoa_ansatz_energy_and_gradient():
+    """The QAOA energy is differentiable and one gradient step from a
+    non-stationary point lowers <sum ZZ>; at (0, 0) the |+> state has
+    exactly zero ZZ energy."""
+    import jax
+    import jax.numpy as jnp
+
+    from examples.qaoa_maxcut import EDGES, LAYERS, N, ansatz
+    from quest_tpu import variational as V
+
+    codes, coeffs = [], []
+    for i, j in EDGES:
+        term = [0] * N
+        term[i] = term[j] = 3
+        codes.append(term)
+        coeffs.append(0.5)
+    zz = V.expectation(ansatz, N, codes, coeffs)
+    zero = jnp.zeros(2 * LAYERS, dtype=jnp.float32)
+    assert abs(float(zz(zero))) < 1e-5
+
+    p0 = jnp.asarray([0.2] * LAYERS + [0.3] * LAYERS, dtype=jnp.float32)
+    e0, g = jax.value_and_grad(zz)(p0)
+    assert float(jnp.linalg.norm(g)) > 1e-3
+    e1 = zz(p0 - 0.05 * g)
+    assert float(e1) < float(e0)
