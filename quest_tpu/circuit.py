@@ -569,6 +569,16 @@ class Circuit:
             inv.ops.append(dataclasses.replace(op, operand=operand))
         return inv
 
+    @classmethod
+    def from_qasm(cls, text: str) -> "Circuit":
+        """Parse OPENQASM 2.0 text into a Circuit — the recorder's own
+        dialect (Ctrl- prefixes, U(rz2, ry, rz1) lines) and standard
+        qelib1 gates both load; see quest_tpu/qasm_import.py. The
+        reference has no importer (its QASM support is write-only,
+        QuEST_qasm.c)."""
+        from quest_tpu.qasm_import import circuit_from_qasm
+        return circuit_from_qasm(text)
+
     def to_qasm(self) -> str:
         """OPENQASM 2.0 text of this circuit, through the same logger the
         eager API records with (quest_tpu/qasm.py; ref QuEST_qasm.c).

@@ -1,0 +1,162 @@
+"""Circuit.from_qasm: the recorder's dialect round-trips, standard
+qelib1 text loads, malformed text fails loudly."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuit import Circuit
+from quest_tpu.state import to_dense
+from quest_tpu.validation import QuESTError
+
+
+def _state_of(circ, n, dtype=np.complex128):
+    q = qt.init_debug_state(qt.create_qureg(n, dtype=dtype))
+    return to_dense(circ.apply(q))
+
+
+def _assert_same_up_to_phase(a, b, atol=1e-5):
+    k = int(np.argmax(np.abs(a)))
+    assert abs(a[k]) > 1e-8
+    phase = b[k] / a[k]
+    assert abs(abs(phase) - 1.0) < atol
+    np.testing.assert_allclose(a * phase, b, atol=atol, rtol=0)
+
+
+def test_roundtrip_named_gates():
+    """Named gates, controlled rotations, swaps and controlled phases
+    survive to_qasm -> from_qasm with the same unitary action (up to
+    global phase; angles pass through %g text at ~1e-6)."""
+    n = 4
+    c = Circuit(n)
+    c.h(0).x(1, 2).y(2).z(3).s(1).t(0)
+    c.rx(2, 1.1).ry(3, -0.4).rz(1, 0.5)
+    c.cnot(0, 3).swap(1, 3).sqrt_swap(0, 2)
+    c.cphase(0.7, 0, 1, 2).phase(2, 0.3)
+    c.multi_rotate_z((1,), 0.9)          # single-target parity -> Rz line
+
+    c2 = Circuit.from_qasm(c.to_qasm())
+    _assert_same_up_to_phase(_state_of(c, n), _state_of(c2, n))
+
+
+def test_roundtrip_controlled_on_zero():
+    """The exporter's NOT-conjugation lines for controlled-on-0 gates
+    execute back to the same operation (diagonal-operand case: the
+    emitted text is exact up to global phase)."""
+    n = 3
+    c = Circuit(n)
+    c.h(0).gate(np.diag([1.0, 1.0j]), (1,), controls=(0,), cstates=(0,))
+    qasm = c.to_qasm()
+    assert "NOTing" in qasm
+    c2 = Circuit.from_qasm(qasm)
+    _assert_same_up_to_phase(_state_of(c, n), _state_of(c2, n))
+
+
+def test_controlled_unitary_line_folds_exactly():
+    """A Ctrl-U line + its restore comment + Rz fix-up line fold back
+    into the EXACT controlled unitary the recorder was describing (the
+    fix-up sequence is not an exact gate sequence on its own — the
+    importer recognizes the convention, QuEST_qasm.c:277-298)."""
+    n = 2
+    u = np.array([[0.6, 0.8], [0.8, -0.6]], dtype=complex)  # det = -1
+    c = Circuit(n)
+    c.h(0).gate(u, (1,), controls=(0,))
+    qasm = c.to_qasm()
+    assert "Restoring the discarded global phase" in qasm
+    c2 = Circuit.from_qasm(qasm)
+    _assert_same_up_to_phase(_state_of(c, n), _state_of(c2, n),
+                             atol=1e-4)
+
+
+def test_standard_qelib1_text():
+    text = """
+    OPENQASM 2.0;
+    include "qelib1.inc";
+    qreg r[3];
+    creg m[3];
+    h r[0];
+    cx r[0], r[1];
+    ccx r[0], r[1], r[2];
+    u1(pi/4) r[2];
+    cu1(pi/2) r[0], r[2];
+    u3(pi/2, 0, pi) r[1];   // = H up to phase
+    u2(0, pi) r[0];         // also H
+    sdg r[1];
+    tdg r[2];
+    barrier r;
+    rz(3*pi/4) r[0];
+    cz r[1], r[2];
+    swap r[0], r[2];
+    """
+    c = Circuit.from_qasm(text)
+    assert c.num_qubits == 3
+    # unitary action on a NORMALIZED state stays normalized
+    v = to_dense(c.apply(qt.create_qureg(3, dtype=np.complex128)))
+    assert abs(np.linalg.norm(v) - 1.0) < 1e-10
+
+    # u3/u2 really are Hadamards up to global phase
+    h3 = Circuit.from_qasm("qreg q[1]; u3(pi/2, 0, pi) q[0];")
+    h2 = Circuit.from_qasm("qreg q[1]; u2(0, pi) q[0];")
+    want = _state_of(Circuit(1).h(0), 1)
+    _assert_same_up_to_phase(_state_of(h3, 1), want, atol=1e-10)
+    _assert_same_up_to_phase(_state_of(h2, 1), want, atol=1e-10)
+
+
+def test_measure_and_reset_import():
+    text = """
+    qreg q[2]; creg c[2];
+    h q[0];
+    measure q[0] -> c[0];
+    reset q[1];
+    """
+    c = Circuit.from_qasm(text)
+    kinds = [op.kind for op in c.ops]
+    assert "measure" in kinds
+
+
+def test_import_errors():
+    with pytest.raises(QuESTError, match="no qreg"):
+        Circuit.from_qasm("OPENQASM 2.0;")
+    with pytest.raises(QuESTError, match="unknown QASM gate"):
+        Circuit.from_qasm("qreg q[2]; frob q[0];")
+    with pytest.raises(QuESTError, match="parameter"):
+        Circuit.from_qasm("qreg q[1]; rz(import_os) q[0];")
+    with pytest.raises(QuESTError, match="dynamic-circuit"):
+        Circuit.from_qasm("qreg q[1]; creg c[1]; if (c==1) x q[0];")
+    with pytest.raises(QuESTError, match="control"):
+        Circuit.from_qasm("qreg q[2]; Ctrl-h q[0];")
+
+
+def test_qasm_example_files_roundtrip():
+    """Every circuit the test suite's own exporter check uses also
+    re-imports: parse the tutorial circuit's QASM and re-export it."""
+    c = Circuit(3)
+    c.h(0).cnot(0, 1).ry(2, 0.1).cphase(np.pi, 0, 1, 2)
+    text = c.to_qasm()
+    c2 = Circuit.from_qasm(text)
+    _assert_same_up_to_phase(_state_of(c, 3), _state_of(c2, 3))
+    # re-export of the imported circuit parses again (fixpoint reachable)
+    c3 = Circuit.from_qasm(c2.to_qasm())
+    _assert_same_up_to_phase(_state_of(c2, 3), _state_of(c3, 3))
+
+
+def test_whole_register_statements():
+    """The recorder's initZeroState/initPlusState emissions (`reset q;`,
+    `h q;`) and whole-register measure expand over every qubit."""
+    text = """
+    qreg q[3]; creg c[3];
+    reset q;
+    h q;
+    measure q -> c;
+    """
+    c = Circuit.from_qasm(text)
+    kinds = [op.kind for op in c.ops]
+    assert kinds.count("measure") == 2 * 3  # 3 resets (measure+flip) + 3
+    h_count = sum(1 for op in c.ops
+                  if op.kind == "matrix" and len(op.targets) == 1
+                  and np.allclose(np.abs(np.asarray(op.operand)),
+                                  np.full((2, 2), 1 / np.sqrt(2))))
+    assert h_count == 3
+
+    with pytest.raises(QuESTError, match="operand"):
+        Circuit.from_qasm("qreg q[2]; h r;")
