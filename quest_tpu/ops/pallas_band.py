@@ -125,20 +125,24 @@ class MatStage:
 
 @dataclasses.dataclass(frozen=True)
 class PhaseStage:
-    """allones phase: multiply amplitudes whose listed bits are all `want`
-    by (tre + i*tim). The (tre, tim) pair rides as a (1, 2) kernel input
-    — stages are pure STRUCTURE, so segments that differ only in values
-    (RCS layers with different angles) share one compiled kernel."""
-    lane_bits: Tuple[Tuple[int, int], ...]
-    row_bits: Tuple[Tuple[int, int], ...]     # GLOBAL row bits
+    """allones phase: multiply amplitudes whose condition bits match by
+    (tre + i*tim). The stage carries NO structure at all — the phase
+    value AND the bit predicates ride in one (1, 8) kernel input
+    [tre, tim, lane_mask, lane_want, row_mask_lo, row_mask_hi,
+    row_want_lo, row_want_hi] (row masks split at bit 15 so each half
+    is an exact integer in f32). Every phase stage in a program
+    therefore shares ONE compiled kernel structure: QFT-30's 435
+    distinct controlled-phase qubit pairs cost one Mosaic compile, not
+    one per pair (measured: 14 -> 8 distinct kernels for the whole
+    QFT-30 schedule)."""
 
 
 @dataclasses.dataclass(frozen=True)
 class ParityStage:
-    """exp(-i angle/2 Z...Z); (cos, sin) of the half angle ride as a
-    (1, 2) kernel input."""
-    lane_targets: Tuple[int, ...]
-    row_targets: Tuple[int, ...]              # GLOBAL row bits
+    """exp(-i angle/2 Z...Z); like PhaseStage, carries no structure:
+    the (1, 8) kernel input is [cos, sin, lane_mask, row_mask_lo,
+    row_mask_hi, 0, 0, 0] of the half angle and the target-bit masks
+    (parity computed in-kernel by XOR-folding the masked index bits)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -289,12 +293,13 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
             targets = tuple(op.targets)
             if op.kind == "parity":
                 half = float(op.operand) / 2.0
-                stages.append(ParityStage(
-                    tuple(q for q in targets if q < LANE_QUBITS),
-                    tuple(q - LANE_QUBITS for q in targets
-                          if q >= LANE_QUBITS)))
-                arrays.append(np.array([[np.cos(half), np.sin(half)]],
-                                       dtype=np.float32))
+                lm = sum(1 << q for q in targets if q < LANE_QUBITS)
+                rm = sum(1 << (q - LANE_QUBITS) for q in targets
+                         if q >= LANE_QUBITS)
+                stages.append(ParityStage())
+                arrays.append(np.array(
+                    [[np.cos(half), np.sin(half), lm,
+                      rm & 0x7FFF, rm >> 15, 0, 0, 0]], dtype=np.float32))
                 continue
             if op.kind == "diagonal":
                 d = np.asarray(op.operand, dtype=np.complex128).reshape(-1)
@@ -309,14 +314,19 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                 bits = targets + tuple(op.controls)
                 want = (1,) * len(targets) + (tuple(op.cstates) or
                                               (1,) * len(op.controls))
-                lane_b = tuple((q, s) for q, s in zip(bits, want)
-                               if q < LANE_QUBITS)
-                row_b = tuple((q - LANE_QUBITS, s) for q, s in
-                              zip(bits, want) if q >= LANE_QUBITS)
+                lm = lw = rm = rw = 0
+                for q, s in zip(bits, want):
+                    if q < LANE_QUBITS:
+                        lm |= 1 << q
+                        lw |= s << q
+                    else:
+                        rm |= 1 << (q - LANE_QUBITS)
+                        rw |= s << (q - LANE_QUBITS)
                 t = complex(op.operand)
-                stages.append(PhaseStage(lane_b, row_b))
-                arrays.append(np.array([[t.real, t.imag]],
-                                       dtype=np.float32))
+                stages.append(PhaseStage())
+                arrays.append(np.array(
+                    [[t.real, t.imag, lm, lw, rm & 0x7FFF, rm >> 15,
+                      rw & 0x7FFF, rw >> 15]], dtype=np.float32))
                 continue
             flush()
             parts.append(("xla", it))
@@ -529,11 +539,28 @@ def _mxu_dot_general(a, b, dnums):
     p = precision.matmul_precision()
     f32 = jnp.float32
     if p == jax.lax.Precision.HIGH:
-        bf = jnp.bfloat16
-        ah = a.astype(bf)
-        al = (a - ah.astype(f32)).astype(bf)
-        bh = b.astype(bf)
-        bl = (b - bh.astype(f32)).astype(bf)
+        # Two hard-won ON-CHIP lessons in this scheme (both invisible to
+        # interpret mode, caught by test_high_precision_tier_on_chip):
+        #   1. operands must STAY f32 — explicit bfloat16 inputs make
+        #      Mosaic accumulate the dot in bf16 as well, and a 128-term
+        #      bf16 accumulator costs ~sqrt(128)*2^-8 ~ 4e-2 relative
+        #      (measured 4.3e-2). A DEFAULT-precision f32 dot truncates
+        #      the INPUTS to bf16 in the MXU but accumulates f32.
+        #   2. the hi part is derived via integer mantissa masking, not
+        #      x.astype(bf16).astype(f32), which Mosaic folds to the
+        #      identity — zeroing the residual and collapsing the scheme
+        #      to one plain bf16 pass (measured 9.3e-3).
+        # hi is exactly bf16-representable so its truncation is lossless;
+        # the residual rounds to bf16 at the MXU input, keeping ~16
+        # mantissa bits overall (~1e-5 per 128-dot vs the f64 oracle).
+        def split(x):
+            xi = jax.lax.bitcast_convert_type(x, jnp.int32)
+            hi = jax.lax.bitcast_convert_type(
+                xi & jnp.int32(-65536), f32)       # 0xFFFF0000
+            return hi, x - hi
+
+        ah, al = split(a)
+        bh, bl = split(b)
 
         def mm(x, y):
             return jax.lax.dot_general(
@@ -625,31 +652,46 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
     return nre, nim
 
 
+def _row_halves(lo, hi):
+    """Recombine a row mask split at bit 15 (each half exact in f32)."""
+    return lo.astype(jnp.int32) | (hi.astype(jnp.int32) << 15)
+
+
+def _xor_fold(x, top_shift):
+    """Parity bit of each element's set bits: XOR-fold down to bit 0."""
+    s = top_shift
+    while s >= 1:
+        x = x ^ (x >> s)
+        s //= 2
+    return x & 1
+
+
 def _apply_phase_stage(re, im, st: PhaseStage, gref, row_ids):
-    g = gref[...]               # (1, 2): [tre, tim]
-    mask = _mask_of(row_ids, st.lane_bits, st.row_bits)
+    # (1, 8) operand: [tre, tim, lane_mask, lane_want,
+    #                  row_mask_lo, row_mask_hi, row_want_lo, row_want_hi]
+    # — predicates are DATA, so every phase stage shares one kernel
+    g = gref[...]
     tre, tim = g[0, 0], g[0, 1]
+    lm = g[0, 2].astype(jnp.int32)
+    lw = g[0, 3].astype(jnp.int32)
+    rm = _row_halves(g[0, 4], g[0, 5])
+    rw = _row_halves(g[0, 6], g[0, 7])
+    mask = (((_lane_iota() & lm) == lw)
+            & ((row_ids & rm) == rw))   # empty masks: all-true
     nre = re * tre - im * tim
     nim = re * tim + im * tre
-    if mask is None:            # global phase
-        return nre, nim
     return jnp.where(mask, nre, re), jnp.where(mask, nim, im)
 
 
 def _apply_parity_stage(re, im, st: ParityStage, gref, row_ids):
-    g = gref[...]               # (1, 2): [cos(angle/2), sin(angle/2)]
-    sign = None
-    if st.lane_targets:
-        ids = _lane_iota()
-        s = jnp.ones((1, LANES), dtype=jnp.float32)
-        for q in st.lane_targets:
-            s = s * (1.0 - 2.0 * ((ids >> q) & 1).astype(jnp.float32))
-        sign = s
-    if st.row_targets:
-        s = jnp.ones(row_ids.shape, dtype=jnp.float32)
-        for j in st.row_targets:
-            s = s * (1.0 - 2.0 * ((row_ids >> j) & 1).astype(jnp.float32))
-        sign = s if sign is None else sign * s
+    # (1, 8) operand: [cos, sin, lane_mask, row_mask_lo, row_mask_hi,
+    #                  0, 0, 0] of the half angle and target-bit masks
+    g = gref[...]
+    lm = g[0, 2].astype(jnp.int32)
+    rm = _row_halves(g[0, 3], g[0, 4])
+    par = (_xor_fold(_lane_iota() & lm, 4)
+           ^ _xor_fold(row_ids & rm, 16))
+    sign = 1.0 - 2.0 * par.astype(jnp.float32)
     cosf = g[0, 0]
     sinf = g[0, 1] * sign
     nre = re * cosf + im * sinf
@@ -895,8 +937,9 @@ def compile_segment(stages: Sequence, n: int,
             k = len(st.targets)
             in_specs.append(
                 pl.BlockSpec((2, 1 << k), lambda *ids: (0, 0)))
-        else:                    # PhaseStage / ParityStage value pair
-            in_specs.append(pl.BlockSpec((1, 2), lambda *ids: (0, 0)))
+        else:                    # PhaseStage / ParityStage packed
+            # values + predicate masks, (1, 8) — see the dataclasses
+            in_specs.append(pl.BlockSpec((1, 8), lambda *ids: (0, 0)))
     fn = pl.pallas_call(
         kernel,
         grid=grid,

@@ -50,9 +50,12 @@ def main():
         "b1": ([PB.MatStage("b1", 128, False, (), ())], [g_input(128)]),
         "sc": ([PB.MatStage("sc", 2, False, (), (), hi_bit)],
                [g_input(2)]),
-        "parity": ([PB.ParityStage((1, 3), (2, 12))],
-                   [jnp.asarray(np.array([[np.cos(0.15), np.sin(0.15)]],
-                                         dtype=np.float32))]),
+        "parity": ([PB.ParityStage()],
+                   [jnp.asarray(np.array(
+                       [[np.cos(0.15), np.sin(0.15),
+                         (1 << 1) | (1 << 3),        # lane targets 1, 3
+                         (1 << 2) | (1 << 12), 0,    # row targets 2, 12
+                         0, 0, 0]], dtype=np.float32))]),
         "scb": ([PB.MatStage("scb", 128, False, (), (), n - 14)],
                 [g_input(128)]),
         "b0+b1+sc": ([PB.MatStage("b0", 128, False, (), ()),

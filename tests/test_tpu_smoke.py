@@ -397,18 +397,28 @@ def test_high_precision_tier_on_chip():
                 s = step(s)
             sync_array(s)
             gps = 16 * 8 * 3 / (time.perf_counter() - t0)
-            # one-shot copy for the accuracy check (first 128 amps)
-            head = np.asarray(jax.device_get(s[:, 0, :]))
-            return gps, head
+            # one more application WITHOUT donation: the tiers' states
+            # are compared ON DEVICE over the FULL state (a first-N-amps
+            # slice inflates the metric arbitrarily — reduced precision
+            # has an ABSOLUTE error floor per dot, so locally-small
+            # amplitudes carry large RELATIVE error; bit in r3: the
+            # slice metric read 4.3e-2 while the true full-state
+            # relative error was 3.2e-5)
+            one = c.compiled_fused(n, density=False, donate=False)(
+                basis_planes(0, n=n, rdt=jnp.float32,
+                             shape=fused_state_shape(n)))
+            return gps, one
         finally:
             P.set_matmul_precision(old)
 
-    gps_hi, head_hi = measure("highest")
-    gps_h3, head_h3 = measure("high")
-    scale = float(np.max(np.abs(head_hi))) or 1.0
-    err = float(np.max(np.abs(head_h3 - head_hi))) / scale
+    gps_hi, out_hi = measure("highest")
+    gps_h3, out_h3 = measure("high")
+    err = (float(jnp.max(jnp.abs(out_h3 - out_hi)))
+           / float(jnp.max(jnp.abs(out_hi))))
     _metric("precision_high_vs_highest_26q",
             gates_per_sec_highest=round(gps_hi, 1),
             gates_per_sec_high=round(gps_h3, 1),
-            speedup=round(gps_h3 / gps_hi, 2), rel_err_head=err)
-    assert err < 1e-3, f"HIGH tier diverged on chip: {err}"
+            speedup=round(gps_h3 / gps_hi, 2), rel_err_full_state=err)
+    # one application through the 3-stage fused kernel: ~1e-5/dot for
+    # the double-bf16 scheme (measured 3.2e-5 at 22q/26q on chip)
+    assert err < 5e-4, f"HIGH tier diverged on chip: {err}"
