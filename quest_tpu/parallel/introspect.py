@@ -46,6 +46,7 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
     its communication schedule plus the local plan it rides on. `n` is
     the STATE-qubit count (2x the logical count for density registers),
     matching the compile_circuit_sharded* builders."""
+    from quest_tpu import precision
     from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
     from quest_tpu.parallel import sharded as S
@@ -59,16 +60,20 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
     D = int(mesh.devices.size)
     g = D.bit_length() - 1
     local_n = n - g
+    # lower with the dtype the run would really use (the engines take it
+    # from the input array): byte figures must reflect f64 registers
+    rdt = precision.real_dtype_of(precision.get_default_dtype())
+    bytes_per_real = jnp.dtype(rdt).itemsize
     step = builders[engine](ops, n, density, mesh=mesh, donate=False)
     lowered = jax.jit(step).lower(
-        jax.ShapeDtypeStruct((2, 1 << n), jnp.float32))
+        jax.ShapeDtypeStruct((2, 1 << n), rdt))
     rec = parse_collectives(lowered.as_text())
     rec.update({
         "devices": D,
         "local_qubits": local_n,
         "global_qubits": g,
         "engine": engine,
-        "chunk_bytes": 2 * 4 * (1 << n) // D,
+        "chunk_bytes": 2 * bytes_per_real * (1 << n) // D,
     })
 
     flat = flatten_ops(ops, n, density)
@@ -79,7 +84,14 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
             1 for op in flat if max(op.targets) < local_n)
         rec["global_ops"] = len(flat) - rec["local_ops"]
     else:
-        items = F.plan(flat, n, bands=S._shard_bands(n, local_n))
+        # band layout PER ENGINE, via the engines' own layout helpers so
+        # the reported plan cannot drift from the executed one
+        bands = None
+        if engine == "fused":
+            bands = S.fused_shard_bands(n, local_n)
+        if bands is None:
+            bands = S._shard_bands(n, local_n)
+        items = F.plan(flat, n, bands=bands)
         rec["local_band_passes"] = sum(
             1 for it in items
             if isinstance(it, F.BandOp) and it.ql < local_n)

@@ -420,6 +420,20 @@ def _shard_bands(n: int, local_n: int):
     return bands
 
 
+def fused_shard_bands(n: int, local_n: int):
+    """The FUSED sharded engine's band layout, or None when the Pallas
+    kernel cannot host the chunk (the engine then falls back to the
+    banded layout). Shared by compile_circuit_sharded_fused and
+    parallel.introspect so the reported plan cannot drift from the
+    executed one: local bands follow the kernel's layout, global qubits
+    get width-1 bands so each composes into one 2x2 pair exchange."""
+    from quest_tpu.ops import pallas_band as PB
+    if not PB.usable(local_n):
+        return None
+    return list(PB.plan_bands(local_n)) + [(q, 1)
+                                           for q in range(local_n, n)]
+
+
 def _band_op_sharded(chunk, dev, *, D, local_n, bop):
     """A composed BandOp on the sharded register: local bands apply as one
     in-chunk contraction; width-1 global bands ride the single-qubit pair
@@ -515,14 +529,11 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
     _reject_measure_ops(ops)
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
-    if not PB.usable(local_n):
+    bands = fused_shard_bands(n, local_n)
+    if bands is None:
         return compile_circuit_sharded_banded(ops, n, density, mesh, donate)
 
     flat = flatten_ops(ops, n, density)
-    # local bands follow the kernel's layout; global qubits get width-1
-    # bands so each composes into one 2x2 pair exchange
-    bands = list(PB.plan_bands(local_n)) + [(q, 1)
-                                            for q in range(local_n, n)]
     items = F.plan(flat, n, bands=bands)
 
     def local_only(it) -> bool:

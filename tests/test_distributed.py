@@ -626,3 +626,48 @@ def test_explain_sharded_reports_lowered_schedule(mesh):
     assert rec["collective_permutes"] == count
     assert rec["ici_bytes_per_device"] > 0
     assert rec["devices"] == D
+
+
+def test_sharded_schedule_tracks_dtype_and_fused_layout(mesh):
+    """Byte figures follow the session dtype (an f64 register moves 2x
+    the bytes) and engine='fused' plans over the Pallas kernel's band
+    layout, not the banded engine's."""
+    from quest_tpu import precision
+    from quest_tpu.ops import pallas_band as PB
+    from quest_tpu.parallel import sharded_schedule
+
+    D = int(mesh.devices.size)
+    g = int(np.log2(D))
+    n = 10
+
+    glob = Circuit(n)
+    glob.rx(n - 1, 0.4)
+    f32 = sharded_schedule(glob.ops, n, False, mesh, engine="banded")
+    old = precision.get_default_dtype()
+    precision.set_default_dtype(np.complex128)
+    try:
+        f64 = sharded_schedule(glob.ops, n, False, mesh, engine="banded")
+    finally:
+        precision.set_default_dtype(old)
+    assert f64["chunk_bytes"] == 2 * f32["chunk_bytes"]
+    assert f64["ici_bytes_per_device"] == 2 * f32["ici_bytes_per_device"]
+
+    # fused layout: the report's plan stats must come from the SAME band
+    # layout the fused engine executes (sharded.fused_shard_bands)
+    local_n = n - g
+    if PB.usable(local_n):
+        from quest_tpu.circuit import flatten_ops
+        from quest_tpu.ops import fusion as F
+        from quest_tpu.parallel.sharded import fused_shard_bands
+
+        rec = sharded_schedule(glob.ops, n, False, mesh, engine="fused")
+        assert rec["engine"] == "fused"
+        items = F.plan(flatten_ops(glob.ops, n, False),
+                       n, bands=fused_shard_bands(n, local_n))
+        want_local = sum(1 for it in items
+                         if isinstance(it, F.BandOp) and it.ql < local_n)
+        want_global = sum(1 for it in items
+                          if isinstance(it, F.BandOp) and it.ql >= local_n)
+        assert rec["local_band_passes"] == want_local
+        assert rec["global_qubit_items"] == want_global
+        assert want_global >= 1     # the rx(n-1) really is a global item
