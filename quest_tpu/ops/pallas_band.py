@@ -27,7 +27,8 @@ the rest. Each grid step's block holds:
 Stages inside the block:
   b0   composed 128x128 operator on the lane band: X @ G^T on the MXU
   b1   composed operator on the sublane band (qubits 7..13): cheap
-       (A,d,l)->(d,A,l) relayout, one MXU dot, undo
+       (A,d,l)->(A*l,d) tile relayout, one LARGE-M MXU dot X @ G^T, undo
+       (the (d,A*l) small-m orientation measured +17 ms/pass vs +4)
   scb  composed 2^w x 2^w operator on a HIGH band (qubits 14+): ONE MXU
        dot over the band's w merged scattered axes — a whole layer of
        gates on qubits 14..20 costs one dot instead of 7 serial VPU
@@ -245,7 +246,9 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                 g = it.gre.T + 1j * it.gim.T       # X @ G^T form
             elif it.ql == LANE_QUBITS:
                 kind, bit = "b1", -1
-                g = it.gre + 1j * it.gim
+                # X @ G^T form, pre-transposed on the host like b0's —
+                # the kernel never pays a per-block gate transpose
+                g = (it.gre + 1j * it.gim).T
                 reserve(floor=it.w)
             elif it.w == 1:
                 kind, bit = "sc", it.ql - LANE_QUBITS
@@ -406,7 +409,7 @@ def _try_pair_stage(it, scatter_max):
             if op_loc == "lane":
                 emb = _embed_2x2(sub, q_op).T            # X @ G^T form
             elif op_loc == "sub":
-                emb = _embed_2x2(sub, q_op - LANE_QUBITS)
+                emb = _embed_2x2(sub, q_op - LANE_QUBITS).T  # X @ G^T form
             else:
                 emb = sub
             blocks[0, r * 2 + c] = emb.real.astype(np.float32)
@@ -529,10 +532,11 @@ def _mxu_dot_general(a, b, dnums):
 
     HIGHEST (default): one f32 dot = 6 bf16 MXU passes, ~3e-7 relative
     error — full f32, matches the reference's PRECISION=1 envelope.
-    HIGH: the double-bf16 3-pass scheme (a = a_hi + a_lo rounded to
-    bf16, keep the three highest-order products, f32 accumulation) —
-    HALF the MXU passes of HIGHEST at ~5e-6 relative error per dot
-    (measured against an f64 oracle; docs/PRECISION.md). Mosaic does not
+    HIGH: the double-bf16 3-pass scheme (a = a_hi + a_lo split by
+    integer mantissa masking, keep the three highest-order products,
+    f32 accumulation) — HALF the MXU passes of HIGHEST at ~2.3e-5
+    relative error per 128-dot (measured ON CHIP against an f64
+    oracle; docs/PRECISION.md). Mosaic does not
     lower Precision.HIGH, so the split is done explicitly here; XLA's
     own bf16_3x does the same thing on the banded/per-gate paths.
     DEFAULT: one bf16 pass, ~1e-3 — exposed but not recommended."""
@@ -576,15 +580,42 @@ _DN_2D = (((1,), (0,)), ((), ()))   # plain 2-D matmul dimension numbers
 
 def _sublane_contract(d):
     """Contraction over the lowest log2(d) row bits of an (R, LANES)
-    block: cheap (A, d, l) -> (d, A, l) relayout, one MXU dot, undo.
-    Shared by the b1 MatStage and b1-op PairStage paths."""
+    block, in the b0-SHAPED frame: (A, d, l) -> (A*l, d) via the cheap
+    (0,2,1) tile transpose, one LARGE-M MXU dot x @ G^T, undo. The
+    (d, A*l) small-m orientation costs ~30% of a whole pass in MXU
+    inefficiency (measured 49.9 -> 38.5 ms/pass at 30q for b1).
+    Expects gg PRE-TRANSPOSED (X @ G^T form, packed host-side).
+    Used by the b1-op PairStage path (Kraus superoperators)."""
     def contract(gg, x):
         rows = x.size // LANES
         a = rows // d
-        xt = x.reshape(a, d, LANES).transpose(1, 0, 2).reshape(d, a * LANES)
-        out = _mxu_dot_general(gg, xt, _DN_2D)
-        return out.reshape(d, a, LANES).transpose(1, 0, 2).reshape(x.shape)
+        xt = (x.reshape(a, d, LANES).transpose(0, 2, 1)
+              .reshape(a * LANES, d))
+        out = _mxu_dot_general(xt, gg, _DN_2D)
+        return (out.reshape(a, LANES, d).transpose(0, 2, 1)
+                .reshape(x.shape))
     return contract
+
+
+def _framed_cdot(to_frame, from_frame, re, im, gre, gim, real_only,
+                 right=False):
+    """Hoist the contraction frame change OUT of the Gauss trick: _cdot
+    invokes its contraction three times (t1, t2, t3), so a
+    frame-changing contract would pay its relayouts per invocation.
+    One frame change in, three plain MXU dots, one frame change out.
+    right=True contracts as X @ G (the caller passes G pre-transposed)
+    — the large-m orientation the MXU wants."""
+    fre, fim = to_frame(re), to_frame(im)
+
+    if right:
+        def contract(gg, xt):
+            return _mxu_dot_general(xt, gg, _DN_2D)
+    else:
+        def contract(gg, xt):
+            return _mxu_dot_general(gg, xt, _DN_2D)
+
+    nre, nim = _cdot(contract, fre, fim, gre, gim, real_only)
+    return from_frame(nre), from_frame(nim)
 
 
 def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
@@ -597,8 +628,22 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
             return _mxu_dot_general(x, gg, _DN_2D)
         nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
     elif st.kind == "b1":
-        contract = _sublane_contract(st.dim)
-        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+        # contract in the b0-SHAPED frame (large-m dot (a*l, d) @ G^T):
+        # the (d, a*l) orientation costs ~30% of a whole pass in MXU
+        # inefficiency (measured 49.9 -> 38.5 ms/pass at 30q — the
+        # lane<->sublane tile transpose is cheap, the small-m dot is not)
+        d = st.dim
+        a = rows // d
+
+        def to_frame(x):
+            return (x.reshape(a, d, LANES).transpose(0, 2, 1)
+                    .reshape(a * LANES, d))
+
+        def from_frame(x):
+            return (x.reshape(a, LANES, d).transpose(0, 2, 1)
+                    .reshape(rows, LANES))
+        nre, nim = _framed_cdot(to_frame, from_frame, re, im,
+                                gre, gim, st.real_only, right=True)
     elif st.kind == "scb":
         # composed high-band operator: ONE dot over the merged scattered
         # axes (they are adjacent row dims of the block — the scat tuple
@@ -613,16 +658,22 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
         pre = 1 << p
         post = (rows >> (p + w)) * LANES
 
-        def contract(gg, x):
-            if pre == 1:
-                xt = x.reshape(d, post)
-                out = _mxu_dot_general(gg, xt, _DN_2D)
-                return out.reshape(x.shape)
-            xt = x.reshape(pre, d, post).transpose(1, 0, 2)
-            out = _mxu_dot_general(gg, xt.reshape(d, pre * post), _DN_2D)
-            return (out.reshape(d, pre, post).transpose(1, 0, 2)
-                    .reshape(x.shape))
-        nre, nim = _cdot(contract, re, im, gre, gim, st.real_only)
+        if pre == 1:
+            def to_frame(x):
+                return x.reshape(d, post)
+
+            def from_frame(x):
+                return x.reshape(rows, LANES)
+        else:
+            def to_frame(x):
+                return (x.reshape(pre, d, post).transpose(1, 0, 2)
+                        .reshape(d, pre * post))
+
+            def from_frame(x):
+                return (x.reshape(d, pre, post).transpose(1, 0, 2)
+                        .reshape(rows, LANES))
+        nre, nim = _framed_cdot(to_frame, from_frame, re, im, gre, gim,
+                                st.real_only)
     else:                        # 'sc': butterfly on one scattered axis
         a = geo.scat.index(st.bit)
         pre = 1 << a

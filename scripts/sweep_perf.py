@@ -58,11 +58,11 @@ if mode == "segment":
         arrays = [jnp.asarray(g)]
     fn = PB.compile_segment(stages, n)
     jfn = jax.jit(lambda a: fn(a, arrays), donate_argnums=(0,))
-    from quest_tpu.state import basis_planes
+    from quest_tpu.state import basis_planes, fused_state_shape
     # ONE fused device buffer: zeros().at.set() would briefly hold two
     # full states (16 GB at 30q -> guaranteed OOM on a 15.75 GiB v5e)
     amps = basis_planes(0, n=n, rdt=jnp.float32,
-                        shape=(2, 1 << (n - 7), 128))
+                        shape=fused_state_shape(n))
     amps = jfn(amps)
     _ = np.asarray(amps[0, 0, :4])
     t0 = time.perf_counter()
