@@ -209,6 +209,51 @@ def _apply_op(amps, n, density, op: GateOp):
     return amps
 
 
+def _estimate_ms(parts, n):
+    """(lo, hi) estimated steady-state ms per application on one v5e,
+    from the measured 30q cost model (docs/KERNELS.md): a pass streams
+    at the chip's real 461 GB/s in-place rate, and each MatStage adds
+    MXU time proportional to its dot dim (~25 ms for a complex 128-dot
+    at HIGHEST at 30q). How much MXU time hides under the DMA window
+    varies with stacking (measured: single-stage segments hide almost
+    all of it, the 3-stage bench segment almost none), so the honest
+    answer is the [max(DMA, compute), DMA + compute] range — the
+    measured bench application (79.9 ms) sits inside its [53, 87]."""
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+
+    scale = (1 << n) / (1 << 30)
+    base = 34.7                    # ms per HBM pass at 30q (16 GiB)
+
+    def compute_ms(st):
+        if isinstance(st, PB.MatStage):
+            d = st.dim if st.kind in ("scb", "b1") else 128
+            if st.kind == "sc":
+                return 5.0         # elementwise butterfly, VPU-bound
+            dot = 25.0 * d / 128.0
+            return dot * (2 / 3 if st.real_only else 1.0)
+        if isinstance(st, PB.PairStage):
+            return 12.0
+        # phase / parity / diagvec: full-block elementwise + masks —
+        # calibrated on QFT-30 (~5.5 ms per stage at 30q: 14 passes of
+        # ~32 phases measured 3.11 s steady)
+        return 5.5
+
+    lo = hi = 0.0
+    for part in parts:
+        if part[0] == "segment":
+            comp = sum(compute_ms(st) for st in part[1])
+            lo += max(base, comp)
+            hi += base + comp
+        else:
+            # XLA band passthrough: 1.6-2x the state bytes
+            it = part[1]
+            mult = 1.8 if isinstance(it, F.BandOp) else 1.0
+            lo += base * mult
+            hi += base * mult
+    return lo * scale, hi * scale
+
+
 def _human_bytes(b: int) -> str:
     if b >= 2**29:
         return f"{b / 2**30:.2f} GiB"
@@ -879,6 +924,11 @@ class Circuit:
             f"({_human_bytes(moved)} moved per application at {n}q), "
             f"{sum(1 for p in parts if p[0] == 'segment')} segments, "
             f"{len(kernels)} distinct kernels")
+        lo, hi = _estimate_ms(parts, n)
+        lines.append(
+            f"  estimated steady state on one v5e: {lo:.1f}-{hi:.1f} ms "
+            f"per application at HIGHEST (measured cost model, "
+            f"docs/KERNELS.md)")
         return "\n".join(lines)
 
     def explain_sharded(self, mesh, density: bool = False,

@@ -206,3 +206,51 @@ def test_fuzz_high_precision_tier(seed):
         P.set_matmul_precision(old)
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=0,
                                err_msg=f"high-tier seed={seed}")
+
+
+def test_fuzz_qasm_roundtrip():
+    """Random circuits over the QASM-expressible op vocabulary survive
+    to_qasm -> from_qasm with the same action up to global phase (%g
+    angle text costs ~1e-6/gate)."""
+    import numpy as np
+
+    import quest_tpu as qt
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.state import to_dense
+
+    n = 6
+    for seed in range(8):
+        rng = np.random.default_rng(100 + seed)
+        c = Circuit(n)
+        for _ in range(25):
+            kind = rng.integers(0, 8)
+            q = int(rng.integers(0, n))
+            q2 = int((q + 1 + rng.integers(0, n - 1)) % n)
+            ang = float(rng.uniform(0, 2 * np.pi))
+            if kind == 0:
+                c.h(q)
+            elif kind == 1:
+                c.rx(q, ang)
+            elif kind == 2:
+                c.ry(q, ang)
+            elif kind == 3:
+                c.rz(q, ang)
+            elif kind == 4:
+                c.cnot(q, q2)
+            elif kind == 5:
+                c.cphase(ang, q, q2)
+            elif kind == 6:
+                c.swap(q, q2)
+            else:
+                c.gate(np.diag([1.0, np.exp(1j * ang)]), (q,),
+                       controls=(q2,))
+        c2 = Circuit.from_qasm(c.to_qasm())
+        q0 = qt.init_debug_state(qt.create_qureg(n, dtype=np.complex128))
+        a = to_dense(c.apply(q0))
+        b = to_dense(c2.apply(q0))
+        k = int(np.argmax(np.abs(a)))
+        ph = a[k] / b[k]
+        assert abs(abs(ph) - 1) < 1e-5, seed
+        scale = float(np.max(np.abs(a)))
+        err = float(np.max(np.abs(b * ph - a))) / scale
+        assert err < 1e-4, (seed, err)

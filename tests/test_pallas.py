@@ -505,3 +505,28 @@ def test_explain_reports_schedule_without_compiling():
     dyn.measure(0)
     with pytest.raises(Exception):
         dyn.explain()
+
+
+def test_explain_estimate_brackets_measurements():
+    """The steady-state estimate line exists and its range is anchored
+    to the measured cost model: the 30q bench application's range must
+    bracket the on-chip measurement (79.9 ms, benchmarks/
+    measured_tpu.json), scaled by state size."""
+    import re
+
+    rng = np.random.default_rng(42)
+    c = Circuit(30)
+    for i in range(16):
+        c.rx(1 + i % 29, float(rng.uniform(0, 2 * np.pi)))
+    text = c.explain()
+    m = re.search(r"estimated steady state on one v5e: "
+                  r"([0-9.]+)-([0-9.]+) ms", text)
+    assert m, text
+    lo, hi = float(m.group(1)), float(m.group(2))
+    assert lo <= 79.9 <= hi * 1.1, (lo, hi)
+    # the estimate scales with state size: 2x amps -> ~2x time
+    c29 = Circuit(29)
+    for i in range(16):
+        c29.rx(1 + i % 28, float(rng.uniform(0, 2 * np.pi)))
+    m29 = re.search(r"([0-9.]+)-([0-9.]+) ms", c29.explain())
+    assert abs(float(m29.group(1)) * 2 - lo) < 0.2 * lo
