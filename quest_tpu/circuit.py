@@ -217,8 +217,11 @@ def _estimate_ms(parts, n):
     at HIGHEST at 30q). How much MXU time hides under the DMA window
     varies with stacking (measured: single-stage segments hide almost
     all of it, the 3-stage bench segment almost none), so the honest
-    answer is the [max(DMA, compute), DMA + compute] range — the
-    measured bench application (79.9 ms) sits inside its [53, 87]."""
+    answer is the [max(DMA, compute), DMA + compute] range, good to
+    ~5% at the edges — the measured bench application (79.9 ms) sits
+    inside its [53, 87], and a lone mirrored scb-128 pass (34.0 ms)
+    sits at lo (its dot hides fully when alone but still consumes MXU
+    time in stacked segments, so it stays charged)."""
     from quest_tpu.ops import fusion as F
     from quest_tpu.ops import pallas_band as PB
 

@@ -284,6 +284,13 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                     parts.append(("xla", it))
                     continue
             real_only = bool(np.all(g.imag == 0.0))
+            if kind == "scb" and g.shape[0] == LANES:
+                # X @ G^T form for the full-width band, matching the
+                # kernel's large-d mirrored frame (small d keeps the
+                # left-dot: its dot is cheap and the 8<->128 tile swaps
+                # of the mirror are not — measured 538 ms/application
+                # when applied to a d=8 stage)
+                g = g.T
             stages.append(MatStage(kind, g.shape[0], real_only, lane_p,
                                    row_p, bit))
             # keep operator arrays HOST-side (numpy): as closure
@@ -648,7 +655,7 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
         # composed high-band operator: ONE dot over the merged scattered
         # axes (they are adjacent row dims of the block — the scat tuple
         # is bit-descending, so the merged index's MSB is the band's top
-        # qubit, matching the operator's index convention)
+        # qubit, matching the operator's index convention).
         d = st.dim
         w = d.bit_length() - 1
         p = geo.scat.index(st.bit + w - 1)
@@ -656,24 +663,51 @@ def _apply_mat_stage(re, im, st: MatStage, gref, geo: _Geometry, row_ids):
             range(st.bit + w - 1, st.bit - 1, -1)), \
             (geo.scat, st.bit, w)
         pre = 1 << p
-        post = (rows >> (p + w)) * LANES
+        post = rows >> (p + w)
 
-        if pre == 1:
+        if d == LANES:
+            # full-width band: contract in the b0-shaped LARGE-M frame,
+            # reached by TWO cheap-class transposes — a row-only swap
+            # then a sublane<->lane tile swap. The direct (d, rest*l)
+            # small-m dot measured 46.3 ms/pass at 30q and the
+            # single-permutation mirror 61.4 (the fused lane<->leading
+            # transpose is the expensive kind); the two-step route runs
+            # at the 34.0 ms pass baseline. Operand arrives
+            # pre-transposed (X @ G^T form).
             def to_frame(x):
-                return x.reshape(d, post)
+                v = x.reshape(pre, d, post, LANES)
+                v = v.transpose(0, 2, 1, 3)    # row-only swap
+                v = v.transpose(0, 1, 3, 2)    # sublane<->lane tile swap
+                return v.reshape(pre * post * LANES, d)
 
             def from_frame(x):
-                return x.reshape(rows, LANES)
+                v = x.reshape(pre, post, LANES, d)
+                v = v.transpose(0, 1, 3, 2)
+                v = v.transpose(0, 2, 1, 3)
+                return v.reshape(rows, LANES)
+            nre, nim = _framed_cdot(to_frame, from_frame, re, im,
+                                    gre, gim, st.real_only, right=True)
         else:
-            def to_frame(x):
-                return (x.reshape(pre, d, post).transpose(1, 0, 2)
-                        .reshape(d, pre * post))
+            # narrow band: the left-dot is already cheap (cost scales
+            # with d) and the mirror's d<->128 tile swaps are NOT
+            # (measured: 538 ms/application on a d=8 stage, padding-
+            # heavy relayouts); keep the transpose-free frame
+            if pre == 1:
+                def to_frame(x):
+                    return x.reshape(d, post * LANES)
 
-            def from_frame(x):
-                return (x.reshape(d, pre, post).transpose(1, 0, 2)
-                        .reshape(rows, LANES))
-        nre, nim = _framed_cdot(to_frame, from_frame, re, im, gre, gim,
-                                st.real_only)
+                def from_frame(x):
+                    return x.reshape(rows, LANES)
+            else:
+                def to_frame(x):
+                    return (x.reshape(pre, d, post * LANES)
+                            .transpose(1, 0, 2).reshape(d, -1))
+
+                def from_frame(x):
+                    return (x.reshape(d, pre, post * LANES)
+                            .transpose(1, 0, 2).reshape(rows, LANES))
+            nre, nim = _framed_cdot(to_frame, from_frame, re, im,
+                                    gre, gim, st.real_only)
     else:                        # 'sc': butterfly on one scattered axis
         a = geo.scat.index(st.bit)
         pre = 1 << a
