@@ -83,6 +83,19 @@ def main():
     except Exception as e:
         print(f"[prewarm] qft 30q FAILED: {e!r}", file=sys.stderr)
 
+    # the driver's entry() compile-check program (28q depth-4 banded
+    # trace): not covered by any of the above — banded 28q compiles cost
+    # minutes cold and the driver should pay a cache load instead
+    t0 = time.perf_counter()
+    try:
+        import __graft_entry__ as g
+        fn, args = g.entry()
+        jax.jit(fn).lower(*args).compile()
+        print(f"[prewarm] graft entry: {time.perf_counter()-t0:.1f}s",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[prewarm] graft entry FAILED: {e!r}", file=sys.stderr)
+
 
 if __name__ == "__main__":
     main()
