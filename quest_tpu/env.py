@@ -120,6 +120,21 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
         # rebound after init — nothing useful to do but report.
         return jax.devices()[0].platform
 
+    # A cpu-FIRST in-process platform config (tests' conftest, CPU
+    # cross-check scripts via jax.config.update) beats any probe: the
+    # container's sitecustomize re-forces JAX_PLATFORMS=axon in child
+    # processes, so a subprocess probe reports the tunnel's platform
+    # even when THIS process is pinned to cpu — entry() would then hand
+    # back a Pallas program a cpu backend cannot run (caught round 3 by
+    # the graft-entry suite test). The default config ('axon,cpu',
+    # mirroring the env) is not cpu-first and still probes.
+    try:
+        cfg_first = (jax.config.jax_platforms or "").split(",")[0]
+    except Exception:
+        cfg_first = ""
+    if cfg_first == "cpu":
+        return "cpu"
+
     import os
 
     # Tunneled (axon) backends ride a local TCP relay; when its port is

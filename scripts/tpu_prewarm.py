@@ -83,11 +83,14 @@ def main():
     except Exception as e:
         print(f"[prewarm] qft 30q FAILED: {e!r}", file=sys.stderr)
 
-    # the driver's entry() compile-check program (28q depth-4 banded
-    # trace): not covered by any of the above — banded 28q compiles cost
-    # minutes cold and the driver should pay a cache load instead
+    # the driver's entry() compile-check program (28q depth-4 RCS on
+    # the fused engine): not covered by any of the above — it is a
+    # different circuit than the bench/RCS programs, and the driver
+    # should pay a cache load, not a fresh compile
     t0 = time.perf_counter()
     try:
+        import jax
+
         import __graft_entry__ as g
         fn, args = g.entry()
         jax.jit(fn).lower(*args).compile()
