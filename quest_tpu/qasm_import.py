@@ -19,7 +19,13 @@ migration on-ramp it never had. Two dialects are accepted:
     both exact and faithful to intent);
   * standard qelib1 gates: ``cx/cz/ccx/cswap/cu1/crz/u1/u2/u3/id/
     sdg/tdg`` plus ``barrier`` (ignored) and ``pi``-arithmetic in
-    parameters (``rz(pi/4)``).
+    parameters (``rz(pi/4)``). Lowercase ``u(theta,phi,lambda)`` is
+    the qelib1 u3 convention; dispatch is CASE-SENSITIVE because the
+    recorder's capitalized ``U(rz2,ry,rz1)`` names a different
+    convention with the same letter. The OPENQASM builtin capital
+    ``U(theta,phi,lambda)`` is recognized per file: an ``include``
+    line with no recorder markers (``Ctrl-`` prefixes / restore
+    comments) switches capital U to the spec (u3) order.
 
 Round-trip guarantee: ``from_qasm(c.to_qasm())`` applies the same
 unitary as ``c`` up to global phase (angles pass through %g text at
@@ -141,25 +147,67 @@ def _tokenize(text: str):
     return items
 
 
-def _parse_gate_head(stmt: str):
-    """(name_lower, params, nctrl, qubit_indices, reg_names) of a gate
-    statement."""
+def _split_head(stmt: str):
+    """(head, rest) of a gate statement, head normalized to
+    ``name(params)`` / ``name``. The QASM lexer permits arbitrary
+    whitespace between tokens — ``rz(pi/2)q[0];``, ``rz (pi/2) q[0];``
+    and ``rz(pi/2) q[0];`` are all legal — so when a ``(`` appears and
+    everything before it is a single bare name, the head ends at the
+    MATCHING close paren (depth-counted: parameters may themselves
+    parenthesize, ``rz(2*(1+1))``). Operand lists never contain parens,
+    so a ``(`` always opens the parameter list. Otherwise the head is
+    the first space-separated token."""
+    op = stmt.find("(")
+    pre = stmt[:op].strip() if op != -1 else ""
+    if op != -1 and pre and not re.search(r"\s", pre):
+        depth = 0
+        for j in range(op, len(stmt)):
+            if stmt[j] == "(":
+                depth += 1
+            elif stmt[j] == ")":
+                depth -= 1
+                if depth == 0:
+                    return pre + stmt[op:j + 1], stmt[j + 1:]
+        raise QuESTError(f"unbalanced parentheses in: {stmt!r}")
     head, _, rest = stmt.partition(" ")
-    if "(" in head and ")" not in head:
-        close = stmt.index(")")
-        head, rest = stmt[:close + 1], stmt[close + 1:]
+    return head, rest
+
+
+def _split_params(ptext: str):
+    """Top-level comma split of a parameter list body (depth-aware, so
+    ``2*(1+1), pi`` yields two items)."""
+    out, depth, start = [], 0, 0
+    for j, ch in enumerate(ptext):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(ptext[start:j])
+            start = j + 1
+    out.append(ptext[start:])
+    return [p for p in out if p.strip()]
+
+
+def _parse_gate_head(stmt: str):
+    """(name_lower, params, nctrl, qubit_indices, reg_names, raw_name)
+    of a gate statement. ``raw_name`` preserves case: the recorder
+    dialect's ``U`` and qelib1's ``u`` name DIFFERENT conventions and
+    are dispatched case-sensitively by the caller."""
+    head, rest = _split_head(stmt)
     name, params = head, []
     if "(" in head:
         name, ptext = head.split("(", 1)
-        params = [_eval_param(p) for p in
-                  ptext.rstrip(")").split(",") if p.strip()]
+        if ptext.endswith(")"):
+            ptext = ptext[:-1]
+        params = [_eval_param(p) for p in _split_params(ptext)]
     nctrl = 0
     while name.lower().startswith("ctrl-"):
         nctrl += 1
         name = name[len("ctrl-"):]
     operands = _OPERAND.findall(rest)
     return (name.lower(), params, nctrl,
-            [int(i) for _, i in operands], [r for r, _ in operands])
+            [int(i) for _, i in operands], [r for r, _ in operands], name)
 
 
 def _qubit_operands(rest, qreg_name, circ, stmt):
@@ -180,11 +228,16 @@ def _qubit_operands(rest, qreg_name, circ, stmt):
 
 
 def _is_uncontrolled_rz(item):
+    """(angle, qubit) of an uncontrolled single-qubit Rz statement, else
+    None. The caller checks the qubit against the preceding controlled
+    line's target — the recorder always applies its fix-up there
+    (qasm.py record_gate/record_unitary; ref QuEST_qasm.c:252-298) — so
+    a foreign file with a coincidental restore comment is not folded."""
     if item is None or item[0] != "stmt":
         return None
-    name, params, nctrl, qubits, _ = _parse_gate_head(item[1])
+    name, params, nctrl, qubits, _, _ = _parse_gate_head(item[1])
     if name == "rz" and nctrl == 0 and len(params) == 1 and len(qubits) == 1:
-        return params[0]
+        return params[0], qubits[0]
     return None
 
 
@@ -203,6 +256,22 @@ def circuit_from_qasm(text: str):
     items = _tokenize(text)
     circ = None
     qreg_name = None
+
+    # Capital-U dialect disambiguation: the recorder's ``U(rz2,ry,rz1)``
+    # and the OPENQASM 2.0 builtin ``U(theta,phi,lambda)`` collide on
+    # the same letter with different parameter orders. The recorder
+    # never emits ``include``; spec/qelib1 files never emit ``Ctrl-``
+    # prefixes or restore comments. A file carrying an include and no
+    # recorder markers reads capital U as the spec builtin (= u3);
+    # anything else — in particular every recorder/reference export —
+    # keeps the ZYZ dialect, preserving the round-trip guarantee.
+    has_include = any(k == "stmt" and s.lower().startswith("include")
+                      for k, s in items)
+    has_recorder_marker = any(
+        (k == "stmt" and s.lower().startswith("ctrl-"))
+        or (k == "comment" and _RESTORE_MARK in s)
+        for k, s in items)
+    spec_builtin_u = has_include and not has_recorder_marker
 
     def need_circuit():
         if circ is None:
@@ -251,7 +320,7 @@ def circuit_from_qasm(text: str):
                 need_circuit().reset(q)
             continue
 
-        name, params, nctrl, qubits, regs = _parse_gate_head(stmt)
+        name, params, nctrl, qubits, regs, raw_name = _parse_gate_head(stmt)
         if name not in _GATES:
             raise QuESTError(f"unknown QASM gate {name!r} in {stmt!r}")
         want_params, base_qubits = _GATES[name]
@@ -264,11 +333,9 @@ def circuit_from_qasm(text: str):
         if (not qubits and nctrl == 0 and _GATES[name][1] == 1
                 and name not in _COMPOUND_CONTROLS):
             # whole-register 1q gate, e.g. the recorder's `h q;` for
-            # initPlusState (qasm.record_init_plus): one gate per qubit
-            # (re-queued as indexed statements; the bare operand is the
-            # final space-separated token, so params keep their spaces)
-            cut = stmt.rstrip().rfind(" ")
-            head, rest = stmt[:cut].strip(), stmt[cut:]
+            # initPlusState (qasm.record_init_plus): one gate per qubit,
+            # re-queued as indexed statements (head keeps its params)
+            head, rest = _split_head(stmt)
             for q in reversed(_qubit_operands(rest, qreg_name,
                                               need_circuit(), stmt)):
                 items.insert(i, ("stmt", f"{head} {qreg_name}[{q}]"))
@@ -292,15 +359,27 @@ def circuit_from_qasm(text: str):
         # --- recorder-convention folding -------------------------------
         # a restore comment + uncontrolled Rz fix-up after a controlled
         # Rz/U line identifies the exporter's controlled-phase /
-        # controlled-unitary convention; fold back to the source gate
+        # controlled-unitary convention; fold back to the source gate.
+        # The fold only fires when the fix-up matches the recorder's
+        # actual convention — Rz on the SAME target, and (for the phase
+        # case) angle == param/2 — so a foreign file with a coincidental
+        # comment falls through to literal interpretation.
         restore_phase = None
-        if (controls and name in ("rz", "u")
+        recorder_u = raw_name == "U" and not spec_builtin_u
+        if (controls and (name == "rz" or recorder_u)
                 and i < len(items) and items[i][0] == "comment"
                 and _RESTORE_MARK in items[i][1]):
-            restore_phase = _is_uncontrolled_rz(
+            fix = _is_uncontrolled_rz(
                 items[i + 1] if i + 1 < len(items) else None)
-            if restore_phase is not None:
-                i += 2          # consume the comment and the fix-up line
+            if fix is not None:
+                fix_angle, fix_qubit = fix
+                matches = fix_qubit == qubits[-1] and (
+                    name != "rz"
+                    or math.isclose(fix_angle, params[0] / 2.0,
+                                    rel_tol=1e-5, abs_tol=1e-9))
+                if matches:
+                    restore_phase = fix_angle
+                    i += 2      # consume the comment and the fix-up line
         if restore_phase is not None and name == "rz":
             # qasm_recordControlledParamGate: controlled PHASE SHIFT of
             # angle = the Ctrl-Rz parameter (fix-up was angle/2)
@@ -353,7 +432,14 @@ def circuit_from_qasm(text: str):
         elif name in ("rz", "crz"):
             mat = _rz(params[0])
         elif name == "u":
-            mat = _u_zyz(*params)
+            # case-sensitive dispatch: the recorder (and the reference
+            # logger it mirrors) emits capitalized ``U(rz2,ry,rz1)``
+            # meaning Rz@Ry@Rz with no phase factor, while qelib1's
+            # lowercase ``u(theta,phi,lambda)`` is u3 — same letter,
+            # different convention, different unitary. Spec files
+            # (include + no recorder markers) read capital U as the
+            # builtin, i.e. the u3 order.
+            mat = _u_zyz(*params) if recorder_u else _u3(*params)
         elif name == "u3":
             mat = _u3(*params)
         elif name == "u2":

@@ -160,3 +160,99 @@ def test_whole_register_statements():
 
     with pytest.raises(QuESTError, match="operand"):
         Circuit.from_qasm("qreg q[2]; h r;")
+
+
+def test_lowercase_u_is_qelib1_u3():
+    """qelib1's lowercase ``u(theta,phi,lambda)`` is the u3 convention;
+    only the recorder's capitalized ``U(rz2,ry,rz1)`` names the ZYZ
+    dialect. ``u(pi/2, 0, pi)`` must import as a Hadamard, exactly like
+    u3 — not as the recorder-dialect diagonal."""
+    want = _state_of(Circuit(1).h(0), 1)
+    got = Circuit.from_qasm("qreg q[1]; u(pi/2, 0, pi) q[0];")
+    _assert_same_up_to_phase(_state_of(got, 1), want, atol=1e-10)
+    # and the recorder's capital U still means Rz@Ry@Rz: U(0, pi/2, 0)
+    # is Ry(pi/2), whose action on |0> is (|0>+|1>)/sqrt(2)
+    ry = Circuit.from_qasm("qreg q[1]; U(0, pi/2, 0) q[0];")
+    got = to_dense(ry.apply(qt.create_qureg(1, dtype=np.complex128)))
+    _assert_same_up_to_phase(got, np.array([1, 1]) / np.sqrt(2),
+                             atol=1e-10)
+
+
+def test_restore_fold_requires_matching_fixup():
+    """A foreign file with a coincidental restore comment is NOT folded:
+    the fix-up Rz must target the controlled line's target qubit and
+    (for the phase case) carry angle/2."""
+    # fix-up on the WRONG qubit: interpret both lines literally
+    text = ("qreg q[2];\n"
+            "Ctrl-Rz(0.8) q[0],q[1];\n"
+            "// Restoring the discarded global phase of nothing\n"
+            "Rz(0.4) q[0];\n")
+    c = Circuit.from_qasm(text)
+    lit = Circuit(2)
+    lit.gate(np.diag([np.exp(-0.4j), np.exp(0.4j)]), (1,), controls=(0,))
+    lit.rz(0, 0.4)
+    np.testing.assert_allclose(_state_of(c, 2), _state_of(lit, 2),
+                               atol=1e-6)
+
+    # fix-up with the WRONG angle: also literal
+    text = ("qreg q[2];\n"
+            "Ctrl-Rz(0.8) q[0],q[1];\n"
+            "// Restoring the discarded global phase of nothing\n"
+            "Rz(0.1) q[1];\n")
+    c = Circuit.from_qasm(text)
+    lit = Circuit(2)
+    lit.gate(np.diag([np.exp(-0.4j), np.exp(0.4j)]), (1,), controls=(0,))
+    lit.rz(1, 0.1)
+    np.testing.assert_allclose(_state_of(c, 2), _state_of(lit, 2),
+                               atol=1e-6)
+
+    # the real convention still folds (round-trip unchanged)
+    good = Circuit(2)
+    good.cphase(0.8, 0, 1)
+    c2 = Circuit.from_qasm(good.to_qasm())
+    assert [op.kind for op in c2.ops] == ["allones"]   # folded, not literal
+
+
+def test_no_space_after_params():
+    """``rz(pi/2)q[0];`` (legal QASM whitespace) parses — the head ends
+    at the matching close paren, not at a space."""
+    c = Circuit.from_qasm("qreg q[1]; rz(pi/2)q[0];")
+    want = _state_of(Circuit(1).rz(0, np.pi / 2), 1)
+    np.testing.assert_allclose(_state_of(c, 1), want, atol=1e-6)
+    # nested parens in a parameter expression survive the depth scan
+    c = Circuit.from_qasm("qreg q[1]; rz(2*(1+1))q[0];")
+    want = _state_of(Circuit(1).rz(0, 4.0), 1)
+    np.testing.assert_allclose(_state_of(c, 1), want, atol=1e-6)
+
+
+def test_spec_builtin_capital_u():
+    """A spec-compliant file (include, no recorder markers) reads the
+    OPENQASM builtin ``U(theta, phi, lambda)`` in the u3 order:
+    U(pi/2, 0, pi) is a Hadamard. Recorder exports (no include) keep
+    the ZYZ dialect for the same letter."""
+    want = _state_of(Circuit(1).h(0), 1)
+    spec = Circuit.from_qasm(
+        'OPENQASM 2.0;\ninclude "qelib1.inc";\nqreg q[1];\n'
+        "U(pi/2, 0, pi) q[0];\n")
+    _assert_same_up_to_phase(_state_of(spec, 1), want, atol=1e-10)
+    # without the include, the recorder dialect wins (ZYZ): the same
+    # line is a diagonal, so |0> stays |0> up to phase
+    rec = Circuit.from_qasm("qreg q[1]; U(pi/2, 0, pi) q[0];")
+    v = to_dense(rec.apply(qt.create_qureg(1, dtype=np.complex128)))
+    assert abs(v[1]) < 1e-10
+
+
+def test_whole_register_parameterized_no_space():
+    """`rz(pi/2)qq;` on a whole register expands per qubit even with a
+    multi-char register name and no space after the params."""
+    c = Circuit.from_qasm("qreg qq[2]; rz(pi/2)qq;")
+    want = _state_of(Circuit(2).rz(0, np.pi / 2).rz(1, np.pi / 2), 2)
+    np.testing.assert_allclose(_state_of(c, 2), want, atol=1e-6)
+
+
+def test_space_before_params():
+    """`rz (pi/2) q[0];` — whitespace between the gate name and its
+    parameter list is legal QASM and parses."""
+    c = Circuit.from_qasm("qreg q[1]; rz (pi/2) q[0];")
+    want = _state_of(Circuit(1).rz(0, np.pi / 2), 1)
+    np.testing.assert_allclose(_state_of(c, 1), want, atol=1e-6)
