@@ -229,7 +229,9 @@ def init_debug_state(qureg: Qureg) -> Qureg:
 
 @partial(jax.jit, static_argnames=("n", "qubit", "outcome", "rdt"))
 def _single_qubit_outcome_planes(*, n, qubit, outcome, rdt):
-    norm = 1.0 / np.sqrt(1 << (n - 1))
+    # scatter value must carry the register dtype: a bare Python float is
+    # f64 under x64 and JAX is hardening the implicit down-cast to an error
+    norm = jnp.asarray(1.0 / np.sqrt(1 << (n - 1)), dtype=rdt)
     pre, post = 1 << (n - 1 - qubit), 1 << qubit
     re = jnp.zeros((pre, 2, post), dtype=rdt).at[:, outcome, :].set(norm)
     return jnp.stack([re.reshape(-1), jnp.zeros((1 << n,), dtype=rdt)])

@@ -27,6 +27,30 @@ DTYPE = np.complex128
 TOL = 1e-12
 
 
+@pytest.fixture(autouse=True, params=["complex128", "complex64"])
+def _dist_dtype(request):
+    """Run the distributed matrix in both precisions: complex128 is the
+    reference's default build, complex64 is the production pod dtype —
+    psum ordering and half-chunk exchange rounding must hold in f32
+    too, not just in the fused/fuzz subsets. Module globals so the
+    file's tests and helpers pick the dtype up without threading a
+    fixture through every call site; tolerances follow the suite-wide
+    scheme (conftest.tol). Tests that pin their own dtype (the fused
+    interpret-mode subset) or never read DTYPE carry
+    @pytest.mark.dtype_agnostic and run once."""
+    if (request.param == "complex64"
+            and request.node.get_closest_marker("dtype_agnostic")):
+        pytest.skip("pins its own dtype / never reads DTYPE")
+    global DTYPE, TOL
+    prev = DTYPE, TOL
+    if request.param == "complex128":
+        DTYPE, TOL = np.complex128, 1e-12
+    else:
+        DTYPE, TOL = np.complex64, 2e-5
+    yield
+    DTYPE, TOL = prev
+
+
 @pytest.fixture(scope="module")
 def mesh():
     # "same tests, more ranks": 8 virtual devices by default (conftest),
@@ -153,7 +177,7 @@ def test_qft_sharded_matches_oracle(mesh):
     # QFT of |13>: amplitudes exp(2 pi i * 13 k / 64) / 8
     k = np.arange(1 << N)
     want = np.exp(2j * np.pi * 13 * k / (1 << N)) / np.sqrt(1 << N)
-    np.testing.assert_allclose(out, want, atol=1e-10, rtol=0)
+    np.testing.assert_allclose(out, want, atol=max(TOL, 1e-10), rtol=0)
 
 
 def test_random_circuit_sharded(mesh):
@@ -324,6 +348,7 @@ def test_banded_sharded_density_channels(mesh):
     np.testing.assert_allclose(a, b, atol=TOL, rtol=0)
 
 
+@pytest.mark.dtype_agnostic
 def test_banded_sharded_plan_composes(mesh):
     """The shard-aligned plan composes local runs into per-band ops and
     global runs into one 2x2 per qubit."""
@@ -369,14 +394,17 @@ def check_fused(circ, mesh, density=False, tol=2e-5, dtype=np.complex64):
     np.testing.assert_allclose(a, b, atol=tol * scale, rtol=0)
 
 
+@pytest.mark.dtype_agnostic
 def test_fused_sharded_rcs(mesh):
     check_fused(random_circuit(NF, depth=3, seed=5), mesh, tol=1e-4)
 
 
+@pytest.mark.dtype_agnostic
 def test_fused_sharded_qft(mesh):
     check_fused(qft_circuit(NF), mesh, tol=1e-4)
 
 
+@pytest.mark.dtype_agnostic
 def test_fused_sharded_every_qubit_class(mesh):
     rng = np.random.default_rng(23)
     u = oracle.random_unitary(2, rng)
@@ -392,6 +420,7 @@ def test_fused_sharded_every_qubit_class(mesh):
     check_fused(c, mesh, tol=1e-4)
 
 
+@pytest.mark.dtype_agnostic
 def test_fused_sharded_density_channels(mesh):
     c = Circuit((NF + 1) // 2)
     c.h(0)
@@ -401,6 +430,7 @@ def test_fused_sharded_density_channels(mesh):
     check_fused(c, mesh, density=True, tol=1e-4)
 
 
+@pytest.mark.dtype_agnostic
 def test_fused_sharded_f64_fallback(mesh):
     """complex128 registers run the banded schedule inside the same
     program and keep full double precision."""
@@ -408,6 +438,7 @@ def test_fused_sharded_f64_fallback(mesh):
                 dtype=np.complex128, tol=1e-12)
 
 
+@pytest.mark.dtype_agnostic
 def test_fused_sharded_plan_has_kernel_parts(mesh):
     """The plan must actually contain kernel segments (not degrade to
     all-sharded items) for a local-heavy circuit."""
@@ -428,6 +459,7 @@ def test_fused_sharded_plan_has_kernel_parts(mesh):
     assert segs, "local items produced no kernel segments"
 
 
+@pytest.mark.dtype_agnostic
 @pytest.mark.parametrize("ndev", [2, 4])
 def test_fused_sharded_other_mesh_sizes(ndev):
     """The fused sharded engine must agree with the single-device path at
@@ -448,6 +480,7 @@ def test_fused_sharded_other_mesh_sizes(ndev):
 
 @pytest.mark.skipif(not os.environ.get("QUEST_SLOW_TESTS"),
                     reason="~4 min subprocess; set QUEST_SLOW_TESTS=1")
+@pytest.mark.dtype_agnostic
 def test_dryrun_multichip_sixteen_devices():
     """The driver-facing dryrun scales past the suite's 8-device mesh:
     16 virtual devices means one more global qubit in every exchange
@@ -457,6 +490,7 @@ def test_dryrun_multichip_sixteen_devices():
     g.dryrun_multichip(16)
 
 
+@pytest.mark.dtype_agnostic
 def test_register_too_small_for_mesh_is_quest_error(mesh):
     """Mesh-shape failures speak the reference's validation language
     (E_DISTRIB_QUREG_TOO_SMALL, QuEST_validation.c:129), not a bare
@@ -472,6 +506,7 @@ def test_register_too_small_for_mesh_is_quest_error(mesh):
             compiler(c.ops, g, density=False, mesh=mesh)
 
 
+@pytest.mark.dtype_agnostic
 def test_control_state_length_mismatch_is_quest_error():
     from quest_tpu.ops.apply import norm_control_states
     with pytest.raises(qt.QuESTError, match="control"):
@@ -490,7 +525,8 @@ def test_outer_channel_collective_bytes_budget(mesh):
     n = ND  # density register: 2*ND state qubits over 8 devices
     state_qubits = 2 * n
     D = int(mesh.devices.size)
-    chunk_bytes = 2 * 8 * (1 << state_qubits) // D  # f64 planes on CPU tests
+    real_bytes = np.dtype(DTYPE).itemsize // 2      # bytes per real plane
+    chunk_bytes = 2 * real_bytes * (1 << state_qubits) // D
     amps = qt.init_debug_state(qt.create_density_qureg(n, dtype=DTYPE))
     sharded = shard_qureg(amps, mesh)
 
@@ -598,6 +634,7 @@ def test_init_preserves_sharding(mesh, init):
         f"{init} de-sharded the register")
     assert q.amps.sharding.mesh.devices.size == mesh.devices.size
 
+@pytest.mark.dtype_agnostic
 def test_explain_sharded_reports_lowered_schedule(mesh):
     """Circuit.explain_sharded: the communication schedule read off the
     LOWERED StableHLO — a diagonal-only circuit must show zero
@@ -628,6 +665,7 @@ def test_explain_sharded_reports_lowered_schedule(mesh):
     assert rec["devices"] == D
 
 
+@pytest.mark.dtype_agnostic
 def test_sharded_schedule_tracks_dtype_and_fused_layout(mesh):
     """Byte figures follow the session dtype (an f64 register moves 2x
     the bytes) and engine='fused' plans over the Pallas kernel's band
@@ -671,3 +709,45 @@ def test_sharded_schedule_tracks_dtype_and_fused_layout(mesh):
         assert rec["local_band_passes"] == want_local
         assert rec["global_qubit_items"] == want_global
         assert want_global >= 1     # the rx(n-1) really is a global item
+
+
+# -- compiled-program cache keys track device identity ------------------------
+
+@pytest.mark.dtype_agnostic
+def test_mesh_cache_key_tracks_device_identity():
+    """Cache keys follow device IDENTITY, not id(mesh): a rebuilt Mesh
+    over the same devices hits the cache, while a same-shape Mesh over
+    DIFFERENT devices — including one allocated after the first was
+    garbage-collected, when CPython may reuse the id — never aliases."""
+    import gc
+
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >= 4 devices")
+
+    m1 = make_amp_mesh(2, devices=devs[:2])
+    # the Mesh itself is the cache key: rebuild over the SAME devices ->
+    # equal by value (a cache hit is correct — the compiled program
+    # targets identical device objects); same shape over DIFFERENT
+    # devices -> unequal, regardless of object identity or id() reuse
+    m1b = make_amp_mesh(2, devices=devs[:2])
+    assert m1b == m1 and hash(m1b) == hash(m1)
+    m2 = make_amp_mesh(2, devices=devs[2:4])
+    assert m2 != m1
+
+    # end to end: compile on mesh 1, drop it, rebuild over other devices;
+    # the program for mesh 2 must land its output on mesh 2's devices
+    c = Circuit(N)
+    c.h(0).cnot(0, N - 1)
+    q1 = qt.init_debug_state(qt.create_qureg(N, dtype=DTYPE))
+    out1 = c.apply_sharded(shard_qureg(q1, m1), m1)
+    assert set(out1.amps.devices()) == set(devs[:2])
+    del m1
+    gc.collect()
+    q2 = qt.init_debug_state(qt.create_qureg(N, dtype=DTYPE))
+    out2 = c.apply_sharded(shard_qureg(q2, m2), m2)
+    assert set(out2.amps.devices()) == set(devs[2:4])
+    np.testing.assert_allclose(to_dense(out1), to_dense(out2), atol=TOL,
+                               rtol=0)
