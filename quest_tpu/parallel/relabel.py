@@ -135,3 +135,171 @@ def lazy_relabel_ops(flat: Sequence, n: int, local_n: int) -> List:
             else:
                 emit_swap(a, b)
     return out
+
+
+def plan_full_relabels(flat: Sequence, n: int, local_n: int,
+                       min_saved_chunks: float = 2.0) -> List:
+    """Layer-amortized relabeling for the FUSED sharded engine: rewrite
+    `flat` so that stretches of global-qubit matrix work run LOCALLY
+    between whole-register relabel events, each ONE all-to-all
+    collective.
+
+    Where lazy_relabel_ops localizes one qubit per inserted SWAP (a
+    half-chunk exchange each, and the SWAPs break band runs — its
+    measured failure on the banded engine), a relabel event swaps ALL
+    g device bits with g chosen local slots at once:
+
+      * bytes: one all-to-all ships (1 - 1/D) of the chunk — k single
+        swap-dances ship k/2 chunks, and the per-gate global path ships
+        k whole chunks (ref exchangeStateVectors,
+        QuEST_cpu_distributed.c:481-509; the reference pays this blindly
+        per gate);
+      * collectives: ONE per event instead of one per qubit;
+      * band runs: ops between events are untouched — the fusion
+        planner sees ordinary local gates, so whole RCS layers still
+        compose into per-band contractions (the event is an explicit
+        barrier item, quest_tpu/ops/fusion.py).
+
+    Victim slots are Belady-chosen (occupants with the farthest next
+    matrix-target use go global). An event is only emitted when the
+    no-relabel cost of the upcoming window exceeds `min_saved_chunks`
+    chunk-equivalents — an isolated global gate keeps the engine's
+    half-chunk swap-dance, which is cheaper than a whole-register
+    exchange. Emits kind='relabel' GateOps whose operand is the tuple
+    of local slots receiving device bits (slot[j] <-> device bit j);
+    the trailing restore costs at most two events + free local swaps."""
+    g = n - local_n
+    if g == 0 or g > local_n:
+        # a full relabel swaps all g device bits with g DISTINCT local
+        # slots, so it needs g <= local_n; tiny chunks keep the plain
+        # swap-dance schedule
+        return list(flat)
+
+    def exchange_cost(op, pperm):
+        """Chunk-equivalents the engine would ship for this op as-is."""
+        if op.kind != "matrix":
+            return 0.0           # diagonal/parity/allones never move data
+        t_phys = [pperm[t] for t in op.targets]
+        n_glob = sum(1 for t in t_phys if t >= local_n)
+        if n_glob == 0:
+            return 0.0
+        if len(t_phys) == 1:
+            return 1.0           # whole-chunk pair exchange (_matrix_op)
+        return 0.5 * n_glob      # half-chunk swap-to-local per global t
+
+    uses = _uses(flat, n)
+    ptr = [0] * n
+    perm = list(range(n))
+    inv = list(range(n))
+    out: List = []
+
+    def next_use(lq, i):
+        u, p = uses[lq], ptr[lq]
+        while p < len(u) and u[p] <= i:
+            p += 1
+        ptr[lq] = p
+        return u[p] if p < len(u) else len(flat) + 1
+
+    def emit_relabel(slots):
+        """slots[j] is the local slot swapping with device bit j."""
+        from quest_tpu.circuit import GateOp
+        out.append(GateOp(kind="relabel", targets=tuple(range(n)),
+                          operand=tuple(slots)))
+        for j, s in enumerate(slots):
+            gpos = local_n + j
+            ls, lg = inv[s], inv[gpos]
+            perm[ls], perm[lg] = gpos, s
+            inv[s], inv[gpos] = lg, ls
+
+    def emit_swap(a: int, b: int):
+        """Physical 2q SWAP of positions a, b (the ONE home of the
+        swap-emit + perm/inv bookkeeping for this pass)."""
+        from quest_tpu.circuit import GateOp
+        out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP))
+        la, lb = inv[a], inv[b]
+        perm[la], perm[lb] = b, a
+        inv[a], inv[b] = lb, la
+
+    def plan_event(i):
+        """(slots, fires) for a relabel at op i: pick the g Belady
+        victims among local slots — never a slot holding one of op i's
+        OWN targets (next_use looks strictly past i, so without the
+        exclusion the triggering op's local co-target ranks as
+        farthest-use and its eviction kills the event at j=i) — then
+        simulate forward until the new layout would itself pay an
+        exchange, summing what the OLD layout would have shipped over
+        that window. Stops as soon as the savings clear
+        min_saved_chunks — the only question asked — so planning stays
+        O(window), not O(circuit), per candidate. Returns fires=False
+        when the current targets leave fewer than g evictable slots."""
+        cur = set(flat[i].targets)
+        pool = [s for s in range(local_n) if inv[s] not in cur]
+        if len(pool) < g:
+            return [], False
+        scores = sorted(pool, key=lambda s: next_use(inv[s], i),
+                        reverse=True)
+        victims = scores[:g]
+        # new local set: everything except the victims' occupants
+        new_local = set(range(n)) - {inv[s] for s in victims}
+        saved = 0.0
+        for j in range(i, len(flat)):
+            op = flat[j]
+            if op.kind == "matrix" and any(t not in new_local
+                                           for t in op.targets):
+                break
+            saved += exchange_cost(op, perm)
+            if saved >= min_saved_chunks:
+                return victims, True
+        return victims, saved >= min_saved_chunks
+
+    for i, op in enumerate(flat):
+        if (op.kind == "matrix"
+                and any(perm[t] >= local_n for t in op.targets)):
+            victims, fires = plan_event(i)
+            if fires:
+                emit_relabel(victims)
+        out.append(dataclasses.replace(
+            op, targets=tuple(perm[t] for t in op.targets),
+            controls=tuple(perm[c] for c in op.controls)))
+
+    if perm != list(range(n)):
+        # restore standard order in at most two events + free swaps:
+        # (1) if the device bits need fixing and any owed logical
+        # (local_n+j) sits at SOME device bit, one event pulls ALL
+        # device-bit occupants into local slots — slots chosen so no
+        # owed logical gets evicted back out; (2) one event sends each
+        # owed logical to its own device bit; (3) the remaining
+        # mismatches are local-local, communication-free in-chunk 2q
+        # swaps. A purely local-local residual (device bits already
+        # home) emits ZERO events — only free swaps.
+        needs_fix = any(inv[local_n + j] != local_n + j for j in range(g))
+        owed_at_device = any(perm[local_n + j] >= local_n
+                             for j in range(g))
+        safe = [s for s in range(local_n) if inv[s] < local_n]
+        if needs_fix and owed_at_device and len(safe) < g:
+            # tiny chunk: not enough safe slots for the two-step
+            # restore; fall back to plain swaps (the engine
+            # swap-dances the global ones, global-global pairs route
+            # through local slot 0 like lazy_relabel_ops' restore)
+            for q in range(n):
+                while perm[q] != q:
+                    a, b = perm[q], q
+                    if a >= local_n and b >= local_n:
+                        emit_swap(a, 0)
+                    else:
+                        emit_swap(a, b)
+        else:
+            if needs_fix:
+                if owed_at_device:
+                    emit_relabel(safe[:g])
+                slots = [perm[local_n + j] for j in range(g)]
+                assert (all(s < local_n for s in slots)
+                        and len(set(slots)) == g)
+                emit_relabel(slots)
+            for q in range(local_n):
+                while perm[q] != q:
+                    a, b = perm[q], q
+                    assert a < local_n and b < local_n
+                    emit_swap(a, b)
+        assert perm == list(range(n))
+    return out

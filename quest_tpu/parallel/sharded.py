@@ -361,11 +361,38 @@ def _pair_exchange_2t(chunk, dev, *, D, local_n, sup, t, jg, gbit):
     return new + A.apply_matrix(recv, local_n, tr(cross), (t,))
 
 
+def _relabel_op(chunk, *, local_n, slots):
+    """Whole-register relabel event: swap every device bit j with local
+    slot slots[j] in ONE all-to-all collective (bytes: (1 - 1/D) of the
+    chunk — vs one whole-chunk pair exchange PER global 1q gate on the
+    plain schedule, ref exchangeStateVectors,
+    QuEST_cpu_distributed.c:481-509). The slot bits are transposed to a
+    leading axis whose value equals the destination device index; the
+    received blocks land at slot-bit positions equal to the SOURCE
+    device index, which is the same layout — so the inverse transpose
+    restores the standard chunk view. Planned by
+    parallel.relabel.plan_full_relabels; validated bit-exactly against
+    a host bit-swap oracle (tests/test_lazy_relabel.py)."""
+    g = len(slots)
+    planes = chunk.reshape((2,) + (2,) * local_n)  # plane, b_{ln-1}..b_0
+    axes_front = [1 + (local_n - 1 - q) for q in reversed(slots)]
+    rest = [a for a in range(1, local_n + 1) if a not in axes_front]
+    perm = [0] + axes_front + rest
+    x = planes.transpose(perm).reshape(2, 1 << g, -1)
+    y = lax.all_to_all(x, AMP_AXIS, split_axis=1, concat_axis=1)
+    y = y.reshape((2,) + (2,) * local_n)
+    inv = np.argsort(perm)
+    return y.transpose(list(inv)).reshape(2, -1)
+
+
 def _apply_gateop(chunk, dev, *, D, local_n, density, op):
     """One GateOp (possibly + its conjugate column-space copy for density
     registers, ref QuEST.c:8-10) on the local chunk."""
     n = local_n + int(math.log2(D))
     shift = n // 2 if density else 0
+
+    if op.kind == "relabel":
+        return _relabel_op(chunk, local_n=local_n, slots=op.operand)
 
     if op.kind == "superop":
         # channel superoperator on [targets, targets+N]: one matrix op on
@@ -504,7 +531,8 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
 
 def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
                                   mesh: Mesh, donate: bool = True,
-                                  interpret: bool = False):
+                                  interpret: bool = False,
+                                  relabel: bool = True):
     """The Pallas band-segment engine over the device mesh: the pod-scale
     composition of the two fastest paths in the framework. Runs of
     purely-local fused items (band contractions, diagonals, phases, pair
@@ -516,6 +544,14 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
     its distributed backend dispatches one kernel per gate per rank
     (QuEST_cpu_distributed.c:846-881); here a whole local stretch of an
     RCS layer is one kernel launch on every device simultaneously.
+
+    relabel=True (default) first rewrites the flat ops through the
+    layer-amortized relabeling pass (parallel/relabel.py
+    plan_full_relabels): stretches of global-qubit matrix work run
+    locally between whole-register all-to-all events, cutting both the
+    collective count and the ICI bytes of deep circuits (the pass
+    leaves cheap schedules untouched — events only fire where they pay
+    for themselves).
 
     interpret=True runs the kernels in the Pallas interpreter (CPU-mesh
     testing)."""
@@ -534,6 +570,9 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
         return compile_circuit_sharded_banded(ops, n, density, mesh, donate)
 
     flat = flatten_ops(ops, n, density)
+    if relabel:
+        from quest_tpu.parallel.relabel import plan_full_relabels
+        flat = plan_full_relabels(flat, n, local_n)
     items = F.plan(flat, n, bands=bands)
 
     def local_only(it) -> bool:

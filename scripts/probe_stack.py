@@ -1,0 +1,103 @@
+"""Stage-count sweep: is a stacked segment max(DMA, compute) or
+DMA + compute?
+
+Time segments of k identical b0 stages (identity values) for
+k = 0..8 at fixed geometry. The k=0 point (one PhaseStage, ~free) is
+the pure-DMA floor. If the curve is flat until k*dot > DMA then linear
+with slope = dot cost, the pipeline overlaps; if it is linear from
+k=1 with intercept = DMA floor, compute and DMA serialize and manual
+multi-buffering is worth building.
+
+Also sweeps the same ladder with 3 scattered bits claimed (the bench
+segment's DMA pattern: 8-strip gathers) to separate gather cost from
+overlap behavior.
+
+Usage: python scripts/probe_stack.py [n]   (default 28)
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+from quest_tpu.precision import enable_compile_cache
+enable_compile_cache()
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+n = %(n)d
+k = %(k)d
+scat = %(scat)d
+reps = %(reps)d
+
+from quest_tpu.ops import pallas_band as PB
+
+stages, arrays = [], []
+if scat:
+    # claim top scattered bits with a cheap sc butterfly so the DMA
+    # pattern matches the bench segment's strip gathers
+    g2 = np.zeros((2, 2, 2), np.float32); g2[0] = np.eye(2)
+    for j in range(scat):
+        stages.append(PB.MatStage(kind="sc", bit=n - 8 - j, dim=2,
+                                  real_only=False, lane_preds=(),
+                                  row_preds=()))
+        arrays.append(jnp.asarray(g2))
+if k == 0:
+    stages.append(PB.PhaseStage())
+    arrays.append(jnp.asarray(np.zeros((1, 8), np.float32)))
+else:
+    g128 = np.zeros((2, 128, 128), np.float32); g128[0] = np.eye(128)
+    for _ in range(k):
+        stages.append(PB.MatStage(kind="b0", dim=128, real_only=False,
+                                  lane_preds=(), row_preds=()))
+        arrays.append(jnp.asarray(g128))
+
+fn = PB.compile_segment(stages, n)
+jfn = jax.jit(lambda a: fn(a, arrays), donate_argnums=(0,))
+from quest_tpu.state import basis_planes, fused_state_shape
+amps = basis_planes(0, n=n, rdt=jnp.float32, shape=fused_state_shape(n))
+amps = jfn(amps)
+_ = np.asarray(amps[0, 0, :4])
+t0 = time.perf_counter()
+for _ in range(reps):
+    amps = jfn(amps)
+_ = np.asarray(amps[0, 0, :4])
+dt = (time.perf_counter() - t0) / reps
+gb = 2 * 2 * (1 << n) * 4 / 2**30
+print("[probe-result] " + json.dumps(dict(
+    k=k, scat=scat, n=n, ms=round(dt * 1e3, 2),
+    eff_gb_s=round(gb / dt, 1))), flush=True)
+"""
+
+
+def run(n, k, scat, reps=8):
+    code = WORKER % dict(repo=REPO, n=n, k=k, scat=scat, reps=reps)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=1200, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"[probe] TIMEOUT k={k} scat={scat}", flush=True)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("[probe-result]"):
+            print(line, flush=True)
+            return json.loads(line[len("[probe-result]"):])
+    print(f"[probe] FAILED k={k} scat={scat}: {r.stdout[-300:]} "
+          f"{r.stderr[-1200:]}", flush=True)
+    return None
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 28
+    for scat in (0, 3):
+        for k in (0, 1, 2, 4, 8):
+            run(n, k, scat)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,3 +41,52 @@ def test_40q_class_schedule_lowers_and_matches_plan():
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["lowered_cp"] > 0
     assert rec["lowered_cp"] == rec["planned_global"], rec
+
+
+RELABEL_WORKER = r'''
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json, sys
+import numpy as np
+import jax.numpy as jnp
+sys.path.insert(0, %(repo)r)
+from jax.sharding import Mesh
+from quest_tpu.circuit import random_circuit
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.parallel.introspect import parse_collectives
+from quest_tpu.parallel.sharded import compile_circuit_sharded_fused
+
+n, D = 36, 64
+c = random_circuit(n, depth=20, seed=1)
+mesh = Mesh(np.array(jax.devices()), (AMP_AXIS,))
+out = {}
+for rel in (False, True):
+    step = compile_circuit_sharded_fused(c.ops, n, False, mesh=mesh,
+                                         donate=False, interpret=True,
+                                         relabel=rel)
+    low = jax.jit(step).lower(jax.ShapeDtypeStruct((2, 1 << n), jnp.float32))
+    r = parse_collectives(low.as_text(), num_devices=D)
+    key = "with" if rel else "without"
+    out[f"exchanges_{key}"] = r["collective_exchanges"]
+    out[f"bytes_{key}"] = r["ici_bytes_per_device"]
+print(json.dumps(out))
+'''
+
+
+def test_40q_class_fused_relabel_schedule():
+    """The layer-amortized relabel pass on the 40q-class fused schedule
+    (36q/64dev CI stand-in; the real 40q/256 lowering measured r4:
+    95 whole-chunk exchanges / 3.26 TB -> 14 all-to-alls / 0.48 TB per
+    device, an 85.3%% ICI-byte cut). Pinned loosely: well under the
+    VERDICT-r3 targets of <=65 exchanges and >=25%% byte cut."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+    code = RELABEL_WORKER % {"repo": REPO}
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-3000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["exchanges_with"] <= 40, rec
+    assert rec["exchanges_with"] < rec["exchanges_without"], rec
+    assert rec["bytes_with"] <= 0.5 * rec["bytes_without"], rec
