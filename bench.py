@@ -240,7 +240,6 @@ def _measure_density(reps: int):
     """(ops/sec, nd) through the fused engine on a density register, or
     (None, None) — the density figure must never break the headline
     JSON. Ladder over register sizes like the statevector bench."""
-    import jax.numpy as jnp
     from quest_tpu.state import fused_state_shape
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")

@@ -931,13 +931,21 @@ class Circuit:
         # the cost model's constants were CALIBRATED on v5e/v5-lite
         # (docs/KERNELS.md); on any other chip generation the estimate
         # is scaled wrong — say so at runtime instead of silently
-        # printing v5e numbers (VERDICT r3 weak item 5)
+        # printing v5e numbers (VERDICT r3 weak item 5). Only consult
+        # the device when this process has ALREADY committed to a
+        # backend: explain() is pure host math and must stay safe to
+        # call before ensure_live_backend — an in-process jax.devices()
+        # with the tunnel down hangs indefinitely, and with it up would
+        # commit the backend early (env.py ordering contract).
+        kind = "?"
         try:
-            kind = str(getattr(jax.devices()[0], "device_kind", "?"))
+            from jax._src import xla_bridge as _xb
+            if _xb._backends:
+                kind = str(getattr(jax.devices()[0], "device_kind", "?"))
         except Exception:               # pragma: no cover - no backend
-            kind = "?"
+            pass
         calibrated = "lite" in kind.lower() or "v5e" in kind.lower()
-        tag = ("" if calibrated else
+        tag = ("" if calibrated or kind == "?" else
                f" [CAUTION: calibrated on v5e, this is {kind!r} — "
                f"treat as relative, not absolute]")
         lines.append(

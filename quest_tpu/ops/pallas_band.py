@@ -1013,22 +1013,23 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
     nbuf = min(nbuf, steps)
 
     def idx_of(step):
-        """Index tuple selecting step's block in the state view. The
-        view's row axes alternate (gap, scattered) pairs then end with
-        (last gap, inner) — see _Geometry.view_dims — so gap axes take
-        the unraveled step id and scattered/inner axes ride whole."""
+        """Index tuple selecting step's block in the state view,
+        derived from the BLOCK SHAPE exactly like the grid driver's
+        index_map (block entry 1 = a grid axis taking the unraveled
+        step id, anything else rides whole) — one layout convention,
+        not two. A size-1 inner axis also has block 1; the default 0
+        indexes it, mirroring index_map's zip-shortest behavior."""
         pids = []
         rem = step
         for g in reversed(grid):
             pids.append(rem % g)
             rem = rem // g
         pids = pids[::-1]
+        it = iter(pids)
         idx = [slice(None)]                  # plane axis
-        for pid in pids[:-1]:
-            idx.append(pl.ds(pid, 1))        # gap axis
-            idx.append(slice(None))          # its scattered axis
-        idx.append(pl.ds(pids[-1], 1))       # last gap axis
-        idx.append(slice(None))              # inner axis
+        for blk in block_shape[1:-1]:        # row-view axes
+            idx.append(pl.ds(next(it, 0), 1) if blk == 1
+                       else slice(None))
         idx.append(slice(None))              # lane axis
         return tuple(idx), pids
 

@@ -115,13 +115,23 @@ def real_eps(dtype) -> float:
 
 def real_dtype_of(dtype):
     """The real scalar dtype paired with a complex amplitude dtype
-    (host-side mapping; never touches the device)."""
+    (host-side mapping; never touches the device). Anything outside the
+    two supported tiers is rejected explicitly — in particular a
+    quad/complex256 request, which the framework REFUSES by policy
+    (docs/PRECISION.md: TPU f64 is already software-emulated and the
+    reference's own GPU build lacks the tier too)."""
     d = np.dtype(dtype)
     if d == np.dtype(np.complex64):
         return np.dtype(np.float32)
     if d == np.dtype(np.complex128):
         return np.dtype(np.float64)
-    return d
+    if d in (np.dtype(np.float32), np.dtype(np.float64)):
+        return d
+    from quest_tpu.validation import QuESTError
+    raise QuESTError(
+        f"unsupported amplitude dtype {d}: the precision tiers are "
+        f"complex64 (f32 planes) and complex128 (f64 planes); wider "
+        f"tiers are explicitly refused (docs/PRECISION.md)")
 
 
 def complex_dtype_of(dtype):

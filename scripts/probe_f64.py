@@ -1,0 +1,113 @@
+"""On-chip probe: what can double precision actually run at on a v5e?
+
+TPU v5e has no f64 vector hardware; XLA emulates f64 in software. This
+probe measures every candidate path for the reference-default-precision
+story (VERDICT r3 item 4: f64 @28q >= 30 gates/s, or a measured
+impossibility argument in docs/PRECISION.md):
+
+  raw-mul     a donated elementwise f64 multiply over the 28q state —
+              the emulation's streaming floor (compare f32's 461 GB/s)
+  raw-dot     one f64 (rows,128)@(128,128) band contraction — the MXU
+              has no f64 path at all, so this is the software wall that
+              makes the banded engine 9 gates/s
+  pergate     the per-gate XLA engine on complex128 (elementwise
+              butterflies, NO dots) — the dot-free route
+  banded      the banded engine on complex128 (the current f64 default)
+
+Each case runs in a subprocess. Usage: python scripts/probe_f64.py [n]
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+from quest_tpu.precision import enable_compile_cache
+enable_compile_cache()
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+mode = %(mode)r
+n = %(n)d
+reps = %(reps)d
+
+if mode in ("raw-mul", "raw-dot"):
+    x = jnp.zeros((2, 1 << n), dtype=jnp.float64)
+
+    if mode == "raw-mul":
+        fn = jax.jit(lambda a: a * 1.000000001, donate_argnums=(0,))
+    else:
+        g = jnp.eye(128, dtype=jnp.float64)
+
+        def dot(a):
+            v = a.reshape(2, -1, 128)
+            return jnp.einsum("prl,lk->prk", v, g,
+                              precision=jax.lax.Precision.HIGHEST
+                              ).reshape(2, -1)
+        fn = jax.jit(dot, donate_argnums=(0,))
+    x = fn(x); _ = np.asarray(x[0, :4])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x = fn(x)
+    _ = np.asarray(x[0, :4])
+    dt = (time.perf_counter() - t0) / reps
+    gb = 2 * 2 * (1 << n) * 8 / 2**30
+    print("[probe-result] " + json.dumps(dict(
+        mode=mode, n=n, ms=round(dt * 1e3, 2),
+        eff_gb_s=round(gb / dt, 1))), flush=True)
+else:
+    from quest_tpu.circuit import Circuit
+    rng = np.random.default_rng(42)
+    c = Circuit(n)
+    for i in range(16):
+        c.rx(1 + i %% (n - 1), float(rng.uniform(0, 2 * np.pi)))
+    iters = 4
+    if mode == "pergate":
+        step = c.compiled(n, density=False, donate=True, iters=iters)
+    else:
+        step = c.compiled_banded(n, density=False, donate=True, iters=iters)
+    amps = jnp.zeros((2, 1 << n), dtype=jnp.float64).at[0, 0].set(1.0)
+    amps = step(amps)
+    _ = np.asarray(amps[0, :4])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        amps = step(amps)
+    _ = np.asarray(amps[0, :4])
+    dt = (time.perf_counter() - t0) / reps
+    print("[probe-result] " + json.dumps(dict(
+        mode=mode, n=n, ms_per_gate=round(dt / iters / 16 * 1e3, 2),
+        gates_per_sec=round(16 * iters / dt, 1))), flush=True)
+"""
+
+
+def run(mode, n, reps=4):
+    code = WORKER % dict(repo=REPO, mode=mode, n=n, reps=reps)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=2400, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"[probe] TIMEOUT mode={mode}", flush=True)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("[probe-result]"):
+            print(line, flush=True)
+            return json.loads(line[len("[probe-result]"):])
+    print(f"[probe] FAILED mode={mode}: {r.stdout[-300:]} "
+          f"{r.stderr[-1200:]}", flush=True)
+    return None
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 28
+    for mode in ("raw-mul", "pergate", "banded", "raw-dot"):
+        run(mode, n)
+
+
+if __name__ == "__main__":
+    main()

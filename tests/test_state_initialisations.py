@@ -140,3 +140,16 @@ def test_amp_getters():
         S.get_amp(rho, 0)
     with pytest.raises(QuESTError, match="density"):
         S.get_density_amp(q, 0, 0)
+
+
+def test_wider_dtypes_explicitly_refused():
+    """complex256/quad requests are refused by POLICY with a pointer at
+    docs/PRECISION.md — not a downstream JAX TypeError (the reference's
+    own GPU build also lacks the quad tier, QuEST_precision.h:59)."""
+    import pytest
+
+    import quest_tpu as qt
+    with pytest.raises(qt.QuESTError, match="refused"):
+        qt.create_qureg(3, dtype="complex256")
+    with pytest.raises(qt.QuESTError, match="refused"):
+        qt.create_density_qureg(2, dtype="float16")
