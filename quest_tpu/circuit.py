@@ -12,6 +12,7 @@ reference architecture cannot express, and the main single-chip perf lever
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -801,7 +802,8 @@ class Circuit:
         self._reject_measure("compiled_fused")
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
-        key = ("fused", n, density, donate, interpret, iters,
+        scan_flag = os.environ.get("QUEST_FUSED_SCAN") == "1"
+        key = ("fused", n, density, donate, interpret, iters, scan_flag,
                precision.matmul_precision())
         fn = self._compiled.get(key)
         if fn is not None:
@@ -838,7 +840,56 @@ class Circuit:
             return (lambda amps, f=xla_fn:
                     f(amps.reshape(2, -1)).reshape(amps.shape))
 
-        appliers = [make_applier(pt) for pt in parts]
+        def make_scan_applier(seg, arrays_run):
+            """One lax.scan over a run of >=3 consecutive segments
+            sharing ONE kernel structure (operands
+            differ, stage tuple identical — QFT's repeated 32-phase
+            mid-segments are the canonical case). The traced program
+            carries the kernel call ONCE with stacked operands instead
+            of len(run) inlined copies — the program-size lever for the
+            relay's per-byte first-execution cost (compile_latency note
+            in benchmarks/measured_tpu.json). Opt-in via
+            QUEST_FUSED_SCAN=1 until its steady-state cost is measured
+            on chip. Interpret mode ignores the flag: the Pallas
+            interpreter's DMA emulation traced into a scan body
+            explodes XLA-CPU compile time (measured r4: >15 min for a
+            4-segment program), so the executed scan path is validated
+            on silicon by scripts/tpu_revalidate.sh's fused-scan stage
+            (QFT-20 with and without the flag, amplitudes compared)."""
+            # numpy stack: operands stay HOST-side closure constants
+            # that upload with the program, like the non-scan path
+            # (segment_plan's host-side-operand design)
+            stacked = tuple(
+                np.stack([arrs[j] for arrs in arrays_run])
+                for j in range(len(arrays_run[0])))
+
+            def apply(amps, seg=seg, stacked=stacked):
+                def body(a, xs):
+                    return seg(a, list(xs)), None
+                out, _ = jax.lax.scan(body, amps, stacked)
+                return out
+            return apply
+
+        scan_min = 3 if (scan_flag and not interpret) else 0
+        appliers = []
+        i = 0
+        while i < len(parts):
+            part = parts[i]
+            if scan_min and part[0] == "segment":
+                seg_key = (tuple(part[1]), n, interpret)
+                j = i
+                while (j < len(parts) and parts[j][0] == "segment"
+                       and (tuple(parts[j][1]), n, interpret) == seg_key):
+                    j += 1
+                if j - i >= scan_min:
+                    seg = PB.compile_segment_cached(
+                        seg_cache, part[1], n, interpret=interpret)
+                    appliers.append(make_scan_applier(
+                        seg, [p[2] for p in parts[i:j]]))
+                    i = j
+                    continue
+            appliers.append(make_applier(part))
+            i += 1
 
         def run(amps):
             # the Pallas kernels are f32-only; f64 registers keep their

@@ -101,4 +101,59 @@ if ! grep -q '"platform": "\(tpu\|axon\)"' /tmp/rcs_out.json; then
     fail_stage rcs-evidence
 fi
 
+echo "== fused-scan path (QUEST_FUSED_SCAN=1 vs baseline amplitudes) =="
+# the executed lax.scan segment path cannot run in interpret mode (its
+# compile explodes on CPU — circuit.py make_scan_applier docstring), so
+# its ONLY validation is here on silicon: same circuit with and without
+# the flag must agree amplitude-for-amplitude
+require_tunnel fused-scan
+timeout 1800 python - << 'PYEOF' || fail_stage fused-scan
+import os, subprocess, sys, json, tempfile
+
+CHILD = r'''
+import os, sys, json
+import numpy as np
+import quest_tpu as qt
+from quest_tpu.circuit import Circuit, flatten_ops
+from quest_tpu.ops import fusion as F
+from quest_tpu.ops import pallas_band as PB
+from quest_tpu.state import to_dense
+
+# phase-heavy circuit: identical consecutive 32-PhaseStage segments, the
+# scan-eligible shape (QFT only produces such runs at 30q; this builds
+# the same structure cheaply at 20q)
+n = 20
+rng = np.random.default_rng(4)
+c = Circuit(n)
+for _ in range(200):
+    a, b = rng.choice(n, size=2, replace=False)
+    c.cphase(float(rng.uniform(0, 6.28)), int(a), int(b))
+parts = PB.segment_plan(
+    F.plan(flatten_ops(c.ops, n, False), n, bands=PB.plan_bands(n)), n)
+sigs = [tuple(p[1]) for p in parts if p[0] == "segment"]
+run = best = 1
+for x, y in zip(sigs, sigs[1:]):
+    run = run + 1 if x == y else 1
+    best = max(best, run)
+assert best >= 3, f"plan lost its scan-eligible run (best={best})"
+q = qt.init_debug_state(qt.create_qureg(n))
+v = to_dense(c.apply_fused(q))
+np.save(sys.argv[1], np.stack([v.real, v.imag]))
+print(json.dumps({"platform": __import__("jax").devices()[0].platform}))
+'''
+outs = {}
+for flag in ("0", "1"):
+    env = dict(os.environ); env["QUEST_FUSED_SCAN"] = flag
+    path = tempfile.mktemp(suffix=".npy")
+    r = subprocess.run([sys.executable, "-c", CHILD, path],
+                       capture_output=True, text=True, env=env, timeout=1700)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert '"platform"' in r.stdout and ("axon" in r.stdout or "tpu" in r.stdout), r.stdout[-200:]
+    import numpy as np
+    outs[flag] = np.load(path)
+d = float(abs(outs["0"] - outs["1"]).max())
+print(f"fused-scan maxdiff {d}")
+assert d < 1e-5, d
+PYEOF
+
 echo "== revalidation COMPLETE =="

@@ -267,3 +267,40 @@ def test_circuit_multi_rotate_pauli_matches_eager():
                      .apply(qt.init_debug_state(
                          qt.create_density_qureg(3, dtype=np.complex128))))
     np.testing.assert_allclose(got_d, want_d, atol=1e-12, rtol=0)
+
+
+def test_fused_scan_grouping_plan():
+    """QUEST_FUSED_SCAN groups runs of >=3 consecutive identical-
+    structure segments (QFT-30's repeated 32-phase mid-segments are the
+    production case). The grouping decision is plan-level host logic;
+    the executed scan path is chip-validated (interpret-mode Pallas
+    inside lax.scan is compile-prohibitive, see circuit.py)."""
+    import numpy as np
+
+    from quest_tpu.circuit import Circuit, flatten_ops
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+
+    n = 10
+    rng = np.random.default_rng(4)
+    c = Circuit(n)
+    for _ in range(100):
+        a, b = rng.choice(n, size=2, replace=False)
+        c.cphase(float(rng.uniform(0, 6.28)), int(a), int(b))
+    parts = PB.segment_plan(
+        F.plan(flatten_ops(c.ops, n, False), n, bands=PB.plan_bands(n)), n)
+    sigs = [tuple(p[1]) for p in parts if p[0] == "segment"]
+    assert len(sigs) >= 3
+    run = best = 1
+    best_end = 0
+    for i, (x, y) in enumerate(zip(sigs, sigs[1:])):
+        run = run + 1 if x == y else 1
+        if run > best:
+            best, best_end = run, i + 1
+    assert best >= 3, "phase-heavy plan lost its scan-eligible run"
+    # operand shapes per stage position are identical across THE run —
+    # the stacking precondition of make_scan_applier
+    arrs = [p[2] for p in parts if p[0] == "segment"]
+    run_arrs = arrs[best_end - best + 1:best_end + 1]
+    shapes = {tuple(a.shape for a in al) for al in run_arrs}
+    assert len(shapes) == 1
