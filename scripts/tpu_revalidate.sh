@@ -157,3 +157,12 @@ assert d < 1e-5, d
 PYEOF
 
 echo "== revalidation COMPLETE =="
+
+# ---- best-effort round-4 probes (results logged, never fail the run) ----
+# f64 ceiling matrix (VERDICT r3 item 4) and the per-kernel vs per-byte
+# relay-cost experiment (item 5); each is independently resumable, so a
+# tunnel drop mid-probe just leaves them for the next window
+echo "== probe: f64 ceiling (scripts/probe_f64.py 28) =="
+timeout 3600 python scripts/probe_f64.py 28 | tee /tmp/probe_f64.out || true
+echo "== probe: relay cold-start (scripts/probe_cold_start.py 26 24) =="
+timeout 3600 python scripts/probe_cold_start.py 26 24 | tee /tmp/probe_cold.out || true
