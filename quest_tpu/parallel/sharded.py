@@ -567,6 +567,17 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     bands = fused_shard_bands(n, local_n)
     if bands is None:
+        # the Pallas kernel cannot host this chunk: banded fallback.
+        # NOT silent when the caller asked for fused-only behavior —
+        # interpret/relabel do not exist on the banded path, and a
+        # dropped flag here once turned a relabel test into a false
+        # positive (caught in review, r4)
+        if interpret or not relabel:
+            import sys
+            print(f"[sharded] local_n={local_n} below the kernel tier's "
+                  f"minimum: falling back to the BANDED engine; "
+                  f"interpret/relabel arguments do not apply there",
+                  file=sys.stderr)
         return compile_circuit_sharded_banded(ops, n, density, mesh, donate)
 
     flat = flatten_ops(ops, n, density)

@@ -93,3 +93,46 @@ outcomes = np.asarray(jax.device_get(outcomes))
 assert outcomes[1] == 0, outcomes
 print(f"proc {PROC}: dynamic circuit outcomes {outcomes.tolist()}",
       flush=True)
+
+# layer-amortized relabeling cross-process: the fused sharded engine's
+# all_to_all relabel events must route over gloo/DCN exactly like they
+# will over ICI on a pod. nr=13 so local_n=10 clears the Pallas
+# kernel's minimum — at n=10 the fused compiler silently falls back to
+# banded and NOTHING relabel-related runs (a false positive caught in
+# review); the fused_shard_bands assertion pins the real path.
+from quest_tpu.parallel.sharded import (  # noqa: E402
+    compile_circuit_sharded_fused, fused_shard_bands)
+
+nr = 13            # 8 devices -> local_n = 10
+g_bits = 3
+assert fused_shard_bands(nr, nr - g_bits) is not None, \
+    "fused engine would silently fall back to banded"
+rng_r = np.random.default_rng(5)
+cr = Circuit(nr)
+for _ in range(3):
+    for q in range(nr):
+        cr.rx(q, float(rng_r.uniform(0, 2 * np.pi)))
+    for q in range(0, nr - 1, 2):
+        cr.cz(q, q + 1)
+from quest_tpu.circuit import flatten_ops  # noqa: E402
+from quest_tpu.parallel.relabel import plan_full_relabels  # noqa: E402
+n_events = sum(1 for op in plan_full_relabels(
+    flatten_ops(cr.ops, nr, False), nr, nr - g_bits)
+    if op.kind == "relabel")
+assert n_events > 0, "deep-global circuit fired no relabel events"
+step_r = compile_circuit_sharded_fused(cr.ops, nr, False, mesh,
+                                       donate=False, interpret=True)
+base_r = np.zeros((2, 1 << nr), dtype=np.float32)
+base_r[0, 0] = 1.0
+sharding_r = NamedSharding(mesh, P(None, AMP_AXIS))
+amps_r = jax.make_array_from_callback((2, 1 << nr), sharding_r,
+                                      lambda idx: base_r[idx])
+out_r = step_r(amps_r)
+want_r = np.asarray(cr.compiled_banded(nr, density=False, donate=False)(
+    jnp.asarray(base_r)))
+for shard in out_r.addressable_shards:
+    got = np.asarray(shard.data)
+    ref = want_r[shard.index]
+    err = float(np.max(np.abs(got - ref)))
+    assert err < 5e-5, f"proc {PROC} relabel shard {shard.index}: err {err}"
+print(f"proc {PROC}: relabel all_to_all ok ({n_events} events)", flush=True)

@@ -44,6 +44,7 @@ def test_two_process_global_mesh():
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert "shards ok" in out, out[-1000:]
         assert "dynamic circuit outcomes" in out, out[-1000:]
+        assert "relabel all_to_all ok" in out, out[-1000:]
     # both processes drew the SAME outcome sequence
     import re
     seqs = {re.search(r"dynamic circuit outcomes (\[.*?\])", o).group(1)
