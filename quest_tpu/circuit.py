@@ -1035,9 +1035,11 @@ class Circuit:
             f"{rec['global_qubits']} device qubits, "
             f"{_human_bytes(rec['chunk_bytes'])} chunk per device",
             *plan_lines,
-            f"  collective exchanges: {rec['collective_permutes']} "
+            f"  collective exchanges: {rec['collective_exchanges']} "
             f"({_human_bytes(rec['ici_bytes_per_device'])} ICI per device "
             f"per application)",
+            *([f"  of which relabel all-to-alls: {rec['all_to_alls']}"]
+              if rec.get("all_to_alls") else []),
             f"  psum reductions: {rec['all_reduces']}",
         ])
 

@@ -97,18 +97,27 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
             1 for op in flat if max(op.targets) < local_n)
         rec["global_ops"] = len(flat) - rec["local_ops"]
     else:
-        # band layout PER ENGINE, via the engines' own layout helpers so
-        # the reported plan cannot drift from the executed one
+        # band layout AND op-list rewrite PER ENGINE, via the engines'
+        # own helpers (S.engine_flat is the ONE home of the rewrite
+        # policy) so the reported plan cannot drift from the executed
+        # one — the banded and fused builders both run the
+        # layer-amortized relabel pass by default, so the plan stats
+        # describe the POST-relabel schedule (its remaining global
+        # items are the lowered collective-permutes; its relabel
+        # events are the all-to-alls)
         bands = None
         if engine == "fused":
             bands = S.fused_shard_bands(n, local_n)
         if bands is None:
             bands = S._shard_bands(n, local_n)
-        items = F.plan(flat, n, bands=bands)
+        flat_r = S.engine_flat(ops, n, density, local_n)
+        items = F.plan(flat_r, n, bands=bands)
         rec["local_band_passes"] = sum(
             1 for it in items
             if isinstance(it, F.BandOp) and it.ql < local_n)
         rec["global_qubit_items"] = sum(
             1 for it in items
             if isinstance(it, F.BandOp) and it.ql >= local_n)
+        rec["relabel_events"] = sum(
+            1 for op in flat_r if op.kind == "relabel")
     return rec

@@ -430,6 +430,31 @@ def _apply_gateop(chunk, dev, *, D, local_n, density, op):
     return chunk
 
 
+def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
+                lazy: bool = False, relabel: bool = None):
+    """The flat op list the banded/fused sharded engines EXECUTE:
+    flatten_ops plus the one relabel-rewrite policy. The single home of
+    that policy — parallel.introspect reads plan statistics through
+    this same function, so the reported schedule cannot drift from the
+    executed one. relabel=None means on-unless-lazy; requesting both
+    strategies explicitly raises."""
+    from quest_tpu.circuit import flatten_ops
+
+    if lazy and relabel:
+        raise ValueError("lazy and relabel are mutually exclusive "
+                         "relabeling strategies; pick one")
+    if relabel is None:
+        relabel = not lazy
+    flat = flatten_ops(ops, n, density)
+    if lazy:
+        from quest_tpu.parallel.relabel import lazy_relabel_ops
+        return lazy_relabel_ops(flat, n, local_n)
+    if relabel:
+        from quest_tpu.parallel.relabel import plan_full_relabels
+        return plan_full_relabels(flat, n, local_n)
+    return flat
+
+
 def _shard_bands(n: int, local_n: int):
     """Band layout aligned to the shard boundary: full-width bands inside
     the local chunk, width-1 bands for global (device-index) qubits — the
@@ -482,7 +507,8 @@ def _band_op_sharded(chunk, dev, *, D, local_n, bop):
 
 def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
                                    mesh: Mesh, donate: bool = True,
-                                   lazy: bool = False):
+                                   lazy: bool = False,
+                                   relabel: bool = None):
     """Band-fusion engine over the mesh: the same planner that drives the
     single-chip engines (quest_tpu/ops/fusion.py), with bands aligned to
     the shard boundary. Commuting gate runs on local qubits compose into
@@ -490,14 +516,18 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     qubit (ONE ppermute pair exchange each — the reference would exchange
     once per gate, QuEST_cpu_distributed.c:846-881); cross-shard 2q
     unitaries KAK-decompose so their entangling content travels as
-    communication-free parity phases. lazy=True additionally rewrites the
-    flat list through lazy qubit relabeling (parallel/relabel.py) before
-    band planning — measured COUNTERPRODUCTIVE here (1152 -> 1856 B on
-    the deep-global testbed): run composition already amortizes global
-    exchanges to ~one per qubit per layer, and the inserted SWAPs break
-    band runs apart. Kept for experimentation; the win lives on the
-    per-gate engine (2304 -> 896 B, same testbed)."""
-    from quest_tpu.circuit import flatten_ops
+    communication-free parity phases.
+
+    relabel (default on) runs the layer-amortized relabeling pass
+    (parallel/relabel.py plan_full_relabels) — this engine is the f64
+    pod path, and the whole-register all-to-all events cut its ICI the
+    same way they cut the fused engine's: the event is a fusion BARRIER
+    between band runs, so unlike lazy's per-qubit SWAPs it cannot break
+    run composition. lazy=True instead rewrites through per-qubit lazy
+    relabeling — measured COUNTERPRODUCTIVE here (1152 -> 1856 B on the
+    deep-global testbed: the inserted SWAPs break band runs apart);
+    kept for experimentation and mutually exclusive with relabel
+    (requesting both explicitly raises)."""
     from quest_tpu.ops import fusion as F
 
     D = int(mesh.devices.size)
@@ -506,10 +536,7 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     _reject_measure_ops(ops)
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
-    flat = flatten_ops(ops, n, density)
-    if lazy:
-        from quest_tpu.parallel.relabel import lazy_relabel_ops
-        flat = lazy_relabel_ops(flat, n, local_n)
+    flat = engine_flat(ops, n, density, local_n, lazy=lazy, relabel=relabel)
     items = F.plan(flat, n, bands=_shard_bands(n, local_n))
 
     def run(chunk):
@@ -532,7 +559,7 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
 def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
                                   mesh: Mesh, donate: bool = True,
                                   interpret: bool = False,
-                                  relabel: bool = True):
+                                  relabel: bool = None):
     """The Pallas band-segment engine over the device mesh: the pod-scale
     composition of the two fastest paths in the framework. Runs of
     purely-local fused items (band contractions, diagonals, phases, pair
@@ -555,7 +582,6 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
 
     interpret=True runs the kernels in the Pallas interpreter (CPU-mesh
     testing)."""
-    from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
     from quest_tpu.ops import pallas_band as PB
 
@@ -567,23 +593,22 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     bands = fused_shard_bands(n, local_n)
     if bands is None:
-        # the Pallas kernel cannot host this chunk: banded fallback.
-        # NOT silent when the caller asked for fused-only behavior —
-        # interpret/relabel do not exist on the banded path, and a
+        # the Pallas kernel cannot host this chunk: banded fallback,
+        # forwarding `relabel` so a plain-vs-relabeled ablation stays
+        # honest. NOT silent when the caller asked for interpret-mode
+        # kernels — those do not exist on the banded path, and a
         # dropped flag here once turned a relabel test into a false
         # positive (caught in review, r4)
-        if interpret or not relabel:
+        if interpret:
             import sys
             print(f"[sharded] local_n={local_n} below the kernel tier's "
-                  f"minimum: falling back to the BANDED engine; "
-                  f"interpret/relabel arguments do not apply there",
+                  f"minimum: falling back to the BANDED engine; the "
+                  f"interpret argument does not apply there",
                   file=sys.stderr)
-        return compile_circuit_sharded_banded(ops, n, density, mesh, donate)
+        return compile_circuit_sharded_banded(ops, n, density, mesh,
+                                              donate, relabel=relabel)
 
-    flat = flatten_ops(ops, n, density)
-    if relabel:
-        from quest_tpu.parallel.relabel import plan_full_relabels
-        flat = plan_full_relabels(flat, n, local_n)
+    flat = engine_flat(ops, n, density, local_n, relabel=relabel)
     items = F.plan(flat, n, bands=bands)
 
     def local_only(it) -> bool:

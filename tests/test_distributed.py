@@ -660,7 +660,7 @@ def test_explain_sharded_reports_lowered_schedule(mesh):
     # the dict form is the script-facing surface (pod projection uses it)
     from quest_tpu.parallel import sharded_schedule
     rec = sharded_schedule(glob.ops, n, False, mesh, engine="banded")
-    assert rec["collective_permutes"] == count
+    assert rec["collective_exchanges"] == count
     assert rec["ici_bytes_per_device"] > 0
     assert rec["devices"] == D
 
