@@ -212,17 +212,17 @@ def _apply_op(amps, n, density, op: GateOp):
 
 def _estimate_ms(parts, n):
     """(lo, hi) estimated steady-state ms per application on one v5e,
-    from the measured 30q cost model (docs/KERNELS.md): a pass streams
-    at the chip's real 461 GB/s in-place rate, and each MatStage adds
-    MXU time proportional to its dot dim (~25 ms for a complex 128-dot
-    at HIGHEST at 30q). How much MXU time hides under the DMA window
-    varies with stacking (measured: single-stage segments hide almost
-    all of it, the 3-stage bench segment almost none), so the honest
-    answer is the [max(DMA, compute), DMA + compute] range, good to
-    ~5% at the edges — the measured bench application (79.9 ms) sits
-    inside its [53, 87], and a lone mirrored scb-128 pass (34.0 ms)
-    sits at lo (its dot hides fully when alone but still consumes MXU
-    time in stacked segments, so it stays charged)."""
+    from the measured 30q cost model (docs/KERNELS.md, r4 calibration):
+    a pass streams at the chip's real 461 GB/s in-place rate, and each
+    contraction stage adds ~25-29 ms of MXU time REGARDLESS of its dot
+    dim — a small-M dot idles most of the systolic array, so stage time
+    follows output size, not MACs (scripts/probe_scb_pos.py; the
+    pre-r4 d-scaled model underestimated narrow stages 10x). The
+    pipeline overlaps compute with the DMA stream at depth
+    (scripts/probe_stack.py), so the honest answer is the
+    [max(DMA, compute), DMA + compute] range — the measured bench
+    application (79.9 ms) sits AT its lo (79), and a lone mirrored
+    scb-128 pass (42.6 ms) just above its 34.7 DMA floor."""
     from quest_tpu.ops import fusion as F
     from quest_tpu.ops import pallas_band as PB
 
@@ -231,11 +231,23 @@ def _estimate_ms(parts, n):
 
     def compute_ms(st):
         if isinstance(st, PB.MatStage):
-            d = st.dim if st.kind in ("scb", "b1") else 128
             if st.kind == "sc":
-                return 5.0         # elementwise butterfly, VPU-bound
-            dot = 25.0 * d / 128.0
-            return dot * (2 / 3 if st.real_only else 1.0)
+                # elementwise butterfly, VPU-bound: ~23 ms each when
+                # stacked (7 stacked sc stages measured 160 ms at 30q,
+                # scripts/probe_scb_pos.py; a lone one hides under DMA)
+                return 23.0
+            # r4 calibration: an scb's MXU time is ~FLAT in d — a
+            # small-M dot idles most of the systolic array, so time
+            # follows output size, not MACs (top/mid/bottom d=8 all
+            # ~40 ms alone vs d=128's 42.6; the pre-r4 d-scaled model
+            # underestimated narrow stacked stages 10x and motivated a
+            # Kron-split that measured 3.8x SLOWER). One 128-class
+            # complex dot ~ 25 ms of MXU at HIGHEST; b1 adds ~4 ms of
+            # frame relayout.
+            # the +4 ms b1 frame relayout is data movement — real_only
+            # discounts only the MXU dot passes
+            return (25.0 * (2 / 3 if st.real_only else 1.0)
+                    + (4.0 if st.kind == "b1" else 0.0))
         if isinstance(st, PB.PairStage):
             return 12.0
         # phase / parity / diagvec: full-block elementwise + masks —
