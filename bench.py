@@ -123,6 +123,25 @@ def banded_fits(n: int) -> bool:
     return True
 
 
+
+def _engine_step(circ, n: int, engine: str, iters: int, density: bool):
+    """(compiled step, boundary state shape) for an engine name — the
+    ONE home of the engine -> (builder, shape) mapping, shared by the
+    statevector ladder and the density scenario (the fused engine's
+    boundary shape differs from the flat XLA ones; keeping the pairing
+    in one place stops the copies drifting)."""
+    from quest_tpu.state import fused_state_shape
+
+    if engine == "fused":
+        return (circ.compiled_fused(n, density=density, donate=True,
+                                    iters=iters), fused_state_shape(n))
+    if engine == "banded":
+        return (circ.compiled_banded(n, density=density, donate=True,
+                                     iters=iters), (2, 1 << n))
+    return (circ.compiled(n, density=density, donate=True, iters=iters),
+            (2, 1 << n))
+
+
 def _warm_step(n: int):
     """Compile + warm the benchmark step through the fastest engine that
     works on this platform (jit errors only surface at first call, so the
@@ -143,21 +162,8 @@ def _warm_step(n: int):
         circ = _build_circuit(n)
         t0 = time.perf_counter()
         try:
-            if name == "banded":
-                step = circ.compiled_banded(n, density=False, donate=True,
-                                            iters=INNER_STEPS)
-                shape = (2, 1 << n)
-            elif name == "fused":
-                step = circ.compiled_fused(n, density=False, donate=True,
-                                           iters=INNER_STEPS)
-                # the fused engine's native boundary shape: same physical
-                # tiling as its kernel views (flat would retile per call)
-                from quest_tpu.state import fused_state_shape
-                shape = fused_state_shape(n)
-            else:
-                step = circ.compiled(n, density=False, donate=True,
-                                     iters=INNER_STEPS)
-                shape = (2, 1 << n)
+            step, shape = _engine_step(circ, n, name, INNER_STEPS,
+                                       density=False)
             state = _basis_state(shape)
             state = step(state)  # warmup/compile
             _sync(state)
@@ -240,8 +246,6 @@ def _measure_density(reps: int):
     """(ops/sec, nd) through the fused engine on a density register, or
     (None, None) — the density figure must never break the headline
     JSON. Ladder over register sizes like the statevector bench."""
-    from quest_tpu.state import fused_state_shape
-
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     sizes = (15, 14, 13) if on_tpu else (10,)
     iters = 4
@@ -251,9 +255,11 @@ def _measure_density(reps: int):
             circ = _build_density_circuit(nd)
             num_ops = len(circ.ops)
             t0 = time.perf_counter()
-            step = circ.compiled_fused(n, density=True, donate=True,
-                                       iters=iters)
-            state = _basis_state(fused_state_shape(n))  # |0><0| flat
+            # the Pallas kernels need the chip; CPU degradation still
+            # reports a figure through the banded engine
+            step, shape = _engine_step(circ, n, "fused" if on_tpu
+                                       else "banded", iters, density=True)
+            state = _basis_state(shape)     # |0><0| flat
             state = step(state)
             _sync(state)
             _log(f"density nd={nd} compile+warmup "
