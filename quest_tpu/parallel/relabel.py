@@ -174,6 +174,17 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
         # slots, so it needs g <= local_n; tiny chunks keep the plain
         # swap-dance schedule
         return list(flat)
+    for op in flat:
+        if op.kind in ("measure", "measure_dm", "classical"):
+            # dynamic-circuit ops carry NESTED gate lists in their
+            # operands that this pass does not remap — the sharded
+            # builders that call it reject measure ops up front
+            # (_reject_measure_ops); this guard keeps a future caller
+            # from silently corrupting a dynamic circuit
+            raise ValueError(
+                "plan_full_relabels cannot rewrite dynamic-circuit ops "
+                f"(got kind={op.kind!r}); relabeling applies to static "
+                "circuits only")
 
     def exchange_cost(op, pperm):
         """Chunk-equivalents the engine would ship for this op as-is."""
