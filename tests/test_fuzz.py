@@ -178,7 +178,9 @@ def test_fuzz_sharded_engines(seed):
     for label, compiler, kw in (
             ("pergate", compile_circuit_sharded, {}),
             ("lazy", compile_circuit_sharded, {"lazy": True}),
-            ("banded", compile_circuit_sharded_banded, {})):
+            ("banded", compile_circuit_sharded_banded, {}),  # relabel on
+            ("banded-plain", compile_circuit_sharded_banded,
+             {"relabel": False})):
         step = compiler(c.ops, N, False, mesh, donate=False, **kw)
         got = to_dense(load().replace_amps(step(load().amps)))
         np.testing.assert_allclose(got, want, atol=1e-11, rtol=0,
