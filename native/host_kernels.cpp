@@ -1,0 +1,301 @@
+// Native host statevector engine: cache-blocked gate-program execution on
+// split re/im planes.
+//
+// This is the framework's CPU-backend counterpart of the reference's
+// single-threaded CPU kernels (QuEST_cpu.c:1656-1713 general unitary,
+// 2940-3109 diagonal/phase families) — re-designed for the host memory
+// hierarchy rather than translated: instead of one full sweep over the
+// state per gate, the Python planner (quest_tpu/host.py) groups
+// consecutive gates whose TARGETS all sit below a block boundary B, and
+// this runner applies the whole group to one 2^B-amplitude block while it
+// is resident in L2, then moves to the next block. A 16-gate layer on
+// low qubits costs ONE read+write of the state instead of sixteen — the
+// host analogue of the TPU band-fusion engine (quest_tpu/ops/fusion.py).
+//
+// Layout matches the framework's device convention (quest_tpu/state.py):
+// a register is two contiguous planes re[2^n], im[2^n]; amplitude index i
+// is little-endian (qubit q = bit q of i); a k-target operator matrix
+// m[r, c] uses bit j of r/c for targets[j] (targets[0] = least
+// significant matrix bit), identical to the reference's
+// multiQubitUnitary convention (QuEST_cpu.c:1814-1898).
+//
+// Program encoding (built by quest_tpu/host.py):
+//   int32 stream, one record per gate:
+//     [kind, k, nc, t0..t_{k-1}, c0..c_{nc-1}, s0..s_{nc-1}, coff]
+//   kind 0 = matrix   coef[coff..]: 2*4^k doubles, row-major, re/im pairs
+//   kind 1 = diagonal coef[coff..]: 2*2^k doubles, re/im pairs
+//   kind 2 = parity   coef[coff..]: 4 doubles (even-parity factor,
+//                     odd-parity factor re/im) — exp(-+i angle/2)
+//   groups: int32 pairs (gate_count, blocked_flag) partitioning the
+//   program in order; blocked groups run block-by-block, unblocked
+//   groups run each gate as one full-range sweep.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct HGate {
+    int kind;
+    int k;
+    uint64_t tmask;
+    uint64_t cmask;
+    uint64_t cval;
+    uint64_t off[64];       // amp offset per matrix/diag index pattern
+    uint64_t smask[6];      // sorted-ascending target bit masks (expand)
+    std::vector<double> coef;
+};
+
+inline uint64_t expand_zeros(uint64_t j, const uint64_t* smask, int k) {
+    // insert a 0 bit at each (ascending) target position
+    for (int i = 0; i < k; ++i) {
+        uint64_t m = smask[i];
+        j = ((j & ~(m - 1)) << 1) | (j & (m - 1));
+    }
+    return j;
+}
+
+bool parse_program(const int32_t* prog, int64_t plen, const double* coef,
+                   std::vector<HGate>& out) {
+    int64_t p = 0;
+    while (p < plen) {
+        if (plen - p < 4) return false;
+        HGate g;
+        g.kind = prog[p++];
+        g.k = prog[p++];
+        int nc = prog[p++];
+        if (g.k < 0 || g.k > 6 || nc < 0 || nc > 48) return false;
+        if (plen - p < g.k + 2 * nc + 1) return false;
+        int32_t tg[6];
+        g.tmask = 0;
+        for (int i = 0; i < g.k; ++i) {
+            tg[i] = prog[p++];
+            g.tmask |= 1ULL << tg[i];
+        }
+        g.cmask = 0;
+        g.cval = 0;
+        int32_t cq[48];
+        for (int i = 0; i < nc; ++i) {
+            cq[i] = prog[p++];
+            g.cmask |= 1ULL << cq[i];
+        }
+        for (int i = 0; i < nc; ++i)
+            if (prog[p++]) g.cval |= 1ULL << cq[i];
+        int64_t coff = prog[p++];
+        int dim = 1 << g.k;
+        // pattern -> amplitude offset (matrix bit j <-> targets[j])
+        for (int pat = 0; pat < dim; ++pat) {
+            uint64_t o = 0;
+            for (int j = 0; j < g.k; ++j)
+                if ((pat >> j) & 1) o |= 1ULL << tg[j];
+            g.off[pat] = o;
+        }
+        // ascending bit masks for base expansion
+        {
+            uint64_t m = g.tmask;
+            int i = 0;
+            while (m) {
+                uint64_t low = m & (~m + 1);
+                g.smask[i++] = low;
+                m ^= low;
+            }
+        }
+        int64_t ncoef = g.kind == 0 ? 2LL * dim * dim
+                      : g.kind == 1 ? 2LL * dim
+                      : 4;
+        g.coef.assign(coef + coff, coef + coff + ncoef);
+        out.push_back(std::move(g));
+    }
+    return true;
+}
+
+// ---- kernels; all operate on the half-open amp range [lo, hi) ------------
+
+template <typename T>
+void gate1_fast(T* re, T* im, uint64_t lo, uint64_t hi, uint64_t stride,
+                const double* m) {
+    const T are = (T)m[0], aim = (T)m[1], bre = (T)m[2], bim = (T)m[3];
+    const T cre = (T)m[4], cim = (T)m[5], dre = (T)m[6], dim_ = (T)m[7];
+    for (uint64_t base = lo; base < hi; base += (stride << 1)) {
+        T* __restrict r0 = re + base;
+        T* __restrict i0 = im + base;
+        T* __restrict r1 = re + base + stride;
+        T* __restrict i1 = im + base + stride;
+        for (uint64_t j = 0; j < stride; ++j) {
+            T x0 = r0[j], y0 = i0[j], x1 = r1[j], y1 = i1[j];
+            r0[j] = are * x0 - aim * y0 + bre * x1 - bim * y1;
+            i0[j] = are * y0 + aim * x0 + bre * y1 + bim * x1;
+            r1[j] = cre * x0 - cim * y0 + dre * x1 - dim_ * y1;
+            i1[j] = cre * y0 + cim * x0 + dre * y1 + dim_ * x1;
+        }
+    }
+}
+
+template <typename T>
+void diag1_fast(T* re, T* im, uint64_t lo, uint64_t hi, uint64_t stride,
+                const double* d) {
+    const T e0r = (T)d[0], e0i = (T)d[1], e1r = (T)d[2], e1i = (T)d[3];
+    for (uint64_t base = lo; base < hi; base += (stride << 1)) {
+        T* __restrict r0 = re + base;
+        T* __restrict i0 = im + base;
+        T* __restrict r1 = re + base + stride;
+        T* __restrict i1 = im + base + stride;
+        for (uint64_t j = 0; j < stride; ++j) {
+            T x0 = r0[j], y0 = i0[j];
+            r0[j] = e0r * x0 - e0i * y0;
+            i0[j] = e0r * y0 + e0i * x0;
+            T x1 = r1[j], y1 = i1[j];
+            r1[j] = e1r * x1 - e1i * y1;
+            i1[j] = e1r * y1 + e1i * x1;
+        }
+    }
+}
+
+template <typename T>
+void matrix_general(T* re, T* im, uint64_t lo, uint64_t hi, const HGate& g,
+                    uint64_t cmask_in, uint64_t cval_in) {
+    const int dim = 1 << g.k;
+    const uint64_t span = hi - lo;
+    const uint64_t nbase = span >> g.k;
+    T tr[64], ti[64], ar[64], ai[64];
+    for (uint64_t j = 0; j < nbase; ++j) {
+        uint64_t idx0 = lo | expand_zeros(j, g.smask, g.k);
+        if ((idx0 & cmask_in) != cval_in) continue;
+        for (int p = 0; p < dim; ++p) {
+            tr[p] = re[idx0 | g.off[p]];
+            ti[p] = im[idx0 | g.off[p]];
+        }
+        const double* mp = g.coef.data();
+        for (int r = 0; r < dim; ++r) {
+            T accr = 0, acci = 0;
+            for (int c = 0; c < dim; ++c) {
+                T mr = (T)mp[2 * (r * dim + c)];
+                T mi = (T)mp[2 * (r * dim + c) + 1];
+                accr += mr * tr[c] - mi * ti[c];
+                acci += mr * ti[c] + mi * tr[c];
+            }
+            ar[r] = accr;
+            ai[r] = acci;
+        }
+        for (int r = 0; r < dim; ++r) {
+            re[idx0 | g.off[r]] = ar[r];
+            im[idx0 | g.off[r]] = ai[r];
+        }
+    }
+}
+
+template <typename T>
+void diag_general(T* re, T* im, uint64_t lo, uint64_t hi, const HGate& g,
+                  uint64_t cmask_in, uint64_t cval_in) {
+    const int dim = 1 << g.k;
+    const uint64_t nbase = (hi - lo) >> g.k;
+    for (uint64_t j = 0; j < nbase; ++j) {
+        uint64_t idx0 = lo | expand_zeros(j, g.smask, g.k);
+        if ((idx0 & cmask_in) != cval_in) continue;
+        for (int p = 0; p < dim; ++p) {
+            uint64_t idx = idx0 | g.off[p];
+            T dr = (T)g.coef[2 * p], di = (T)g.coef[2 * p + 1];
+            T x = re[idx], y = im[idx];
+            re[idx] = dr * x - di * y;
+            im[idx] = dr * y + di * x;
+        }
+    }
+}
+
+template <typename T>
+void parity_phase(T* re, T* im, uint64_t lo, uint64_t hi, const HGate& g) {
+    const T f0r = (T)g.coef[0], f0i = (T)g.coef[1];
+    const T f1r = (T)g.coef[2], f1i = (T)g.coef[3];
+    for (uint64_t i = lo; i < hi; ++i) {
+        int par = __builtin_popcountll(i & g.tmask) & 1;
+        T fr = par ? f1r : f0r, fi = par ? f1i : f0i;
+        T x = re[i], y = im[i];
+        re[i] = fr * x - fi * y;
+        im[i] = fr * y + fi * x;
+    }
+}
+
+template <typename T>
+void apply_in_range(T* re, T* im, uint64_t lo, uint64_t hi, const HGate& g) {
+    // caller guarantees: targets < log2(hi-lo); control bits >= the span
+    // already checked against lo
+    const uint64_t span_mask = (hi - lo) - 1;
+    const uint64_t cmask_in = g.cmask & span_mask;
+    const uint64_t cval_in = g.cval & span_mask;
+    if (g.kind == 2) {
+        parity_phase(re, im, lo, hi, g);
+        return;
+    }
+    if (g.k == 1 && cmask_in == 0) {
+        if (g.kind == 0)
+            gate1_fast(re, im, lo, hi, g.tmask, g.coef.data());
+        else
+            diag1_fast(re, im, lo, hi, g.tmask, g.coef.data());
+        return;
+    }
+    if (g.kind == 0)
+        matrix_general(re, im, lo, hi, g, cmask_in, cval_in);
+    else
+        diag_general(re, im, lo, hi, g, cmask_in, cval_in);
+}
+
+template <typename T>
+int run_program(T* re, T* im, int n, const int32_t* prog, int64_t plen,
+                const double* coef, const int32_t* groups, int ngroups,
+                int block_log, int iters) {
+    std::vector<HGate> gates;
+    if (!parse_program(prog, plen, coef, gates)) return 1;
+    const uint64_t namps = 1ULL << n;
+    if (block_log > n) block_log = n;
+    const uint64_t bsz = 1ULL << block_log;
+    const uint64_t high_mask = ~(bsz - 1);
+    for (int it = 0; it < iters; ++it) {
+        size_t gi = 0;
+        for (int grp = 0; grp < ngroups; ++grp) {
+            int count = groups[2 * grp];
+            int blocked = groups[2 * grp + 1];
+            if (gi + count > gates.size()) return 2;
+            if (blocked) {
+                for (uint64_t base = 0; base < namps; base += bsz) {
+                    for (int t = 0; t < count; ++t) {
+                        const HGate& g = gates[gi + t];
+                        // controls above the block: whole block passes or
+                        // fails at once
+                        uint64_t ch = g.cmask & high_mask;
+                        if ((base & ch) != (g.cval & ch)) continue;
+                        apply_in_range(re, im, base, base + bsz, g);
+                    }
+                }
+            } else {
+                for (int t = 0; t < count; ++t)
+                    apply_in_range(re, im, (uint64_t)0, namps, gates[gi + t]);
+            }
+            gi += count;
+        }
+        if (gi != gates.size()) return 2;
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int qh_run_program_f32(float* re, float* im, int n, const int32_t* prog,
+                       int64_t plen, const double* coef,
+                       const int32_t* groups, int ngroups, int block_log,
+                       int iters) {
+    return run_program(re, im, n, prog, plen, coef, groups, ngroups,
+                       block_log, iters);
+}
+
+int qh_run_program_f64(double* re, double* im, int n, const int32_t* prog,
+                       int64_t plen, const double* coef,
+                       const int32_t* groups, int ngroups, int block_log,
+                       int iters) {
+    return run_program(re, im, n, prog, plen, coef, groups, ngroups,
+                       block_log, iters);
+}
+
+}  // extern "C"

@@ -788,6 +788,41 @@ class Circuit:
         self._compiled[key] = fn
         return fn
 
+    def compiled_host(self, n: int, density: bool, iters: int = 1):
+        """Compiled program on the NATIVE HOST engine (quest_tpu.host):
+        cache-blocked C++ kernels applying whole gate groups per
+        L2-resident block — the CPU-backend counterpart of the
+        reference's per-gate sweeps (QuEST_cpu.c:1656-1713), used by the
+        bench fallback ladder when no TPU is reachable. Returns
+        step(state)->state over numpy (2, 2^n) planes (jax host arrays
+        convert on first call); ALWAYS updates writable numpy input in
+        place (callers wanting a pristine input pass a copy — see
+        apply_host). Raises host.HostEngineUnsupported on dynamic ops /
+        traced operands so callers fall back loudly."""
+        self._reject_measure("compiled_host")
+        from quest_tpu import host as H
+        # QUEST_HOST_BLOCK is read at encode time — key it so flipping it
+        # mid-process can't return a stale program (the cache-key
+        # discipline from ADVICE r4 item 2)
+        key = ("host", n, density, iters,
+               os.environ.get("QUEST_HOST_BLOCK", ""))
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = H.compile_circuit_host(self.ops, n, density, iters)
+            self._compiled[key] = fn
+        return fn
+
+    def apply_host(self, q: Qureg, donate: bool = False) -> Qureg:
+        """Apply via the native host engine (numpy planes). donate=False
+        copies first so q's buffer survives (the engine itself is
+        in-place)."""
+        if self.num_qubits != q.num_qubits:
+            raise ValueError("circuit/register size mismatch")
+        fn = self.compiled_host(q.num_state_qubits, q.is_density)
+        import numpy as _np
+        amps = _np.array(q.amps) if not donate else q.amps
+        return q.replace_amps(jnp.asarray(fn(amps)))
+
     def banded_trace(self, amps, n: int, density: bool):
         """Apply the band-fusion plan to raw amplitudes inside an existing
         trace (the un-jitted core of compiled_banded)."""

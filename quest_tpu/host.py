@@ -1,0 +1,250 @@
+"""Native host (CPU) circuit engine: cache-blocked C++ kernel execution.
+
+The framework's counterpart of the reference's CPU backend
+(QuEST_cpu.c) — but planned for the host memory hierarchy instead of
+translated: consecutive gates whose TARGETS all sit below a block
+boundary B are grouped, and the native runner (native/host_kernels.cpp)
+applies the whole group to one 2^B-amplitude block while it is resident
+in L2 before moving on. A 16-gate layer on low qubits costs ONE
+read+write sweep of the state instead of sixteen — the host analogue of
+the TPU band-fusion engine (quest_tpu/ops/fusion.py), and the reason
+this engine beats the reference's per-gate sweeps (QuEST_cpu.c:1656-1713
+touches the full state once per gate) on the same silicon.
+
+This engine exists for the CPU-fallback path (bench.py's ladder when no
+TPU is reachable) and as a fast host-side oracle; the TPU engines remain
+the primary compute path. Supported op kinds after flatten_ops:
+matrix / diagonal / parity / allones (superops arrive pre-flattened as
+matrix ops). Dynamic ops (measure/classical) and traced operands raise
+HostEngineUnsupported so callers fall back loudly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from quest_tpu import native
+
+_DEFAULT_BLOCK_LOG = 17     # 2^17 amps x 2 planes x 4 B = 1 MiB, inside a
+                            # 2 MiB L2. Measured on the bench circuit
+                            # (16 rx over qubits 1..16 @ 24q): 2^17 ->
+                            # 140 gates/s, 2^16 -> 114, 2^18 -> 130,
+                            # 2^15 -> 121 (reference CPU build: 8.98)
+_MAX_TARGETS = 6
+
+
+class HostEngineUnsupported(RuntimeError):
+    """Raised when a circuit cannot run on the native host engine
+    (dynamic ops, traced operands, too many targets, or no native lib);
+    callers fall back to an XLA engine and report the fallback."""
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    for name, fp in (("qh_run_program_f32", ctypes.c_float),
+                     ("qh_run_program_f64", ctypes.c_double)):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ctypes.POINTER(fp), ctypes.POINTER(fp), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        fn.restype = ctypes.c_int
+
+
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not native.available():
+        return None
+    lib = native._load()
+    try:
+        _bind(lib)
+    except AttributeError:
+        # stale prebuilt library: native._load() succeeds on its own
+        # (older) symbols, so ITS rebuild path never fires — rebuild
+        # here and re-open the fresh .so (new inode; a second CDLL on
+        # the path maps the rebuilt file)
+        if not native._build():
+            return None
+        try:
+            lib = ctypes.CDLL(native._LIB_PATH)
+            _bind(lib)
+        except (OSError, AttributeError):
+            return None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_concrete(operand) -> np.ndarray:
+    try:
+        arr = np.asarray(operand)
+    except Exception as e:      # jax TracerArrayConversionError et al.
+        raise HostEngineUnsupported(f"traced operand ({type(e).__name__})")
+    if arr.dtype == object or not np.issubdtype(arr.dtype, np.number):
+        raise HostEngineUnsupported("traced/non-numeric operand")
+    return arr.astype(np.complex128)
+
+
+def _encode(flat_ops, n: int):
+    """(prog int32[], coef float64[], groups int32[], block_log) for the
+    native runner. Raises HostEngineUnsupported on anything the C side
+    does not implement."""
+    block_log = int(os.environ.get("QUEST_HOST_BLOCK", _DEFAULT_BLOCK_LOG))
+    block_log = max(1, min(block_log, n))
+
+    prog: List[int] = []
+    coef: List[float] = []
+    records = []        # (max_target, prog record) per gate
+
+    def emit(kind, targets, controls, cstates, values):
+        coff = len(coef)
+        coef.extend(values)
+        rec = [kind, len(targets), len(controls), *targets, *controls,
+               *cstates, coff]
+        records.append((max(targets), rec))
+
+    for op in flat_ops:
+        if op.kind in ("measure", "measure_dm", "classical"):
+            raise HostEngineUnsupported(f"dynamic op {op.kind!r}")
+        controls = tuple(int(c) for c in op.controls)
+        cstates = tuple(int(s) for s in (op.cstates or (1,) * len(controls)))
+        targets = tuple(int(t) for t in op.targets)
+        if op.kind == "matrix":
+            m = _as_concrete(op.operand).reshape(1 << len(targets),
+                                                 1 << len(targets))
+            if len(targets) > _MAX_TARGETS:
+                raise HostEngineUnsupported(
+                    f"{len(targets)}-target matrix (max {_MAX_TARGETS})")
+            vals = np.empty(2 * m.size)
+            vals[0::2] = m.real.ravel()
+            vals[1::2] = m.imag.ravel()
+            emit(0, targets, controls, cstates, vals.tolist())
+        elif op.kind == "diagonal":
+            d = _as_concrete(op.operand).reshape(-1)
+            if d.size != 1 << len(targets):
+                raise HostEngineUnsupported("diagonal size mismatch")
+            if len(targets) > _MAX_TARGETS:
+                raise HostEngineUnsupported(
+                    f"{len(targets)}-target diagonal (max {_MAX_TARGETS})")
+            vals = np.empty(2 * d.size)
+            vals[0::2] = d.real
+            vals[1::2] = d.imag
+            emit(1, targets, controls, cstates, vals.tolist())
+        elif op.kind == "allones":
+            # phase `term` where ALL listed qubits are 1 — matches
+            # apply_phase_on_all_ones: a [1, term] diagonal on targets[0]
+            # controlled on the rest (circuit._apply_one ignores
+            # op.controls for this kind, as does the XLA path)
+            term = complex(_as_concrete(op.operand).reshape(()))
+            qubits = targets
+            emit(1, (qubits[0],), qubits[1:], (1,) * (len(qubits) - 1),
+                 [1.0, 0.0, term.real, term.imag])
+        elif op.kind == "parity":
+            # exp(-i angle/2 * Z..Z): even-parity factor exp(-i a/2),
+            # odd-parity exp(+i a/2)  (ops/apply.py:apply_parity_phase)
+            a = float(np.asarray(op.operand).reshape(()))
+            f0 = complex(np.cos(a / 2), -np.sin(a / 2))
+            f1 = complex(np.cos(a / 2), +np.sin(a / 2))
+            emit(2, targets, (), (), [f0.real, f0.imag, f1.real, f1.imag])
+        else:
+            raise HostEngineUnsupported(f"op kind {op.kind!r}")
+
+    # greedy blocked grouping: gates whose targets all sit below the block
+    # boundary share one L2-resident sweep; others run as full sweeps
+    groups: List[int] = []
+    cur = 0             # pending blocked-group size
+    for max_t, rec in records:
+        # parity is elementwise on absolute indices — blockable at any
+        # target position; matrix/diag need their targets inside the block
+        blockable = rec[0] == 2 or max_t < block_log
+        if blockable:
+            cur += 1
+        else:
+            if cur:
+                groups += [cur, 1]
+                cur = 0
+            groups += [1, 0]
+        prog.extend(rec)
+    if cur:
+        groups += [cur, 1]
+
+    return (np.asarray(prog, dtype=np.int32),
+            np.asarray(coef, dtype=np.float64),
+            np.asarray(groups, dtype=np.int32),
+            block_log)
+
+
+def plan_summary(flat_ops, n: int) -> str:
+    """Human-readable sweep plan (for Circuit.explain): how many full
+    state sweeps the blocked schedule costs vs the per-gate count."""
+    prog, coef, groups, block_log = _encode(flat_ops, n)
+    ngates = 0
+    sweeps = 0
+    it = iter(groups.tolist())
+    for count, blocked in zip(it, it):
+        ngates += count
+        sweeps += 1 if blocked else count
+    return (f"host engine: {ngates} gates in {sweeps} state sweep(s) "
+            f"(block=2^{block_log} amps)")
+
+
+def compile_circuit_host(ops, n: int, density: bool, iters: int = 1):
+    """step(state) -> state running the whole (flattened) circuit through
+    the native blocked runner, `iters` times per call. `state` is the
+    (2, 2^n) split-plane register (numpy or any array-protocol object;
+    jax host arrays convert on first call); float32 and float64 planes
+    both dispatch to matching kernels. The returned array is updated
+    in place across calls (donation semantics — the input buffer is the
+    output buffer once it is a writable numpy array)."""
+    from quest_tpu.circuit import flatten_ops
+
+    lib = _load()
+    if lib is None:
+        raise HostEngineUnsupported("native host library unavailable")
+    flat = flatten_ops(ops, n, density)
+    if not flat:
+        return lambda state: state
+    prog, coef, groups, block_log = _encode(flat, n)
+    ngroups = len(groups) // 2
+    prog_p = prog.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    coef_p = coef.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    groups_p = groups.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    def step(state):
+        arr = np.asarray(state)
+        if arr.shape != (2, 1 << n):
+            raise ValueError(
+                f"state shape {arr.shape} != (2, {1 << n})")
+        if arr.dtype not in (np.float32, np.float64):
+            arr = arr.astype(np.float32)
+        if not (arr.flags.c_contiguous and arr.flags.writeable):
+            arr = np.ascontiguousarray(arr).copy()
+        if arr.dtype == np.float32:
+            fn, fp = lib.qh_run_program_f32, ctypes.c_float
+        else:
+            fn, fp = lib.qh_run_program_f64, ctypes.c_double
+        re_p = arr[0].ctypes.data_as(ctypes.POINTER(fp))
+        im_p = arr[1].ctypes.data_as(ctypes.POINTER(fp))
+        rc = fn(re_p, im_p, n, prog_p, len(prog), coef_p, groups_p,
+                ngroups, block_log, iters)
+        if rc != 0:
+            raise RuntimeError(f"native host runner failed (rc={rc})")
+        return arr
+
+    return step

@@ -112,6 +112,11 @@ def test_fuzz_statevector_all_engines(seed):
     got_b = to_dense(c.apply_banded(load()))
     np.testing.assert_allclose(got_b, want, atol=1e-11, rtol=0,
                                err_msg=f"banded seed={seed}")
+    from quest_tpu import host as H
+    if H.available():
+        got_h = to_dense(c.apply_host(load()))
+        np.testing.assert_allclose(got_h, want, atol=1e-11, rtol=0,
+                                   err_msg=f"host seed={seed}")
     # inverse round-trip restores the input exactly
     back = to_dense(c.inverse().apply(c.apply(load())))
     np.testing.assert_allclose(back, v0, atol=1e-11, rtol=0,
@@ -154,6 +159,11 @@ def test_fuzz_density_with_channels(seed):
     got = to_dense(c.apply(q0))
     np.testing.assert_allclose(got, want, atol=1e-10, rtol=0,
                                err_msg=f"density seed={seed}")
+    from quest_tpu import host as H
+    if H.available():
+        got_h = to_dense(c.apply_host(q0))
+        np.testing.assert_allclose(got_h, want, atol=1e-10, rtol=0,
+                                   err_msg=f"host density seed={seed}")
 
 
 @pytest.mark.parametrize("seed", range(4))

@@ -1,0 +1,170 @@
+"""Native host engine (quest_tpu/host.py + native/host_kernels.cpp):
+oracle equivalence, blocked-scheduling invariance, dtype dispatch, and
+loud unsupported-op fallback.
+
+The host engine is the CPU-backend counterpart of the reference's
+QuEST_cpu.c kernels; these tests play the role the reference's
+unit tests play for that backend (same 5-qubit scale,
+tests/utilities.hpp:36), against the same independent dense oracle the
+other engines are checked with.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import host
+from quest_tpu.circuit import Circuit, GateOp, flatten_ops
+from quest_tpu.state import init_state_from_amps, to_dense
+
+from . import oracle
+
+pytestmark = pytest.mark.skipif(not host.available(),
+                                reason="native host library unavailable")
+
+N = 6
+
+
+def _mixed_circuit(rng, n):
+    """A circuit hitting every supported kind: plain/controlled matrices
+    (1-3 targets, 0-control states), diagonals, parity, all-ones."""
+    c = Circuit(n)
+    ops = []
+
+    def add(matrix, targets, controls=(), cstates=None):
+        c.gate(matrix, targets, controls, cstates)
+        ops.append((np.asarray(matrix), tuple(targets), tuple(controls),
+                    tuple(cstates) if cstates else None))
+
+    qs = [int(q) for q in rng.permutation(n)]
+    add(oracle.random_unitary(1, rng), (qs[0],))
+    add(oracle.random_unitary(1, rng), (qs[1],), (qs[2],), (0,))
+    add(oracle.random_unitary(2, rng), (qs[3], qs[0]))
+    add(oracle.random_unitary(3, rng), (qs[2], qs[5], qs[1]))
+    add(oracle.random_unitary(2, rng), (qs[4], qs[2]), (qs[0], qs[1]),
+        (1, 0))
+    d = np.exp(1j * rng.uniform(0, 2 * np.pi, 4))
+    c.ops.append(GateOp("diagonal", (qs[1], qs[4]), (qs[5],), (1,),
+                        np.asarray(d)))
+    ops.append((np.diag(d), (qs[1], qs[4]), (qs[5],), (1,)))
+    ang = float(rng.uniform(0, 2 * np.pi))
+    c.multi_rotate_z((qs[0], qs[3], qs[5]), ang)
+    par = np.array([np.exp(-1j * ang / 2 * (-1.0) **
+                           (bin(i).count("1") & 1)) for i in range(8)])
+    ops.append((np.diag(par), (qs[0], qs[3], qs[5]), (), None))
+    c.cphase(0.77, qs[2], qs[4])
+    ops.append((np.diag([1, 1, 1, np.exp(1j * 0.77)]),
+                (qs[2], qs[4]), (), None))
+    return c, ops
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_host_matches_oracle(seed):
+    rng = np.random.default_rng(500 + seed)
+    c, ops = _mixed_circuit(rng, N)
+    v0 = oracle.random_statevector(N, rng)
+    want = v0
+    for mat, targets, controls, cstates in ops:
+        want = oracle.apply_to_vector(want, N, mat, targets, controls,
+                                      cstates)
+    q = init_state_from_amps(qt.create_qureg(N, dtype=np.complex128),
+                             v0.real, v0.imag)
+    got = to_dense(c.apply_host(q))
+    np.testing.assert_allclose(got, want, atol=1e-12, rtol=0)
+
+
+@pytest.mark.parametrize("block", ["1", "3", "4"])
+def test_host_blocked_schedule_invariant(block):
+    """Tiny forced block sizes split the program into many groups and
+    block sweeps; the result must be identical to the one-group run."""
+    rng = np.random.default_rng(77)
+    c, ops = _mixed_circuit(rng, N)
+    v0 = oracle.random_statevector(N, rng)
+    base = c.compiled_host(N, False)(
+        np.stack([v0.real, v0.imag]).astype(np.float64))
+    old = os.environ.get("QUEST_HOST_BLOCK")
+    os.environ["QUEST_HOST_BLOCK"] = block
+    try:
+        got = c.compiled_host(N, False)(
+            np.stack([v0.real, v0.imag]).astype(np.float64))
+    finally:
+        if old is None:
+            del os.environ["QUEST_HOST_BLOCK"]
+        else:
+            os.environ["QUEST_HOST_BLOCK"] = old
+    np.testing.assert_allclose(got, base, atol=1e-13, rtol=0)
+
+
+def test_host_f32_dispatch():
+    rng = np.random.default_rng(9)
+    c, ops = _mixed_circuit(rng, N)
+    v0 = oracle.random_statevector(N, rng)
+    want = c.compiled_host(N, False)(
+        np.stack([v0.real, v0.imag]).astype(np.float64))
+    got32 = c.compiled_host(N, False)(
+        np.stack([v0.real, v0.imag]).astype(np.float32))
+    assert got32.dtype == np.float32
+    np.testing.assert_allclose(got32, want, atol=1e-5, rtol=0)
+
+
+def test_host_density_channels():
+    """Density register with channels: superops flatten to doubled-target
+    matrix ops, gate duals included — same oracle as the XLA engines."""
+    nd = 3
+    rng = np.random.default_rng(123)
+    c = Circuit(nd)
+    u = oracle.random_unitary(1, rng)
+    c.gate(u, (1,))
+    c.damping(0, 0.2)
+    c.dephasing(2, 0.3)
+    rho0 = oracle.random_density(nd, rng)
+    want = oracle.apply_to_density(rho0, nd, u, (1,))
+    from quest_tpu.ops.matrices import damping_kraus, dephasing_kraus
+    want = oracle.apply_kraus_to_density(want, nd, damping_kraus(0.2), (0,))
+    want = oracle.apply_kraus_to_density(want, nd, dephasing_kraus(0.3),
+                                         (2,))
+    flat = rho0.reshape(-1, order="F")
+    q0 = init_state_from_amps(
+        qt.create_density_qureg(nd, dtype=np.complex128),
+        flat.real, flat.imag)
+    got = to_dense(c.apply_host(q0))
+    np.testing.assert_allclose(got, want, atol=1e-12, rtol=0)
+
+
+def test_host_iters_repeat():
+    rng = np.random.default_rng(4)
+    c, _ = _mixed_circuit(rng, N)
+    v0 = oracle.random_statevector(N, rng)
+    planes = np.stack([v0.real, v0.imag]).astype(np.float64)
+    one = c.compiled_host(N, False, iters=1)
+    x = planes.copy()
+    for _ in range(3):
+        x = one(x)
+    y = c.compiled_host(N, False, iters=3)(planes.copy())
+    np.testing.assert_allclose(y, x, atol=0, rtol=0)
+
+
+def test_host_unsupported_is_loud():
+    c = Circuit(2)
+    c.h(0)
+    c.measure(0)
+    with pytest.raises(Exception, match="measure|measurement"):
+        c.compiled_host(2, False)
+
+    # beyond the native runner's target limit -> typed, catchable error
+    c2 = Circuit(8)
+    c2.ops.append(GateOp("matrix", tuple(range(7)), (), (),
+                         np.eye(128, dtype=complex)))
+    with pytest.raises(host.HostEngineUnsupported):
+        c2.compiled_host(8, False)
+
+
+def test_host_plan_summary_counts_sweeps():
+    c = Circuit(20)
+    for q in range(8):
+        c.rx(q, 0.1)           # low targets: one blocked sweep
+    c.rx(19, 0.2)              # high target: own full sweep
+    s = host.plan_summary(flatten_ops(c.ops, 20, False), 20)
+    assert "9 gates" in s and "2 state sweep(s)" in s
