@@ -163,4 +163,28 @@ long long qh_read_state_csv(const char* path, double* re, double* im,
     return count;
 }
 
+// ISA extensions this build requires (the Makefile compiles with
+// -march=native, so a prebuilt .so copied to an older machine would
+// SIGILL with no diagnostics). quest_tpu/native.py compares this list
+// against /proc/cpuinfo flags at load time and rebuilds on mismatch.
+const char* qh_isa_requirements(void) {
+    return ""
+#ifdef __AVX512F__
+        "avx512f "
+#endif
+#ifdef __AVX512VL__
+        "avx512vl "
+#endif
+#ifdef __AVX2__
+        "avx2 "
+#endif
+#ifdef __FMA__
+        "fma "
+#endif
+#ifdef __AVX__
+        "avx "
+#endif
+        ;
+}
+
 }  // extern "C"

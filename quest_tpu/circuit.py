@@ -631,14 +631,15 @@ class Circuit:
         return inv
 
     @classmethod
-    def from_qasm(cls, text: str) -> "Circuit":
+    def from_qasm(cls, text: str, u_dialect: str | None = None) -> "Circuit":
         """Parse OPENQASM 2.0 text into a Circuit — the recorder's own
         dialect (Ctrl- prefixes, U(rz2, ry, rz1) lines) and standard
         qelib1 gates both load; see quest_tpu/qasm_import.py. The
         reference has no importer (its QASM support is write-only,
-        QuEST_qasm.c)."""
+        QuEST_qasm.c). `u_dialect` ('spec' | 'recorder') pins the
+        capital-U parameter convention when the marker heuristic can't."""
         from quest_tpu.qasm_import import circuit_from_qasm
-        return circuit_from_qasm(text)
+        return circuit_from_qasm(text, u_dialect=u_dialect)
 
     def to_qasm(self) -> str:
         """OPENQASM 2.0 text of this circuit, through the same logger the
@@ -1037,8 +1038,13 @@ class Circuit:
         # commit the backend early (env.py ordering contract).
         kind = "?"
         try:
+            # backends_are_initialized() is the named API for "has this
+            # process committed to a backend" (pinned by
+            # tests/test_docs.py::test_backend_probe_api so a JAX
+            # upgrade that renames it fails loudly instead of silently
+            # dropping the wrong-chip caution — ADVICE r4 item 3)
             from jax._src import xla_bridge as _xb
-            if _xb._backends:
+            if _xb.backends_are_initialized():
                 kind = str(getattr(jax.devices()[0], "device_kind", "?"))
         except Exception:               # pragma: no cover - no backend
             pass

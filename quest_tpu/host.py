@@ -66,24 +66,7 @@ def _load():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    if not native.available():
-        return None
-    lib = native._load()
-    try:
-        _bind(lib)
-    except AttributeError:
-        # stale prebuilt library: native._load() succeeds on its own
-        # (older) symbols, so ITS rebuild path never fires — rebuild
-        # here and re-open the fresh .so (new inode; a second CDLL on
-        # the path maps the rebuilt file)
-        if not native._build():
-            return None
-        try:
-            lib = ctypes.CDLL(native._LIB_PATH)
-            _bind(lib)
-        except (OSError, AttributeError):
-            return None
-    _lib = lib
+    _lib = native.load_with(_bind)
     return _lib
 
 
@@ -234,7 +217,7 @@ def compile_circuit_host(ops, n: int, density: bool, iters: int = 1):
         if arr.dtype not in (np.float32, np.float64):
             arr = arr.astype(np.float32)
         if not (arr.flags.c_contiguous and arr.flags.writeable):
-            arr = np.ascontiguousarray(arr).copy()
+            arr = np.array(arr)     # ONE copy: contiguous + writable
         if arr.dtype == np.float32:
             fn, fp = lib.qh_run_program_f32, ctypes.c_float
         else:

@@ -39,32 +39,85 @@ def _build() -> bool:
         return False
 
 
+def _isa_ok(lib: ctypes.CDLL) -> bool:
+    """Whether this machine supports the ISA extensions the library was
+    built with (-march=native makes prebuilt .so files CPU-specific; a
+    copied library on an older host would SIGILL with no diagnostics, so
+    mismatches trigger a rebuild instead)."""
+    try:
+        fn = lib.qh_isa_requirements
+    except AttributeError:
+        return False        # predates the tag: rebuild
+    fn.restype = ctypes.c_char_p
+    req = fn().decode().split()
+    if not req:
+        return True
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    have = set(line.split(":", 1)[1].split())
+                    return all(r in have for r in req)
+    except OSError:
+        pass
+    return True             # can't introspect the CPU: assume ok
+
+
+def _try_open() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        _bind(lib)
+        return lib
+    except (OSError, AttributeError):
+        return None
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _lib_tried
     with _lock:
         if _lib_tried:
             return _lib
         _lib_tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-            _bind(lib)
-        except OSError:
-            return None
-        except AttributeError:
-            # stale prebuilt library missing a newer symbol: rebuild once
-            # (cheap no-op when fresh), then retry; degrade to the Python
-            # fallbacks rather than crash if it still doesn't bind
+        lib = _try_open()
+        if lib is None or not _isa_ok(lib):
+            # missing, stale (symbol set predates this tree) or built for
+            # a different CPU: rebuild once — the Makefile links to a
+            # temp name and rename(2)s, so the path gets a NEW inode (an
+            # already-mapped old library stays valid) and a fresh dlopen
+            # really sees the rebuilt code
             if not _build():
                 return None
-            try:
-                lib = ctypes.CDLL(_LIB_PATH)
-                _bind(lib)
-            except (OSError, AttributeError):
-                return None
+            lib = _try_open()
+            if lib is None or not _isa_ok(lib):
+                return None     # degrade to the Python fallbacks
         _lib = lib
         return _lib
+
+
+def load_with(binder) -> Optional[ctypes.CDLL]:
+    """The shared load-bind-rebuild dance for extension modules binding
+    EXTRA symbols (e.g. quest_tpu/host.py): returns the core library
+    with `binder(lib)` applied, rebuilding once if the on-disk library
+    predates the symbols the binder needs. One home for the retry logic
+    (ADVICE/code-review r5: host.py re-implemented it)."""
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        binder(lib)
+        return lib
+    except AttributeError:
+        if not _build():
+            return None
+        try:
+            fresh = ctypes.CDLL(_LIB_PATH)
+            _bind(fresh)
+            binder(fresh)
+            return fresh
+        except (OSError, AttributeError):
+            return None
 
 
 def _bind(lib: ctypes.CDLL) -> None:

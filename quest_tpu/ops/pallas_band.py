@@ -1111,18 +1111,27 @@ def _rows_eff_override():
 _ROWS_EFF_BITS_EFFECTIVE = None  # resolved lazily on first compile
 
 
+_DRIVER_EFFECTIVE = None  # resolved once on first compile
+
+
 def _driver_override() -> str:
     """QUEST_FUSED_DRIVER experiment knob: 'pipelined' (default) or
     'grid' (the automatic BlockSpec pipeline — kept for A/B probes and
-    as a fallback). Parsed per compile; the value participates in the
-    callers' cache keys only through compile_segment_cached's process
-    lifetime, so sweep via subprocesses like the block experiments."""
+    as a fallback). Resolved ONCE per process (like NBUF): compiled
+    programs cache across engines without carrying the knob in every
+    cache key, and flipping the env mid-process cannot hand back a
+    program built with the other driver (ADVICE r4 item 2) — sweep via
+    subprocesses like the block experiments."""
+    global _DRIVER_EFFECTIVE
+    if _DRIVER_EFFECTIVE is not None:
+        return _DRIVER_EFFECTIVE
     v = os.environ.get("QUEST_FUSED_DRIVER", "pipelined")
     if v not in ("pipelined", "grid"):
         import sys
         print(f"[pallas_band] ignoring unknown QUEST_FUSED_DRIVER={v!r}",
               file=sys.stderr)
-        return "pipelined"
+        v = "pipelined"
+    _DRIVER_EFFECTIVE = v
     return v
 
 

@@ -241,11 +241,25 @@ def _is_uncontrolled_rz(item):
     return None
 
 
-def circuit_from_qasm(text: str):
+def circuit_from_qasm(text: str, u_dialect: str | None = None):
     """Parse OPENQASM 2.0 text into a Circuit (see module docstring for
-    the accepted dialects and the recorder-convention folding)."""
+    the accepted dialects and the recorder-convention folding).
+
+    `u_dialect` pins the capital-``U`` parameter convention: ``"spec"``
+    (OPENQASM 2.0 builtin ``U(theta, phi, lambda)``) or ``"recorder"``
+    (the recorder's ``U(rz2, ry, rz1)`` ZYZ order). Default ``None``
+    applies the marker heuristic below — and warns on stderr the first
+    time a capital U is read as ZYZ in a file with an OPENQASM header
+    but NO recorder markers, because a spec-compliant file needs no
+    ``include`` for its builtin U and would otherwise parse silently
+    with the wrong parameter order (ADVICE r4 item 1)."""
     from quest_tpu.circuit import Circuit
     from quest_tpu.ops import matrices as M
+
+    if u_dialect not in (None, "spec", "recorder"):
+        raise ValueError(
+            f"u_dialect must be None, 'spec' or 'recorder', got "
+            f"{u_dialect!r}")
 
     fixed = {
         "h": M.HADAMARD, "x": M.PAULI_X, "y": M.PAULI_Y, "z": M.PAULI_Z,
@@ -267,11 +281,35 @@ def circuit_from_qasm(text: str):
     # keeps the ZYZ dialect, preserving the round-trip guarantee.
     has_include = any(k == "stmt" and s.lower().startswith("include")
                       for k, s in items)
+    has_header = any(k == "stmt" and s.lower().startswith("openqasm")
+                     for k, s in items)
     has_recorder_marker = any(
         (k == "stmt" and s.lower().startswith("ctrl-"))
         or (k == "comment" and _RESTORE_MARK in s)
         for k, s in items)
-    spec_builtin_u = has_include and not has_recorder_marker
+    if u_dialect is not None:
+        spec_builtin_u = u_dialect == "spec"
+        warn_ambiguous_u = False
+    else:
+        spec_builtin_u = has_include and not has_recorder_marker
+        # header + no include + no recorder markers: the heuristic keeps
+        # ZYZ (round-trip guarantee) but a spec-compliant file lands
+        # here too — one warning per parse, silenceable via u_dialect
+        warn_ambiguous_u = (has_header and not has_include
+                            and not has_recorder_marker)
+    _u_warned = [False]
+
+    def _warn_u_once():
+        if warn_ambiguous_u and not _u_warned[0]:
+            _u_warned[0] = True
+            import sys
+            print(
+                "[qasm_import] capital U read in the recorder's "
+                "U(rz2, ry, rz1) ZYZ order; this file has an OPENQASM "
+                "header but no recorder markers, so if it means the "
+                "spec builtin U(theta, phi, lambda) pass "
+                "u_dialect='spec' (u_dialect='recorder' silences this)",
+                file=sys.stderr)
 
     def need_circuit():
         if circ is None:
@@ -439,7 +477,11 @@ def circuit_from_qasm(text: str):
             # different convention, different unitary. Spec files
             # (include + no recorder markers) read capital U as the
             # builtin, i.e. the u3 order.
-            mat = _u_zyz(*params) if recorder_u else _u3(*params)
+            if recorder_u:
+                _warn_u_once()
+                mat = _u_zyz(*params)
+            else:
+                mat = _u3(*params)
         elif name == "u3":
             mat = _u3(*params)
         elif name == "u2":

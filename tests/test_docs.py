@@ -47,3 +47,12 @@ def test_api_reference_covers_every_parity_row():
             entries.update(re.findall(r"^## (\w+)", f.read(), re.M))
     missing = [r for r in set(rows) if r.split(".")[0] not in entries]
     assert not missing, f"parity functions without docs: {sorted(missing)}"
+
+
+def test_backend_probe_api():
+    """Pin the jax internal explain() uses to detect a committed backend
+    (circuit.py explain; ADVICE r4 item 3): if a JAX upgrade renames
+    backends_are_initialized, fail HERE loudly instead of silently
+    dropping the wrong-chip calibration caution."""
+    from jax._src import xla_bridge
+    assert callable(getattr(xla_bridge, "backends_are_initialized"))

@@ -256,3 +256,32 @@ def test_space_before_params():
     c = Circuit.from_qasm("qreg q[1]; rz (pi/2) q[0];")
     want = _state_of(Circuit(1).rz(0, np.pi / 2), 1)
     np.testing.assert_allclose(_state_of(c, 1), want, atol=1e-6)
+
+
+def test_capital_u_dialect_pin_and_warning(capsys):
+    """ADVICE r4 item 1: a file with an OPENQASM header but no include
+    and no recorder markers is ambiguous for capital U — the heuristic
+    keeps ZYZ but must warn on stderr; u_dialect pins either reading
+    and silences it."""
+    text = "OPENQASM 2.0;\nqreg q[1];\nU(pi/2, 0, pi) q[0];\n"
+    Circuit.from_qasm(text)
+    assert "u_dialect" in capsys.readouterr().err
+
+    want = _state_of(Circuit(1).h(0), 1)
+    spec = Circuit.from_qasm(text, u_dialect="spec")
+    assert "u_dialect" not in capsys.readouterr().err
+    _assert_same_up_to_phase(_state_of(spec, 1), want, atol=1e-10)
+
+    rec = Circuit.from_qasm(text, u_dialect="recorder")
+    assert "u_dialect" not in capsys.readouterr().err
+    v = to_dense(rec.apply(qt.create_qureg(1, dtype=np.complex128)))
+    assert abs(v[1]) < 1e-10    # ZYZ reading of these params is diagonal
+
+    # a spec file WITH include stays silent (unambiguous)
+    Circuit.from_qasm('OPENQASM 2.0;\ninclude "qelib1.inc";\n'
+                      "qreg q[1];\nU(pi/2, 0, pi) q[0];\n")
+    assert "u_dialect" not in capsys.readouterr().err
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        Circuit.from_qasm(text, u_dialect="bogus")
