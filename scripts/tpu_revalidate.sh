@@ -178,3 +178,4 @@ run_probe() {
 }
 run_probe f64 scripts/probe_f64.py 28
 run_probe cold-start scripts/probe_cold_start.py 26 24
+run_probe stage-report -m quest_tpu.profiling --n 30

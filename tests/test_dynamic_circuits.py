@@ -354,3 +354,203 @@ def test_sharded_dynamic_banded_matches_pergate():
         np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
         np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
                                    atol=1e-11, rtol=0)
+
+
+# --- relabel + fuse on the dynamic sharded engine (VERDICT r4 item 4) ----
+
+
+def _deep_dynamic_circuit(n, layers=5, seed=11):
+    """Global-qubit-heavy RCS-shaped stretches (every layer rotates
+    EVERY qubit and entangles with CZs — the deep-global testbed of
+    tests/test_lazy_relabel.py) separated by measurements + feedback:
+    the workload whose measurement-free stretches should relabel."""
+    rng = np.random.default_rng(seed)
+
+    def stretch(c):
+        for _ in range(layers):
+            for qb in range(n):
+                c.rx(qb, float(rng.uniform(0, 2 * np.pi)))
+                c.ry(qb, float(rng.uniform(0, 2 * np.pi)))
+            for qb in range(0, n - 1, 2):
+                c.cz(qb, qb + 1)
+    c = Circuit(n)
+    stretch(c)
+    c.measure(n - 1)
+    c.x_if(0, (0, 1))
+    stretch(c)
+    c.measure(0)
+    return c
+
+
+def test_sharded_dynamic_engines_agree():
+    """xla / banded / banded+relabel / fused(interpret) dynamic engines
+    draw identical trajectories and states for every key."""
+    from quest_tpu.parallel import make_amp_mesh
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    c = _deep_dynamic_circuit(7, layers=2)
+    for s in range(4):
+        key = jax.random.PRNGKey(40 + s)
+        res = {}
+        for label, kw in (
+                ("xla", dict(engine="xla")),
+                ("banded-plain", dict(engine="banded", relabel=False)),
+                ("banded-relabel", dict(engine="banded", relabel=True)),
+                ("fused", dict(engine="fused", relabel=True,
+                               interpret=True))):
+            q = qt.create_qureg(7, dtype=np.complex128)
+            r, o = c.apply_sharded_measured(q, key, mesh, **kw)
+            res[label] = (to_dense(r), np.asarray(o))
+        base_v, base_o = res["xla"]
+        for label, (v, o) in res.items():
+            np.testing.assert_array_equal(o, base_o, err_msg=label)
+            np.testing.assert_allclose(v, base_v, atol=1e-10, rtol=0,
+                                       err_msg=label)
+
+
+def test_sharded_dynamic_fused_kernels_execute():
+    """complex64 register so use_kernels is TRUE: the fused dynamic
+    engine's Pallas kernel-execution branch (reshape to LANES, kernel
+    call, reshape back) actually runs — a complex128 register silently
+    takes the banded item path instead, so without this variant a
+    broken kernel branch would pass the whole suite (review r5)."""
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.ops import pallas_band as PB
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    n = 13                          # local_n = 10: inside the kernel tier
+    assert PB.usable(n - 3)
+    c = _deep_dynamic_circuit(n, layers=1)
+    for s in range(2):
+        key = jax.random.PRNGKey(90 + s)
+        r1, o1 = c.apply_sharded_measured(
+            qt.create_qureg(n, dtype=np.complex64), key, mesh,
+            engine="xla")
+        r2, o2 = c.apply_sharded_measured(
+            qt.create_qureg(n, dtype=np.complex64), key, mesh,
+            engine="fused", interpret=True)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_allclose(to_dense(r1), to_dense(r2),
+                                   atol=5e-5, rtol=0)
+
+
+def test_sharded_dynamic_relabel_cuts_ici():
+    """On a deep global-heavy dynamic circuit the per-stretch relabel
+    pass must fire (events > 0) and reduce the lowered per-device ICI
+    bytes vs the plain banded schedule."""
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.introspect import sharded_measured_schedule
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    n = 9
+    c = _deep_dynamic_circuit(n, layers=6)
+    plain = sharded_measured_schedule(c.ops, n, False, mesh,
+                                      engine="banded", relabel=False)
+    rel = sharded_measured_schedule(c.ops, n, False, mesh,
+                                    engine="banded", relabel=True)
+    assert rel["relabel_events"] > 0
+    assert rel["ici_bytes_per_device"] < plain["ici_bytes_per_device"]
+    assert rel["stretches"] == 2 and rel["measurements"] == 2
+    # the psum-per-measurement schedule is engine-independent
+    assert rel["all_reduces"] == plain["all_reduces"]
+
+
+def test_sharded_dynamic_fused_has_kernel_segments():
+    """The fused dynamic engine compiles purely-local stretch runs into
+    Pallas kernel segments (reported through the same planner the
+    engine executes)."""
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.introspect import sharded_measured_schedule
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    n = 13                      # local_n = 10 >= the kernel tier minimum
+    c = _deep_dynamic_circuit(n, layers=2)
+    rec = sharded_measured_schedule(c.ops, n, False, mesh, engine="fused")
+    assert rec["engine"] == "fused"
+    assert rec["kernel_segments"] > 0
+    assert rec["relabel_events"] > 0
+
+
+def test_explain_sharded_reports_dynamic_schedule():
+    from quest_tpu.parallel import make_amp_mesh
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    c = _deep_dynamic_circuit(7, layers=2)
+    txt = c.explain_sharded(mesh, engine="banded")
+    assert "DYNAMIC" in txt
+    assert "relabel events:" in txt
+    assert "2 measurement(s)" in txt and "1 feedback op(s)" in txt
+
+
+def test_bit_flip_cycle_30q_class_lowers_with_relabel_and_kernels():
+    """VERDICT r4 item 4's acceptance shape: a repetition-code cycle at
+    30q-class size over 8 virtual devices LOWERS (no allocation) with
+    relabel events and kernel segments in the dynamic schedule, and its
+    small-register twin executes identically across engines."""
+    from quest_tpu.parallel import make_amp_mesh
+    from quest_tpu.parallel.introspect import sharded_measured_schedule
+
+    def cycle(n_data, rounds=2):
+        """n_data data qubits + 2 syndrome ancillas, bit-flip-code style
+        stabilizer rounds with feedback corrections (the deep-QEC shape
+        of examples/bit_flip_code.py scaled up)."""
+        n = n_data + 2
+        c = Circuit(n)
+        rng = np.random.default_rng(5)
+        out_idx = 0
+        for r in range(rounds):
+            for qb in range(n_data):        # noisy stretch (static work)
+                c.rx(qb, float(rng.uniform(0, 0.2)))
+                c.rz(qb, float(rng.uniform(0, 0.2)))
+            c.cnot(0, n_data)               # syndrome 1: parity(0,1)
+            c.cnot(1, n_data)
+            c.cnot(1, n_data + 1)           # syndrome 2: parity(1,2)
+            c.cnot(2, n_data + 1)
+            c.measure(n_data)
+            c.measure(n_data + 1)
+            c.x_if(0, ((out_idx, 1), (out_idx + 1, 0)))
+            c.x_if(2, ((out_idx, 0), (out_idx + 1, 1)))
+            c.x_if(1, ((out_idx, 1), (out_idx + 1, 1)))
+            c.measure(n_data)               # reset ancillas via measure
+            c.measure(n_data + 1)
+            c.x_if(n_data, (out_idx + 2, 1))
+            c.x_if(n_data + 1, (out_idx + 3, 1))
+            out_idx += 4
+        return c
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    big = cycle(28)                         # 30 qubits over 8 devices
+    rec = sharded_measured_schedule(big.ops, 30, False, mesh,
+                                    engine="fused")
+    assert rec["engine"] == "fused"
+    assert rec["kernel_segments"] > 0
+    assert rec["stretches"] >= 2
+    # the noisy stretches are all-local here (rx/rz on low qubits) --
+    # relabel must NOT fire events it can't pay for; the global-ancilla
+    # variant below must fire them
+    deep = Circuit(30)
+    rngu = np.random.default_rng(9)
+    for rep in range(4):
+        for qb in range(30):
+            deep.rx(qb, float(rngu.uniform(0, 6.28)))
+            deep.ry(qb, float(rngu.uniform(0, 6.28)))
+        for qb in range(0, 29, 2):
+            deep.cz(qb, qb + 1)
+    deep.measure(0)
+    rec2 = sharded_measured_schedule(deep.ops, 30, False, mesh,
+                                     engine="fused")
+    assert rec2["relabel_events"] > 0
+
+    # execution equivalence of the same cycle at small size
+    small = cycle(4)                        # 6 qubits
+    for s in range(3):
+        key = jax.random.PRNGKey(70 + s)
+        r1, o1 = small.apply_sharded_measured(
+            qt.create_qureg(6, dtype=np.complex128), key, mesh,
+            engine="xla")
+        r2, o2 = small.apply_sharded_measured(
+            qt.create_qureg(6, dtype=np.complex128), key, mesh,
+            engine="banded", relabel=True)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        np.testing.assert_allclose(to_dense(r1), to_dense(r2),
+                                   atol=1e-10, rtol=0)

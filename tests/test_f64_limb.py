@@ -1,0 +1,124 @@
+"""The f64 MXU limb contraction (ops/apply.py _limb_band_contract):
+exact-integer 8-bit limb slices make every pair-dot exact on bf16/f32
+matmul hardware, so f64 band work rides the MXU instead of software
+emulation (the reference's default precision is double,
+QuEST_precision.h:45-48; VERDICT r4 item 2's fast-path ask).
+
+QUEST_F64_MXU=1 forces the scheme on the CPU backend — the dots are
+then plain f32 matmuls whose inputs are small integers, which is the
+same exactness argument, so the numerics are fully testable off-chip.
+The on-chip throughput A/B lives in scripts/probe_f64.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quest_tpu.ops.apply import _limb_band_contract, apply_band
+from quest_tpu.circuit import random_circuit
+
+
+@pytest.fixture
+def force_limb(monkeypatch):
+    monkeypatch.setenv("QUEST_F64_MXU", "1")
+
+
+def test_limb_contract_norm_class_accuracy():
+    """Row-relative error must sit in the f64 REAL_EPS class (1e-13)
+    even when contraction rows span 40 binary orders of magnitude —
+    the per-row scaling is what keeps small-amplitude rows accurate."""
+    rng = np.random.default_rng(0)
+    band = 128
+    g = rng.normal(size=(band, band)) / np.sqrt(band)
+    x = rng.normal(size=(16, band, 8))
+    x *= 2.0 ** rng.integers(-40, 0, size=(16, 1, 8))
+    want = np.einsum("ab,pbq->paq", g, x)
+    got = np.asarray(_limb_band_contract(jnp.asarray(g), jnp.asarray(x)))
+    rowmax = np.max(np.abs(x), axis=1, keepdims=True) * np.max(np.abs(g))
+    rel = np.abs(got - want) / np.maximum(rowmax, 1e-300)
+    assert rel.max() < 1e-13, rel.max()
+
+
+def test_limb_contract_exact_on_integer_grid():
+    """Inputs already on the 8-bit grid round-trip bit-exactly: the
+    pair-dots really are exact, not approximately so."""
+    rng = np.random.default_rng(3)
+    g = rng.integers(-128, 128, size=(8, 8)).astype(np.float64) / 256.0
+    x = rng.integers(-128, 128, size=(4, 8, 4)).astype(np.float64) / 256.0
+    want = np.einsum("ab,pbq->paq", g, x)
+    got = np.asarray(_limb_band_contract(jnp.asarray(g), jnp.asarray(x)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_banded_engine_equivalent_with_limb_scheme(force_limb):
+    """Full banded-engine circuit at complex128: limb-on matches the
+    native-f64 path to f64 working precision."""
+    n = 10
+    c = random_circuit(n, depth=4, seed=2)
+    amps = np.zeros((2, 1 << n))
+    amps[0, 0] = 1.0
+    on = np.asarray(c.compiled_banded(n, False, donate=False)(
+        jnp.asarray(amps)))
+    os.environ["QUEST_F64_MXU"] = "0"
+    try:
+        # SAME Circuit object on purpose: the compiled-program cache must
+        # key on the f64-MXU flag (circuit._engine_mode_key) — before
+        # that fix this returned the limb-scheme program back (review r5)
+        off = np.asarray(c.compiled_banded(n, False, donate=False)(
+            jnp.asarray(amps)))
+    finally:
+        os.environ["QUEST_F64_MXU"] = "1"
+    assert np.abs(on - off).max() < 1e-12
+    norm = float((on.astype(np.float64) ** 2).sum())
+    assert abs(norm - 1.0) < 1e-12
+
+
+def test_sharded_banded_f64_limb(force_limb):
+    """The f64 pod path (sharded banded engine) rides the same limb
+    contraction: 8-device run matches the dense oracle at f64
+    tolerance."""
+    from quest_tpu.parallel import make_amp_mesh, shard_qureg
+    from quest_tpu.parallel.sharded import compile_circuit_sharded_banded
+    from quest_tpu.state import init_state_from_amps, to_dense
+    from .helpers import max_mesh_devices
+    from . import oracle
+    import quest_tpu as qt
+
+    mesh = make_amp_mesh(max_mesh_devices())
+    n = 6
+    rng = np.random.default_rng(8)
+    c = random_circuit(n, depth=3, seed=8)
+    v0 = oracle.random_statevector(n, rng)
+    q = init_state_from_amps(qt.create_qureg(n, dtype=np.complex128),
+                             v0.real, v0.imag)
+    step = compile_circuit_sharded_banded(c.ops, n, False, mesh,
+                                          donate=False)
+    sq = shard_qureg(q, mesh)
+    got = to_dense(sq.replace_amps(step(sq.amps)))
+    # oracle: the per-gate XLA engine at f64 (native dots)
+    os.environ["QUEST_F64_MXU"] = "0"
+    try:
+        q2 = init_state_from_amps(qt.create_qureg(n, dtype=np.complex128),
+                                  v0.real, v0.imag)
+        want = to_dense(random_circuit(n, depth=3, seed=8).apply(q2))
+    finally:
+        os.environ["QUEST_F64_MXU"] = "1"
+    np.testing.assert_allclose(got, want, atol=1e-12, rtol=0)
+
+
+def test_f32_path_untouched(force_limb):
+    """The limb scheme is f64-only: f32 planes keep the plain einsum
+    (the HIGHEST/HIGH tiers own that path)."""
+    n = 8
+    rng = np.random.default_rng(1)
+    g = np.linalg.qr(rng.normal(size=(4, 4)) + 1j)[0]
+    amps = np.zeros((2, 1 << n), dtype=np.float32)
+    amps[0, 0] = 1.0
+    out = apply_band(jnp.asarray(amps), n, (g.real.astype(np.float32),
+                                            g.imag.astype(np.float32)),
+                     ql=2, w=2)
+    assert out.dtype == jnp.float32

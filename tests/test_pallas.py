@@ -535,3 +535,52 @@ def test_explain_estimate_brackets_measurements():
         c29.rx(1 + i % 28, float(rng.uniform(0, 2 * np.pi)))
     m29 = re.search(r"([0-9.]+)-([0-9.]+) ms", c29.explain())
     assert abs(float(m29.group(1)) * 2 - lo) < 0.2 * lo
+
+
+def test_cost_model_table_is_chip_keyed():
+    """VERDICT r4 item 7: the estimate's constants are per-generation
+    with named provenance — v5e measured, v5p projected (datasheet x
+    measured derate), unknown chips fall back to v5e WITH matched=False
+    so explain() cautions instead of silently mis-scaling."""
+    from quest_tpu.circuit import _COST_MODELS, _cost_model_for, _estimate_ms
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+
+    v5e, ok_e = _cost_model_for("TPU v5e lite")
+    v5p, ok_p = _cost_model_for("TPU v5p")
+    unk, ok_u = _cost_model_for("TPU v7x")
+    assert ok_e and ok_p and not ok_u
+    assert v5e is _COST_MODELS["v5e"] and unk is _COST_MODELS["v5e"]
+    assert "MEASURED" in v5e["provenance"]
+    assert "PROJECTED" in v5p["provenance"]
+    # a faster chip projects faster on the same plan
+    rng = np.random.default_rng(1)
+    c = Circuit(30)
+    for i in range(16):
+        c.rx(1 + i % 29, float(rng.uniform(0, 2 * np.pi)))
+    parts = PB.segment_plan(
+        __import__("quest_tpu.ops.fusion", fromlist=["plan"]).plan(
+            c._flat_ops(30, False), 30, bands=PB.plan_bands(30)), 30)
+    lo_e, hi_e = _estimate_ms(parts, 30, v5e)
+    lo_p, hi_p = _estimate_ms(parts, 30, v5p)
+    assert lo_p < lo_e and hi_p < hi_e
+
+
+def test_stage_report_runs_and_audits():
+    """profiling.stage_report (the shipped form of the KERNELS.md
+    probes) runs end-to-end on the attached backend: one record per
+    stage family with measured + model figures."""
+    import io
+    from quest_tpu import profiling
+
+    buf = io.StringIO()
+    rec = profiling.stage_report(n=12, reps=1, out=buf)
+    txt = buf.getvalue()
+    assert "phase (DMA floor)" in rec and "b0" in rec and "b1" in rec
+    for r in rec.values():
+        assert r["measured_ms"] >= 0 and r["model_hi_ms"] >= r["model_lo_ms"]
+    assert "DMA floor" in txt
+    # CPU host: the caution must be loud
+    import jax as _jax
+    if _jax.devices()[0].platform not in ("tpu", "axon"):
+        assert "INTERPRETER" in txt
