@@ -323,6 +323,65 @@ def _estimate_ms(parts, n, model=None):
     return lo * scale, hi * scale
 
 
+def _scan_partition(parts, scan_min: int):
+    """Group maximal runs of >= scan_min consecutive kernel segments
+    sharing ONE structure (identical stage tuple; operands differ) into
+    ('scan', stages, [arrays, ...]) elements; everything else passes
+    through as ('one', part). scan_min <= 0 disables grouping. Pure
+    planning — unit-tested directly (tests/test_pallas.py), since the
+    EXECUTED scan path is chip-only (interpret-mode Pallas inside a
+    scan body explodes XLA-CPU compile, measured r4: >15 min for a
+    4-segment program)."""
+    out = []
+    i = 0
+    while i < len(parts):
+        part = parts[i]
+        if scan_min > 0 and part[0] == "segment":
+            seg_key = tuple(part[1])
+            j = i
+            while (j < len(parts) and parts[j][0] == "segment"
+                   and tuple(parts[j][1]) == seg_key):
+                j += 1
+            if j - i >= scan_min:
+                out.append(("scan", part[1], [p[2] for p in parts[i:j]]))
+                i = j
+                continue
+        out.append(("one", part))
+        i += 1
+    return out
+
+
+def make_scan_applier(seg, arrays_run):
+    """One lax.scan over a run of consecutive segments sharing ONE
+    kernel structure (operands differ, stage tuple identical — QFT's
+    repeated 32-phase mid-segments are the canonical case). The traced
+    program carries the kernel call ONCE with stacked operands instead
+    of len(run) inlined copies — the program-size lever for the relay's
+    per-byte first-execution cost (compile_latency note in
+    benchmarks/measured_tpu.json). Opt-in via QUEST_FUSED_SCAN=1 until
+    its steady-state cost is measured on chip. Interpret mode ignores
+    the flag (compiled_fused passes scan_min=0): the Pallas
+    interpreter's DMA emulation traced into a scan body explodes
+    XLA-CPU compile time, so the executed scan path is validated on
+    silicon by scripts/tpu_revalidate.sh's fused-scan stage (QFT-20
+    with and without the flag, amplitudes compared); the grouping and
+    operand stacking are unit-tested off-chip via _scan_partition and
+    this function with a stub segment."""
+    # numpy stack: operands stay HOST-side closure constants that
+    # upload with the program, like the non-scan path (segment_plan's
+    # host-side-operand design)
+    stacked = tuple(
+        np.stack([arrs[j] for arrs in arrays_run])
+        for j in range(len(arrays_run[0])))
+
+    def apply(amps, seg=seg, stacked=stacked):
+        def body(a, xs):
+            return seg(a, list(xs)), None
+        out, _ = jax.lax.scan(body, amps, stacked)
+        return out
+    return apply
+
+
 def _human_bytes(b: int) -> str:
     if b >= 2**29:
         return f"{b / 2**30:.2f} GiB"
@@ -941,56 +1000,15 @@ class Circuit:
             return (lambda amps, f=xla_fn:
                     f(amps.reshape(2, -1)).reshape(amps.shape))
 
-        def make_scan_applier(seg, arrays_run):
-            """One lax.scan over a run of >=3 consecutive segments
-            sharing ONE kernel structure (operands
-            differ, stage tuple identical — QFT's repeated 32-phase
-            mid-segments are the canonical case). The traced program
-            carries the kernel call ONCE with stacked operands instead
-            of len(run) inlined copies — the program-size lever for the
-            relay's per-byte first-execution cost (compile_latency note
-            in benchmarks/measured_tpu.json). Opt-in via
-            QUEST_FUSED_SCAN=1 until its steady-state cost is measured
-            on chip. Interpret mode ignores the flag: the Pallas
-            interpreter's DMA emulation traced into a scan body
-            explodes XLA-CPU compile time (measured r4: >15 min for a
-            4-segment program), so the executed scan path is validated
-            on silicon by scripts/tpu_revalidate.sh's fused-scan stage
-            (QFT-20 with and without the flag, amplitudes compared)."""
-            # numpy stack: operands stay HOST-side closure constants
-            # that upload with the program, like the non-scan path
-            # (segment_plan's host-side-operand design)
-            stacked = tuple(
-                np.stack([arrs[j] for arrs in arrays_run])
-                for j in range(len(arrays_run[0])))
-
-            def apply(amps, seg=seg, stacked=stacked):
-                def body(a, xs):
-                    return seg(a, list(xs)), None
-                out, _ = jax.lax.scan(body, amps, stacked)
-                return out
-            return apply
-
         scan_min = 3 if (scan_flag and not interpret) else 0
         appliers = []
-        i = 0
-        while i < len(parts):
-            part = parts[i]
-            if scan_min and part[0] == "segment":
-                seg_key = (tuple(part[1]), n, interpret)
-                j = i
-                while (j < len(parts) and parts[j][0] == "segment"
-                       and (tuple(parts[j][1]), n, interpret) == seg_key):
-                    j += 1
-                if j - i >= scan_min:
-                    seg = PB.compile_segment_cached(
-                        seg_cache, part[1], n, interpret=interpret)
-                    appliers.append(make_scan_applier(
-                        seg, [p[2] for p in parts[i:j]]))
-                    i = j
-                    continue
-            appliers.append(make_applier(part))
-            i += 1
+        for grp in _scan_partition(parts, scan_min):
+            if grp[0] == "scan":
+                seg = PB.compile_segment_cached(
+                    seg_cache, grp[1], n, interpret=interpret)
+                appliers.append(make_scan_applier(seg, grp[2]))
+            else:
+                appliers.append(make_applier(grp[1]))
 
         def run(amps):
             # the Pallas kernels are f32-only; f64 registers keep their

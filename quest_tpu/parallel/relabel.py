@@ -163,6 +163,23 @@ def _compose_free_flags(flat: Sequence) -> List[bool]:
     return out
 
 
+def _op_exchange_price(op, pperm, local_n: int) -> float:
+    """Chunk-equivalents the sharded banded/fused engines ship for ONE
+    matrix op at the given logical->physical permutation — the single
+    home of the engine's exchange price table, shared by the greedy
+    placer and the A/B accept test (they must agree on prices; they
+    deliberately differ only on the composition discount)."""
+    if op.kind != "matrix":
+        return 0.0               # diagonal/parity/allones never move data
+    t_phys = [pperm[t] for t in op.targets]
+    n_glob = sum(1 for t in t_phys if t >= local_n)
+    if n_glob == 0:
+        return 0.0
+    if len(t_phys) == 1:
+        return 1.0               # whole-chunk pair exchange (_matrix_op)
+    return 0.5 * n_glob          # half-chunk swap-to-local per global t
+
+
 def _schedule_cost(ops_list: Sequence, n: int, local_n: int) -> float:
     """Chunk-equivalents of ICI a sharded banded/fused engine ships for
     an op list whose targets are PHYSICAL positions, under the
@@ -172,17 +189,15 @@ def _schedule_cost(ops_list: Sequence, n: int, local_n: int) -> float:
     A/B that keeps plan_full_relabels honest (below)."""
     D = 1 << (n - local_n)
     flags = _compose_free_flags(ops_list)
+    identity = list(range(n))
     total = 0.0
     for i, op in enumerate(ops_list):
         if op.kind == "relabel":
             total += (D - 1) / D
             continue
-        if op.kind != "matrix" or flags[i]:
+        if flags[i]:
             continue
-        n_glob = sum(1 for q in op.targets if q >= local_n)
-        if n_glob == 0:
-            continue
-        total += 1.0 if len(op.targets) == 1 else 0.5 * n_glob
+        total += _op_exchange_price(op, identity, local_n)
     return total
 
 
@@ -236,22 +251,14 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
                 "circuits only")
 
     def exchange_cost(op, pperm):
-        """Chunk-equivalents the engine would ship for this op as-is.
-        Deliberately per-op (NO band-run composition discount): the
+        """Per-op price via the shared table (_op_exchange_price).
+        Deliberately NO band-run composition discount here: the
         optimistic count places events denser, which measured BETTER
         plans on the deep-global testbed (6 events/43 KB vs the
         accurate count's 6 events + 2 stray permutes/59 KB) — the
         composition-aware model's job is the final accept test below,
         not greedy placement."""
-        if op.kind != "matrix":
-            return 0.0           # diagonal/parity/allones never move data
-        t_phys = [pperm[t] for t in op.targets]
-        n_glob = sum(1 for t in t_phys if t >= local_n)
-        if n_glob == 0:
-            return 0.0
-        if len(t_phys) == 1:
-            return 1.0           # whole-chunk pair exchange (_matrix_op)
-        return 0.5 * n_glob      # half-chunk swap-to-local per global t
+        return _op_exchange_price(op, pperm, local_n)
 
     uses = _uses(flat, n)
     ptr = [0] * n
