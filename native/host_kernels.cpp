@@ -30,6 +30,7 @@
 //   program in order; blocked groups run block-by-block, unblocked
 //   groups run each gate as one full-range sweep.
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -278,9 +279,64 @@ int run_program(T* re, T* im, int n, const int32_t* prog, int64_t plen,
     return 0;
 }
 
+template <typename T>
+double prob0_sv(const T* re, const T* im, int n, int qubit) {
+    // probability of bit `qubit` == 0, accumulated in double
+    const uint64_t namps = 1ULL << n;
+    const uint64_t stride = 1ULL << qubit;
+    double p0 = 0.0;
+    for (uint64_t base = 0; base < namps; base += (stride << 1))
+        for (uint64_t j = base; j < base + stride; ++j)
+            p0 += (double)re[j] * re[j] + (double)im[j] * im[j];
+    return p0;
+}
+
+template <typename T>
+void collapse_sv(T* re, T* im, int n, int qubit, int outcome,
+                 double prob) {
+    // kept half scales by 1/sqrt(prob), other half zeroes. Outcome and
+    // prob are decided by the CALLER (quest_tpu/host.py), which mirrors
+    // the eager API's draw logic exactly — including NOT consuming a
+    // uniform when the outcome is eps-forced, so identically-seeded
+    // host and eager trajectories stay in lockstep.
+    const uint64_t namps = 1ULL << n;
+    const uint64_t stride = 1ULL << qubit;
+    const T scale = (T)(1.0 / std::sqrt(prob));
+    for (uint64_t base = 0; base < namps; base += (stride << 1)) {
+        uint64_t keep = base + (outcome ? stride : 0);
+        uint64_t kill = base + (outcome ? 0 : stride);
+        for (uint64_t j = 0; j < stride; ++j) {
+            re[keep + j] *= scale;
+            im[keep + j] *= scale;
+            re[kill + j] = 0;
+            im[kill + j] = 0;
+        }
+    }
+}
+
 }  // namespace
 
 extern "C" {
+
+double qh_prob0_sv_f32(const float* re, const float* im, int n,
+                       int qubit) {
+    return prob0_sv(re, im, n, qubit);
+}
+
+double qh_prob0_sv_f64(const double* re, const double* im, int n,
+                       int qubit) {
+    return prob0_sv(re, im, n, qubit);
+}
+
+void qh_collapse_sv_f32(float* re, float* im, int n, int qubit,
+                        int outcome, double prob) {
+    collapse_sv(re, im, n, qubit, outcome, prob);
+}
+
+void qh_collapse_sv_f64(double* re, double* im, int n, int qubit,
+                        int outcome, double prob) {
+    collapse_sv(re, im, n, qubit, outcome, prob);
+}
 
 int qh_run_program_f32(float* re, float* im, int n, const int32_t* prog,
                        int64_t plen, const double* coef,

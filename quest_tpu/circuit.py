@@ -936,6 +936,23 @@ class Circuit:
         amps = _np.array(q.amps) if not donate else q.amps
         return q.replace_amps(jnp.asarray(fn(amps)))
 
+    def compiled_host_measured(self, n: int, density: bool = False):
+        """DYNAMIC circuit on the NATIVE HOST engine: step(state,
+        draws=None) -> (planes, outcomes). Measurement-free stretches
+        run blocked native kernels; measurements collapse natively;
+        default draws come from the reference-exact MT19937 — the same
+        stream the eager API uses, so identically-seeded host and eager
+        trajectories match outcome-for-outcome (quest_tpu/host.py
+        compile_circuit_host_measured). Statevector only."""
+        from quest_tpu import host as H
+        key = ("host-measured", n, density,
+               os.environ.get("QUEST_HOST_BLOCK", ""))
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = H.compile_circuit_host_measured(self.ops, n, density)
+            self._compiled[key] = fn
+        return fn
+
     def banded_trace(self, amps, n: int, density: bool):
         """Apply the band-fusion plan to raw amplitudes inside an existing
         trace (the un-jitted core of compiled_banded)."""

@@ -55,6 +55,19 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.c_int, ctypes.c_int,
         ]
         fn.restype = ctypes.c_int
+    for name, fp in (("qh_prob0_sv_f32", ctypes.c_float),
+                     ("qh_prob0_sv_f64", ctypes.c_double)):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.POINTER(fp), ctypes.POINTER(fp),
+                       ctypes.c_int, ctypes.c_int]
+        fn.restype = ctypes.c_double
+    for name, fp in (("qh_collapse_sv_f32", ctypes.c_float),
+                     ("qh_collapse_sv_f64", ctypes.c_double)):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.POINTER(fp), ctypes.POINTER(fp),
+                       ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                       ctypes.c_double]
+        fn.restype = None
 
 
 _lib = None
@@ -204,30 +217,164 @@ def compile_circuit_host(ops, n: int, density: bool, iters: int = 1):
     if not flat:
         return lambda state: state
     prog, coef, groups, block_log = _encode(flat, n)
-    ngroups = len(groups) // 2
-    prog_p = prog.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-    coef_p = coef.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-    groups_p = groups.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
     def step(state):
-        arr = np.asarray(state)
-        if arr.shape != (2, 1 << n):
-            raise ValueError(
-                f"state shape {arr.shape} != (2, {1 << n})")
-        if arr.dtype not in (np.float32, np.float64):
-            arr = arr.astype(np.float32)
-        if not (arr.flags.c_contiguous and arr.flags.writeable):
-            arr = np.array(arr)     # ONE copy: contiguous + writable
-        if arr.dtype == np.float32:
-            fn, fp = lib.qh_run_program_f32, ctypes.c_float
-        else:
-            fn, fp = lib.qh_run_program_f64, ctypes.c_double
-        re_p = arr[0].ctypes.data_as(ctypes.POINTER(fp))
-        im_p = arr[1].ctypes.data_as(ctypes.POINTER(fp))
-        rc = fn(re_p, im_p, n, prog_p, len(prog), coef_p, groups_p,
-                ngroups, block_log, iters)
-        if rc != 0:
-            raise RuntimeError(f"native host runner failed (rc={rc})")
+        arr = _as_planes(state, n)
+        _run_native(lib, arr, n, prog, coef, groups, block_log, iters)
         return arr
+
+    return step
+
+
+def _as_planes(state, n: int) -> np.ndarray:
+    arr = np.asarray(state)
+    if arr.shape != (2, 1 << n):
+        raise ValueError(f"state shape {arr.shape} != (2, {1 << n})")
+    if arr.dtype not in (np.float32, np.float64):
+        arr = arr.astype(np.float32)
+    if not (arr.flags.c_contiguous and arr.flags.writeable):
+        arr = np.array(arr)         # ONE copy: contiguous + writable
+    return arr
+
+
+def _run_native(lib, arr, n, prog, coef, groups, block_log, iters):
+    if arr.dtype == np.float32:
+        fn, fp = lib.qh_run_program_f32, ctypes.c_float
+    else:
+        fn, fp = lib.qh_run_program_f64, ctypes.c_double
+    rc = fn(arr[0].ctypes.data_as(ctypes.POINTER(fp)),
+            arr[1].ctypes.data_as(ctypes.POINTER(fp)), n,
+            prog.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(prog),
+            coef.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            groups.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(groups) // 2, block_log, iters)
+    if rc != 0:
+        raise RuntimeError(f"native host runner failed (rc={rc})")
+
+
+def _measure_native(lib, arr, n: int, qubit: int, draw) -> int:
+    """Native statevector measurement MIRRORING the eager API's logic
+    (measurement.measure_with_stats): native p0 pass, then the outcome
+    draw happens HERE — `draw()` is only called when the outcome is not
+    eps-forced, exactly like the eager path, so identically-seeded host
+    and eager trajectories consume the same MT19937 stream — then a
+    native collapse pass. Returns the outcome."""
+    from quest_tpu import precision
+    eps = float(precision.real_eps(arr.dtype))
+    if arr.dtype == np.float32:
+        p_fn, c_fn, fp = (lib.qh_prob0_sv_f32, lib.qh_collapse_sv_f32,
+                          ctypes.c_float)
+    else:
+        p_fn, c_fn, fp = (lib.qh_prob0_sv_f64, lib.qh_collapse_sv_f64,
+                          ctypes.c_double)
+    re_p = arr[0].ctypes.data_as(ctypes.POINTER(fp))
+    im_p = arr[1].ctypes.data_as(ctypes.POINTER(fp))
+    p0 = float(p_fn(re_p, im_p, n, qubit))
+    if p0 < eps:
+        outcome = 1
+    elif 1.0 - p0 < eps:
+        outcome = 0
+    else:
+        outcome = int(float(draw()) > p0)
+    prob = max(p0 if outcome == 0 else 1.0 - p0, eps)
+    c_fn(re_p, im_p, n, qubit, outcome, prob)
+    return outcome
+
+
+def compile_circuit_host_measured(ops, n: int, density: bool = False):
+    """DYNAMIC circuit on the native host engine: step(state, draws=None)
+    -> (state, outcomes int array). Measurement-free stretches run
+    through the blocked native runner; measurements collapse natively
+    (qh_measure_sv_*); classical feedback evaluates on the host and
+    conditionally runs its inner ops as their own native program.
+
+    `draws` supplies the per-measurement uniforms; default draws from
+    quest_tpu.random_ (the reference-exact MT19937 when the native
+    library is loaded) — the SAME stream the eager measurement API uses
+    (measurement.measure_with_stats), so identically-seeded host and
+    eager trajectories match outcome-for-outcome. Statevector only:
+    density dynamic circuits run on the XLA engines
+    (compiled_measured / the sharded measured compiler)."""
+    from quest_tpu.circuit import flatten_ops
+
+    lib = _load()
+    if lib is None:
+        raise HostEngineUnsupported("native host library unavailable")
+    if density:
+        raise HostEngineUnsupported(
+            "density dynamic circuits run on the XLA engines")
+    flat = flatten_ops(ops, n, density)
+
+    # split at dynamic barriers; encode each static piece (and each
+    # classical op's inner gate list) as its own native program
+    def encode(piece):
+        if not piece:
+            return None
+        prog, coef, groups, block_log = _encode(piece, n)
+        return (prog, coef, groups, block_log)
+
+    program = []        # ("run", enc) | ("measure", qubit) |
+                        # ("classical", conds, enc)
+    cur = []
+    n_meas = 0
+    for op in flat:
+        if op.kind == "measure":
+            program.append(("run", encode(cur)))
+            cur = []
+            program.append(("measure", int(op.targets[0])))
+            n_meas += 1
+        elif op.kind in ("measure_dm",):
+            raise HostEngineUnsupported(
+                "density dynamic circuits run on the XLA engines")
+        elif op.kind == "classical":
+            program.append(("run", encode(cur)))
+            cur = []
+            inners, conds = op.operand
+            program.append(("classical", tuple(conds),
+                            encode(list(inners))))
+        else:
+            cur.append(op)
+    program.append(("run", encode(cur)))
+    if not n_meas:
+        from quest_tpu.validation import QuESTError
+        raise QuESTError(
+            "Invalid operation: compile_circuit_host_measured requires "
+            "at least one mid-circuit measurement; use "
+            "compile_circuit_host instead.")
+
+    def step(state, draws=None):
+        from quest_tpu import random_ as R
+        arr = _as_planes(state, n)
+        it = iter(draws) if draws is not None else None
+
+        def draw():
+            if it is None:
+                return R.uniform()
+            try:
+                return next(it)
+            except StopIteration:
+                raise ValueError(
+                    f"draws exhausted: this circuit has {n_meas} "
+                    f"measurements (forced outcomes consume none)")
+
+        outcomes = []
+        for el in program:
+            if el[0] == "run":
+                if el[1] is not None:
+                    prog, coef, groups, block_log = el[1]
+                    _run_native(lib, arr, n, prog, coef, groups,
+                                block_log, 1)
+            elif el[0] == "measure":
+                outcomes.append(_measure_native(lib, arr, n, el[1],
+                                                draw))
+            else:                           # classical feedback
+                _, conds, enc = el
+                if all(outcomes[i] == want for i, want in conds) \
+                        and enc is not None:
+                    prog, coef, groups, block_log = enc
+                    _run_native(lib, arr, n, prog, coef, groups,
+                                block_log, 1)
+        return arr, np.asarray(outcomes, dtype=np.int32)
 
     return step

@@ -168,3 +168,110 @@ def test_host_plan_summary_counts_sweeps():
     c.rx(19, 0.2)              # high target: own full sweep
     s = host.plan_summary(flatten_ops(c.ops, 20, False), 20)
     assert "9 gates" in s and "2 state sweep(s)" in s
+
+
+# --- native dynamic circuits (measurement + feedback in C) ---------------
+
+
+def test_host_measured_matches_eager_trajectories():
+    """Identically-seeded host-native and eager-API trajectories match
+    outcome-for-outcome AND state-for-state: both draw from the same
+    reference-exact MT19937 stream (quest_tpu/random_), and the native
+    collapse follows the same u > p0 / eps-guard rules."""
+    import jax
+
+    from quest_tpu import measurement as meas
+    from quest_tpu import random_ as R
+    from quest_tpu.ops import gates as G
+
+    c = Circuit(3).h(0).cnot(0, 1).ry(2, 0.7)
+    c.measure(1)
+    c.x_if(2, (0, 1))
+    c.measure(2)
+    step = c.compiled_host_measured(3, False)
+    for s in range(6):
+        R.seed_quest([s, s + 1])
+        v = np.zeros((2, 8))
+        v[0, 0] = 1.0
+        arr, outs = step(v)
+        R.seed_quest([s, s + 1])
+        q = qt.create_qureg(3, dtype=np.complex128)
+        q = G.rotate_y(G.controlled_not(G.hadamard(q, 0), 0, 1), 2, 0.7)
+        q, o1 = meas.measure(q, 1)
+        if o1 == 1:
+            q = G.pauli_x(q, 2)
+        q, o2 = meas.measure(q, 2)
+        assert list(outs) == [o1, o2]
+        np.testing.assert_allclose(arr[0] + 1j * arr[1], to_dense(q),
+                                   atol=1e-12, rtol=0)
+
+
+def test_host_measured_explicit_draws_force_branches():
+    """draws= pins the uniforms: u below/above p0 selects each branch
+    deterministically, and the collapsed state is exact."""
+    c = Circuit(1).h(0)
+    c.measure(0)
+    step = c.compiled_host_measured(1, False)
+    v = np.zeros((2, 2))
+    v[0, 0] = 1.0
+    arr, outs = step(v.copy(), draws=[0.1])      # u < p0=0.5 -> outcome 0
+    assert list(outs) == [0] and abs(arr[0, 0] - 1.0) < 1e-12
+    arr, outs = step(v.copy(), draws=[0.9])      # u > p0 -> outcome 1
+    assert list(outs) == [1] and abs(arr[0, 1] - 1.0) < 1e-12
+
+
+def test_host_measured_repeat_is_consistent():
+    c = Circuit(1).h(0)
+    c.measure(0)
+    c.measure(0)
+    step = c.compiled_host_measured(1, False)
+    from quest_tpu import random_ as R
+    for s in range(10):
+        R.seed_quest([40 + s])
+        v = np.zeros((2, 2))
+        v[0, 0] = 1.0
+        _, outs = step(v)
+        assert outs[0] == outs[1]
+
+
+def test_host_measured_guards():
+    from quest_tpu.validation import QuESTError
+
+    with pytest.raises(QuESTError, match="at least one"):
+        Circuit(1).h(0).compiled_host_measured(1, False)
+    c = Circuit(1).h(0)
+    c.measure(0)
+    with pytest.raises(host.HostEngineUnsupported, match="density"):
+        c.compiled_host_measured(2, True)
+
+
+def test_host_measured_forced_outcome_keeps_stream_in_sync():
+    """Review r5 regression: a deterministic measurement (qubit already
+    in a basis state) must consume NO uniform — the eager API draws
+    only when the outcome is not eps-forced, so a host path that drew
+    unconditionally would desync identically-seeded trajectories."""
+    from quest_tpu import measurement as meas
+    from quest_tpu import random_ as R
+    from quest_tpu.ops import gates as G
+
+    c = Circuit(2)
+    c.measure(0)            # |00>: outcome forced to 0, no draw
+    c.h(1)
+    c.measure(1)            # genuine 50/50: consumes THE first draw
+    step = c.compiled_host_measured(2, False)
+    for s in range(12):
+        R.seed_quest([77 + s])
+        v = np.zeros((2, 4))
+        v[0, 0] = 1.0
+        _, outs = step(v)
+        R.seed_quest([77 + s])
+        q = qt.create_qureg(2, dtype=np.complex128)
+        q, o0 = meas.measure(q, 0)
+        q, o1 = meas.measure(G.hadamard(q, 1), 1)
+        assert list(outs) == [o0, o1], (s, list(outs), [o0, o1])
+    # an exhausted explicit draws sequence is a named error, not a bare
+    # StopIteration
+    with pytest.raises(ValueError, match="draws exhausted"):
+        v = np.zeros((2, 4))
+        v[0, 0] = 1.0
+        step(v, draws=[])

@@ -129,3 +129,20 @@ def test_host_kernels_native_runner_exercise():
             os.environ.pop("QUEST_HOST_BLOCK", None)
             if old is not None:
                 os.environ["QUEST_HOST_BLOCK"] = old
+
+    # native measurement kernel: both forced branches + a feedback run
+    dc = Circuit(n)
+    dc.ops.append(GateOp("matrix", (2,), (), (),
+                         np.array([[1, 1], [1, -1]]) / np.sqrt(2)))
+    dc.measure(2)
+    dc.x_if(0, (0, 1))
+    dc.measure(0)
+    step = host.compile_circuit_host_measured(dc.ops, n, False)
+    for u0 in (0.01, 0.99):
+        v = np.zeros((2, 1 << n))
+        v[0, 0] = 1.0
+        v, outs = step(v, draws=[u0, 0.5])
+        assert outs[0] == (0 if u0 < 0.5 else 1)
+        assert outs[1] == outs[0]       # feedback X(0) iff outcome 1
+        norm = float((v.astype(np.float64) ** 2).sum())
+        assert abs(norm - 1.0) < 1e-6
