@@ -314,9 +314,60 @@ void collapse_sv(T* re, T* im, int n, int qubit, int outcome,
     }
 }
 
+template <typename T>
+double prob0_dm(const T* re, int n, int qubit) {
+    // density register (n = 2*nd state qubits, column-major flat
+    // rho[r + c*2^nd]): probability of outcome 0 = sum of diagonal
+    // entries rho[r,r] whose bit `qubit` of r is 0
+    const int nd = n / 2;
+    const uint64_t dim = 1ULL << nd;
+    double p0 = 0.0;
+    for (uint64_t r = 0; r < dim; ++r)
+        if (((r >> qubit) & 1) == 0)
+            p0 += (double)re[r * (dim + 1)];
+    return p0;
+}
+
+template <typename T>
+void collapse_dm(T* re, T* im, int n, int qubit, int outcome,
+                 double prob) {
+    // keep entries whose ROW bit q and COLUMN bit q (= flat bit q+nd)
+    // both equal the outcome, scaled by 1/prob (density renormalizes
+    // by the probability, not its square root); zero the rest
+    const int nd = n / 2;
+    const uint64_t namps = 1ULL << n;
+    const T scale = (T)(1.0 / prob);
+    const uint64_t m_lo = 1ULL << qubit;
+    const uint64_t m_hi = 1ULL << (qubit + nd);
+    const uint64_t want = outcome ? (m_lo | m_hi) : 0;
+    for (uint64_t i = 0; i < namps; ++i) {
+        bool keep = (i & (m_lo | m_hi)) == want;
+        re[i] = keep ? re[i] * scale : (T)0;
+        im[i] = keep ? im[i] * scale : (T)0;
+    }
+}
+
 }  // namespace
 
 extern "C" {
+
+double qh_prob0_dm_f32(const float* re, int n, int qubit) {
+    return prob0_dm(re, n, qubit);
+}
+
+double qh_prob0_dm_f64(const double* re, int n, int qubit) {
+    return prob0_dm(re, n, qubit);
+}
+
+void qh_collapse_dm_f32(float* re, float* im, int n, int qubit,
+                        int outcome, double prob) {
+    collapse_dm(re, im, n, qubit, outcome, prob);
+}
+
+void qh_collapse_dm_f64(double* re, double* im, int n, int qubit,
+                        int outcome, double prob) {
+    collapse_dm(re, im, n, qubit, outcome, prob);
+}
 
 double qh_prob0_sv_f32(const float* re, const float* im, int n,
                        int qubit) {

@@ -943,7 +943,8 @@ class Circuit:
         default draws come from the reference-exact MT19937 — the same
         stream the eager API uses, so identically-seeded host and eager
         trajectories match outcome-for-outcome (quest_tpu/host.py
-        compile_circuit_host_measured). Statevector only."""
+        compile_circuit_host_measured); density registers collapse
+        both spaces natively."""
         from quest_tpu import host as H
         key = ("host-measured", n, density,
                os.environ.get("QUEST_HOST_BLOCK", ""))

@@ -15,8 +15,11 @@ This engine exists for the CPU-fallback path (bench.py's ladder when no
 TPU is reachable) and as a fast host-side oracle; the TPU engines remain
 the primary compute path. Supported op kinds after flatten_ops:
 matrix / diagonal / parity / allones (superops arrive pre-flattened as
-matrix ops). Dynamic ops (measure/classical) and traced operands raise
-HostEngineUnsupported so callers fall back loudly.
+matrix ops) on the static path (compile_circuit_host); dynamic
+circuits — mid-circuit measurement + classical feedback, statevector
+AND density — run natively through compile_circuit_host_measured.
+Traced operands and over-wide targets raise HostEngineUnsupported so
+callers fall back loudly.
 """
 
 from __future__ import annotations
@@ -39,7 +42,8 @@ _MAX_TARGETS = 6
 
 class HostEngineUnsupported(RuntimeError):
     """Raised when a circuit cannot run on the native host engine
-    (dynamic ops, traced operands, too many targets, or no native lib);
+    (traced operands, too many targets, or no native lib — dynamic ops
+    on the STATIC entry point belong on compile_circuit_host_measured);
     callers fall back to an XLA engine and report the fallback."""
 
 
@@ -62,12 +66,19 @@ def _bind(lib: ctypes.CDLL) -> None:
                        ctypes.c_int, ctypes.c_int]
         fn.restype = ctypes.c_double
     for name, fp in (("qh_collapse_sv_f32", ctypes.c_float),
-                     ("qh_collapse_sv_f64", ctypes.c_double)):
+                     ("qh_collapse_sv_f64", ctypes.c_double),
+                     ("qh_collapse_dm_f32", ctypes.c_float),
+                     ("qh_collapse_dm_f64", ctypes.c_double)):
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.POINTER(fp), ctypes.POINTER(fp),
                        ctypes.c_int, ctypes.c_int, ctypes.c_int,
                        ctypes.c_double]
         fn.restype = None
+    for name, fp in (("qh_prob0_dm_f32", ctypes.c_float),
+                     ("qh_prob0_dm_f64", ctypes.c_double)):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.POINTER(fp), ctypes.c_int, ctypes.c_int]
+        fn.restype = ctypes.c_double
 
 
 _lib = None
@@ -253,24 +264,29 @@ def _run_native(lib, arr, n, prog, coef, groups, block_log, iters):
         raise RuntimeError(f"native host runner failed (rc={rc})")
 
 
-def _measure_native(lib, arr, n: int, qubit: int, draw) -> int:
-    """Native statevector measurement MIRRORING the eager API's logic
-    (measurement.measure_with_stats): native p0 pass, then the outcome
-    draw happens HERE — `draw()` is only called when the outcome is not
-    eps-forced, exactly like the eager path, so identically-seeded host
-    and eager trajectories consume the same MT19937 stream — then a
-    native collapse pass. Returns the outcome."""
+def _measure_native(lib, arr, n: int, qubit: int, draw,
+                    density: bool = False) -> int:
+    """Native measurement MIRRORING the eager API's logic
+    (measurement.measure_with_stats): native probability pass, then the
+    outcome draw happens HERE — `draw()` is only called when the
+    outcome is not eps-forced, exactly like the eager path, so
+    identically-seeded host and eager trajectories consume the same
+    MT19937 stream — then a native collapse pass (1/sqrt(prob) for
+    statevectors, 1/prob both-space for density registers). Returns the
+    outcome."""
     from quest_tpu import precision
     eps = float(precision.real_eps(arr.dtype))
-    if arr.dtype == np.float32:
-        p_fn, c_fn, fp = (lib.qh_prob0_sv_f32, lib.qh_collapse_sv_f32,
-                          ctypes.c_float)
-    else:
-        p_fn, c_fn, fp = (lib.qh_prob0_sv_f64, lib.qh_collapse_sv_f64,
-                          ctypes.c_double)
+    kind = "dm" if density else "sv"
+    bits = "f32" if arr.dtype == np.float32 else "f64"
+    fp = ctypes.c_float if arr.dtype == np.float32 else ctypes.c_double
+    p_fn = getattr(lib, f"qh_prob0_{kind}_{bits}")
+    c_fn = getattr(lib, f"qh_collapse_{kind}_{bits}")
     re_p = arr[0].ctypes.data_as(ctypes.POINTER(fp))
     im_p = arr[1].ctypes.data_as(ctypes.POINTER(fp))
-    p0 = float(p_fn(re_p, im_p, n, qubit))
+    if density:
+        p0 = float(p_fn(re_p, n, qubit))
+    else:
+        p0 = float(p_fn(re_p, im_p, n, qubit))
     if p0 < eps:
         outcome = 1
     elif 1.0 - p0 < eps:
@@ -286,24 +302,21 @@ def compile_circuit_host_measured(ops, n: int, density: bool = False):
     """DYNAMIC circuit on the native host engine: step(state, draws=None)
     -> (state, outcomes int array). Measurement-free stretches run
     through the blocked native runner; measurements collapse natively
-    (qh_measure_sv_*); classical feedback evaluates on the host and
+    (qh_prob0_*/qh_collapse_*); classical feedback evaluates on the host and
     conditionally runs its inner ops as their own native program.
 
     `draws` supplies the per-measurement uniforms; default draws from
     quest_tpu.random_ (the reference-exact MT19937 when the native
     library is loaded) — the SAME stream the eager measurement API uses
     (measurement.measure_with_stats), so identically-seeded host and
-    eager trajectories match outcome-for-outcome. Statevector only:
-    density dynamic circuits run on the XLA engines
-    (compiled_measured / the sharded measured compiler)."""
+    eager trajectories match outcome-for-outcome. Density registers
+    measure natively too (diagonal probability + both-space 1/prob
+    collapse, qh_prob0_dm_* / qh_collapse_dm_*)."""
     from quest_tpu.circuit import flatten_ops
 
     lib = _load()
     if lib is None:
         raise HostEngineUnsupported("native host library unavailable")
-    if density:
-        raise HostEngineUnsupported(
-            "density dynamic circuits run on the XLA engines")
     flat = flatten_ops(ops, n, density)
 
     # split at dynamic barriers; encode each static piece (and each
@@ -314,19 +327,17 @@ def compile_circuit_host_measured(ops, n: int, density: bool = False):
         prog, coef, groups, block_log = _encode(piece, n)
         return (prog, coef, groups, block_log)
 
-    program = []        # ("run", enc) | ("measure", qubit) |
+    program = []        # ("run", enc) | ("measure", qubit, density) |
                         # ("classical", conds, enc)
     cur = []
     n_meas = 0
     for op in flat:
-        if op.kind == "measure":
+        if op.kind in ("measure", "measure_dm"):
             program.append(("run", encode(cur)))
             cur = []
-            program.append(("measure", int(op.targets[0])))
+            program.append(("measure", int(op.targets[0]),
+                            op.kind == "measure_dm"))
             n_meas += 1
-        elif op.kind in ("measure_dm",):
-            raise HostEngineUnsupported(
-                "density dynamic circuits run on the XLA engines")
         elif op.kind == "classical":
             program.append(("run", encode(cur)))
             cur = []
@@ -367,7 +378,7 @@ def compile_circuit_host_measured(ops, n: int, density: bool = False):
                                 block_log, 1)
             elif el[0] == "measure":
                 outcomes.append(_measure_native(lib, arr, n, el[1],
-                                                draw))
+                                                draw, density=el[2]))
             else:                           # classical feedback
                 _, conds, enc = el
                 if all(outcomes[i] == want for i, want in conds) \

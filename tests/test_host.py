@@ -239,10 +239,40 @@ def test_host_measured_guards():
 
     with pytest.raises(QuESTError, match="at least one"):
         Circuit(1).h(0).compiled_host_measured(1, False)
-    c = Circuit(1).h(0)
+
+
+def test_host_measured_density_matches_eager():
+    """Density-register dynamic circuit natively: diagonal probability,
+    both-space 1/prob collapse, same MT19937 stream as the eager API."""
+    from quest_tpu import measurement as meas
+    from quest_tpu import random_ as R
+    from quest_tpu.ops import gates as G
+
+    nd = 2
+    c = Circuit(nd).h(0).cnot(0, 1).dephasing(0, 0.25)
     c.measure(0)
-    with pytest.raises(host.HostEngineUnsupported, match="density"):
-        c.compiled_host_measured(2, True)
+    c.x_if(1, (0, 1))
+    c.measure(1)
+    step = c.compiled_host_measured(2 * nd, True)
+    for s in range(8):
+        R.seed_quest([9 + s])
+        v = np.zeros((2, 1 << (2 * nd)))
+        v[0, 0] = 1.0                      # |00><00| column-major flat
+        arr, outs = step(v)
+        R.seed_quest([9 + s])
+        q = qt.create_density_qureg(nd, dtype=np.complex128)
+        q = G.controlled_not(G.hadamard(q, 0), 0, 1)
+        from quest_tpu.ops import channels as CH
+        q = CH.mix_dephasing(q, 0, 0.25)
+        q, o0 = meas.measure(q, 0)
+        if o0 == 1:
+            q = G.pauli_x(q, 1)
+        q, o1 = meas.measure(q, 1)
+        assert list(outs) == [o0, o1], (s, list(outs), [o0, o1])
+        got = (arr[0] + 1j * arr[1]).reshape(1 << nd, 1 << nd,
+                                             order="F")
+        np.testing.assert_allclose(got, to_dense(q), atol=1e-12,
+                                   rtol=0)
 
 
 def test_host_measured_forced_outcome_keeps_stream_in_sync():
