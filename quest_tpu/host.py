@@ -327,16 +327,17 @@ def compile_circuit_host_measured(ops, n: int, density: bool = False):
         prog, coef, groups, block_log = _encode(piece, n)
         return (prog, coef, groups, block_log)
 
-    program = []        # ("run", enc) | ("measure", qubit, density) |
+    program = []        # ("run", enc) | ("measure", qubit) |
                         # ("classical", conds, enc)
     cur = []
     n_meas = 0
     for op in flat:
         if op.kind in ("measure", "measure_dm"):
+            # flatten_ops tags every measure as measure_dm iff density;
+            # the executor closes over `density` (one source of truth)
             program.append(("run", encode(cur)))
             cur = []
-            program.append(("measure", int(op.targets[0]),
-                            op.kind == "measure_dm"))
+            program.append(("measure", int(op.targets[0])))
             n_meas += 1
         elif op.kind == "classical":
             program.append(("run", encode(cur)))
@@ -378,7 +379,7 @@ def compile_circuit_host_measured(ops, n: int, density: bool = False):
                                 block_log, 1)
             elif el[0] == "measure":
                 outcomes.append(_measure_native(lib, arr, n, el[1],
-                                                draw, density=el[2]))
+                                                draw, density=density))
             else:                           # classical feedback
                 _, conds, enc = el
                 if all(outcomes[i] == want for i, want in conds) \

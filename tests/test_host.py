@@ -305,3 +305,68 @@ def test_host_measured_forced_outcome_keeps_stream_in_sync():
         v = np.zeros((2, 4))
         v[0, 0] = 1.0
         step(v, draws=[])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_host_measured_fuzz_vs_eager(seed):
+    """Randomized dynamic circuits (the fuzz vocabulary interleaved
+    with measurements + feedback): host-native trajectories match an
+    eager-API replay — same MT19937 stream, same outcomes, same state."""
+    from quest_tpu import measurement as meas
+    from quest_tpu import random_ as R
+    from .test_fuzz import _random_circuit
+
+    n = 5
+    rng = np.random.default_rng(9000 + seed)
+    c = Circuit(n)
+    meas_count = 0
+    for block in range(3):
+        blk, _ = _random_circuit(rng, n, depth=4)
+        c.ops.extend(blk.ops)
+        q_m = int(rng.integers(0, n))
+        c.measure(q_m)
+        c.x_if(int(rng.integers(0, n)),
+               (meas_count, int(rng.integers(0, 2))))
+        meas_count += 1
+
+    def eager_run(key_seeds):
+        R.seed_quest(key_seeds)
+        q = qt.create_qureg(n, dtype=np.complex128)
+        outs = []
+        buf = Circuit(n)
+
+        def flush(q):
+            nonlocal buf
+            if buf.ops:
+                q = buf.apply(q)
+                buf = Circuit(n)
+            return q
+
+        for op in c.ops:
+            if op.kind == "measure":
+                q = flush(q)
+                q, o = meas.measure(q, op.targets[0])
+                outs.append(o)
+            elif op.kind == "classical":
+                q = flush(q)
+                inners, conds = op.operand
+                if all(outs[i] == w for i, w in conds):
+                    cc = Circuit(n)
+                    cc.ops = list(inners)
+                    q = cc.apply(q)
+            else:
+                buf.ops.append(op)
+        return flush(q), outs
+
+    step = c.compiled_host_measured(n, False)
+    for s in range(3):
+        key_seeds = [7000 + 13 * seed + s]
+        R.seed_quest(key_seeds)
+        v = np.zeros((2, 1 << n))
+        v[0, 0] = 1.0
+        arr, outs = step(v)
+        q_ref, outs_ref = eager_run(key_seeds)
+        assert list(outs) == list(outs_ref), (seed, s)
+        np.testing.assert_allclose(arr[0] + 1j * arr[1], to_dense(q_ref),
+                                   atol=1e-11, rtol=0,
+                                   err_msg=f"seed={seed} s={s}")
