@@ -290,6 +290,59 @@ def _measure_density(reps: int):
     return None, None
 
 
+def _measure_f64(reps: int):
+    """(gates/sec, n) for the f64 (reference-default precision) banded
+    path — on TPU this rides the MXU limb scheme (ops/apply.py
+    _limb_band_contract, r5); returns (None, None) on any failure so
+    the headline JSON never breaks. TPU-only: the CPU fallback's f64
+    story is the host engine's, already covered by the headline."""
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        return None, None
+    prior_x64 = bool(jax.config.jax_enable_x64)
+    if not prior_x64:
+        try:
+            jax.config.update("jax_enable_x64", True)
+        except Exception:
+            return None, None
+    try:
+        return _measure_f64_inner(reps)
+    finally:
+        if not prior_x64:
+            # restore the process-global flag: anything running after
+            # this helper (tpu_prewarm imports bench) must not silently
+            # promote f32 work to f64
+            jax.config.update("jax_enable_x64", prior_x64)
+
+
+def _measure_f64_inner(reps: int):
+    import jax.numpy as jnp
+
+    for n in (26, 24):
+        try:
+            circ = _build_circuit(n)
+            iters = 4
+            t0 = time.perf_counter()
+            step = circ.compiled_banded(n, density=False, donate=True,
+                                        iters=iters)
+            state = jnp.zeros((2, 1 << n),
+                              dtype=jnp.float64).at[0, 0].set(1.0)
+            state = step(state)
+            _sync(state)
+            _log(f"f64 n={n} compile+warmup {time.perf_counter()-t0:.1f}s")
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                state = step(state)
+            _sync(state)
+            dt = time.perf_counter() - t0
+            gps = GATES_PER_STEP * iters * reps / dt
+            _log(f"f64 banded n={n}: {gps:.1f} gates/s (MXU limb dots)")
+            return gps, n
+        except Exception:
+            _log(f"f64 n={n} failed; trying next size down:\n"
+                 f"{traceback.format_exc()}")
+    return None, None
+
+
 def _baseline_gates_per_sec(n: int) -> tuple[float, str]:
     """Reference gates/sec at size n. Prefers the measured reference-build
     numbers (amps/sec scale-invariantly per the reference's O(2^n) kernels);
@@ -354,6 +407,7 @@ def main():
          f"(this host has one; its OpenMP build rejects modern GCC)")
 
     density_ops, density_nd = _measure_density(reps=3)
+    f64_gps, f64_n = _measure_f64(reps=2)
 
     line = {
         "metric": f"single-qubit gates/sec @ {n}q statevec ({platform})",
@@ -367,6 +421,11 @@ def main():
                                   f"density ({platform})")
         line["density_value"] = round(density_ops, 2)
         line["density_unit"] = "ops/sec"
+    if f64_gps is not None:
+        line["f64_metric"] = (f"single-qubit gates/sec @ {f64_n}q "
+                              f"statevec f64/MXU-limb ({platform})")
+        line["f64_value"] = round(f64_gps, 2)
+        line["f64_unit"] = "gates/sec"
     print(json.dumps(line))
 
 
