@@ -68,19 +68,20 @@ def _build_circuit(n: int):
     return c
 
 
-def _basis_state(shape):
+def _basis_state(shape, rdt=None):
     """|0...0> planes built in ONE fused device buffer DIRECTLY in the
     engine's view shape (zeros().at.set() would briefly hold two
     full-state buffers; an out-of-jit reshape would relayout-copy —
-    either one is 16 GB at 30q)."""
+    either one is 16 GB at 30q). rdt defaults to float32; the f64
+    scenario passes float64."""
     import jax.numpy as jnp
     from quest_tpu.state import basis_planes
 
     n = int(np.prod(shape)).bit_length() - 2  # shape holds 2 * 2^n reals
-    return basis_planes(0, n=n, rdt=jnp.float32, shape=shape)
+    return basis_planes(0, n=n, rdt=rdt or jnp.float32, shape=shape)
 
 
-def banded_fits(n: int) -> bool:
+def banded_fits(n: int, bytes_per_real: int = 4) -> bool:
     """Whether the banded engine's XLA band-dot footprint fits this
     device. The band dots need ~3x the state in HLO temps even under
     remat (measured: 24 GB at 30q, six 4 GB dot_general buffers), so on a
@@ -109,7 +110,8 @@ def banded_fits(n: int) -> bool:
             lim = int(15.75 * 2**30)
             _log(f"device hides HBM stats; assuming {lim/2**30:.2f} GiB "
                  f"for device_kind={kind!r} (override via QUEST_HBM_BYTES)")
-    need = 4 * 2 * 4 * (1 << n)  # state (2 f32 planes) + ~3x in temps
+    # state (2 planes) + ~3x in temps; f64 planes double every term
+    need = 4 * 2 * bytes_per_real * (1 << n)
     if lim is None:
         _log(f"device reports no HBM limit; banded OOM gate is a no-op "
              f"at n={n} (a too-big size will pay its full compile "
@@ -318,14 +320,15 @@ def _measure_f64_inner(reps: int):
     import jax.numpy as jnp
 
     for n in (26, 24):
+        if not banded_fits(n, bytes_per_real=8):
+            continue
         try:
             circ = _build_circuit(n)
             iters = 4
             t0 = time.perf_counter()
-            step = circ.compiled_banded(n, density=False, donate=True,
-                                        iters=iters)
-            state = jnp.zeros((2, 1 << n),
-                              dtype=jnp.float64).at[0, 0].set(1.0)
+            step, shape = _engine_step(circ, n, "banded", iters,
+                                       density=False)
+            state = _basis_state(shape, rdt=jnp.float64)
             state = step(state)
             _sync(state)
             _log(f"f64 n={n} compile+warmup {time.perf_counter()-t0:.1f}s")

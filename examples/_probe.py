@@ -1,0 +1,10 @@
+"""Bounded backend probe for running examples as scripts — import for
+the side effect. A dead TPU tunnel must not hang an example
+(quest_tpu/env.py ensure_live_backend: subprocess probe with timeout,
+loud fallback to the host CPU). One home for the probe behavior; every
+example imports this under ``if __name__ == "__main__"`` so the test
+suite's imports stay no-ops."""
+
+from quest_tpu.env import ensure_live_backend
+
+ensure_live_backend()

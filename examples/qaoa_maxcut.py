@@ -25,6 +25,15 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if __name__ == "__main__":
+    # bounded backend probe FIRST — a dead TPU tunnel must not hang the
+    # example run; one home for the behavior (examples/_probe.py)
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), ".."))
+    from examples import _probe  # noqa: F401
+
+
 N = 8
 EDGES = [(i, (i + 1) % N) for i in range(N)] + [(i, i + 4) for i in range(4)]
 LAYERS = 2

@@ -25,6 +25,15 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if __name__ == "__main__":
+    # bounded backend probe FIRST — a dead TPU tunnel must not hang the
+    # example run; one home for the behavior (examples/_probe.py)
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), ".."))
+    from examples import _probe  # noqa: F401
+
+
 M = 15          # the number to factor
 A = 7           # coprime base: order 4 mod 15
 T_BITS = 8      # counting precision: 2 * ceil(log2 M)

@@ -13,6 +13,15 @@ Run: python examples/vqe_example.py
 
 import numpy as np
 
+
+if __name__ == "__main__":
+    # bounded backend probe FIRST — a dead TPU tunnel must not hang the
+    # example run; one home for the behavior (examples/_probe.py)
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), ".."))
+    from examples import _probe  # noqa: F401
+
 import jax
 import jax.numpy as jnp
 
