@@ -928,7 +928,10 @@ class Circuit:
     def apply_host(self, q: Qureg, donate: bool = False) -> Qureg:
         """Apply via the native host engine (numpy planes). donate=False
         copies first so q's buffer survives (the engine itself is
-        in-place)."""
+        in-place). Donation only takes effect for registers backed by a
+        writable numpy array: jax device buffers are immutable, so a
+        jax-backed q.amps costs exactly one host copy either way (the
+        engine's _as_planes makes it when the view is read-only)."""
         if self.num_qubits != q.num_qubits:
             raise ValueError("circuit/register size mismatch")
         fn = self.compiled_host(q.num_state_qubits, q.is_density)
@@ -1075,14 +1078,29 @@ class Circuit:
         lines = [f"fused schedule for {len(self.ops)} ops on "
                  f"{self.num_qubits} qubits"
                  + (f" (density: {n}-qubit register)" if density else "")]
+        flat = self._flat_ops(n, density)
+
+        def host_line():
+            # the CPU-fallback story: what the native host engine would
+            # do with this circuit (the bench ladder's first off-chip
+            # rung) — omitted when the native library or an op's host
+            # kernel is unavailable, never fatal to explain()
+            try:
+                from quest_tpu import host as H
+                if H.available():
+                    lines.append("  cpu fallback "
+                                 + H.plan_summary(flat, n))
+            except Exception:
+                pass
+
         if not PB.usable(n):
             lines.append(f"  register below the kernel tier's minimum "
                          f"({PB.LANE_QUBITS + 3} qubits): the banded XLA "
                          f"engine runs instead")
+            host_line()
             return "\n".join(lines)
 
-        items = F.plan(self._flat_ops(n, density), n,
-                       bands=PB.plan_bands(n))
+        items = F.plan(flat, n, bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
         kernels = set()
         passes = 0
@@ -1146,6 +1164,7 @@ class Circuit:
             f"  estimated steady state on one {chip}: {lo:.1f}-{hi:.1f} "
             f"ms per application at HIGHEST "
             f"(constants: {model['provenance']}){tag}")
+        host_line()
         return "\n".join(lines)
 
     def explain_sharded(self, mesh, density: bool = False,
@@ -1301,6 +1320,9 @@ class Circuit:
         on for banded/fused) runs the layer-amortized relabel pass per
         measurement-free stretch."""
         from quest_tpu.parallel import sharded as S
+        # the compiler's own defaulting, so equivalent calls share one
+        # compiled program
+        engine, relabel = S.resolve_measured_engine(engine, relabel)
         key_ = ("sharded-measured", n, density, mesh, donate, engine,
                 relabel, interpret, _engine_mode_key())
         fn = self._compiled.get(key_)

@@ -1069,7 +1069,11 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
             get_out(s, slot).start()
             return 0
 
-        jax.lax.fori_loop(0, steps, step_body, 0)
+        # int32 bounds pin the loop counter (and everything derived from
+        # it in idx_of) to 32 bits: under jax_enable_x64 Python-int
+        # bounds trace as int64, which Mosaic cannot lower (the x64 test
+        # suite's on-chip smoke run hits exactly this)
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps), step_body, 0)
         for j in range(nbuf):                # drain the tail out-DMAs
             s = steps - nbuf + j
             if s >= 0:

@@ -157,10 +157,7 @@ def sharded_measured_schedule(ops: Sequence, n: int, density: bool, mesh,
         jax.ShapeDtypeStruct((2, 1 << n), rdt), key)
     rec = parse_collectives(lowered.as_text(), num_devices=D)
 
-    if engine is None:
-        engine = "xla"
-    if relabel is None:
-        relabel = engine in ("banded", "fused")
+    engine, relabel = S.resolve_measured_engine(engine, relabel)
     flat = flatten_ops(ops, n, density)
     # interpret=True here too: this stats pass re-plans the program (the
     # compiler's own plan isn't exposed), and non-interpret segment

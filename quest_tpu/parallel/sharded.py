@@ -874,6 +874,22 @@ def plan_measured_program(flat: Sequence, n: int, local_n: int,
     return program, engine
 
 
+def resolve_measured_engine(engine, relabel, banded: bool = False):
+    """The ONE home of the dynamic engine's argument defaulting —
+    engine=None means 'xla' (or 'banded' via the legacy bool), relabel
+    defaults on for the fusing engines. Shared by the compiler below and
+    Circuit.compiled_sharded_measured's cache key so equivalent calls
+    always resolve to (and cache as) the same program."""
+    if engine is None:
+        engine = "banded" if banded else "xla"
+    if engine not in ("xla", "banded", "fused"):
+        raise ValueError(f"engine must be 'xla', 'banded' or 'fused', "
+                         f"got {engine!r}")
+    if relabel is None:
+        relabel = engine in ("banded", "fused")
+    return engine, relabel
+
+
 def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
                                      mesh: Mesh, donate: bool = True,
                                      banded: bool = False,
@@ -910,13 +926,7 @@ def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
     from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
 
-    if engine is None:
-        engine = "banded" if banded else "xla"
-    if engine not in ("xla", "banded", "fused"):
-        raise ValueError(f"engine must be 'xla', 'banded' or 'fused', "
-                         f"got {engine!r}")
-    if relabel is None:
-        relabel = engine in ("banded", "fused")
+    engine, relabel = resolve_measured_engine(engine, relabel, banded)
 
     D = int(mesh.devices.size)
     g = int(math.log2(D))

@@ -554,3 +554,27 @@ def test_bit_flip_cycle_30q_class_lowers_with_relabel_and_kernels():
         np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
         np.testing.assert_allclose(to_dense(r1), to_dense(r2),
                                    atol=1e-10, rtol=0)
+
+
+def test_sharded_measured_cache_key_normalizes_defaults():
+    """engine=None/'xla' and relabel=None/<engine default> must share one
+    compiled program — the cache key mirrors the compiler's defaulting
+    (review r5: raw-argument keys compiled the same pod-scale dynamic
+    program twice)."""
+    from quest_tpu.parallel import make_amp_mesh
+    mesh = make_amp_mesh(4)
+    c = Circuit(6)
+    c.h(0)
+    c.measure(0)
+    c.x(1)
+    assert c.compiled_sharded_measured(6, False, mesh, True, None, None) \
+        is c.compiled_sharded_measured(6, False, mesh, True, "xla", None)
+    assert c.compiled_sharded_measured(6, False, mesh, True, "banded",
+                                       None) \
+        is c.compiled_sharded_measured(6, False, mesh, True, "banded",
+                                       True)
+    # distinct settings still get distinct programs
+    assert c.compiled_sharded_measured(6, False, mesh, True, "banded",
+                                       False) \
+        is not c.compiled_sharded_measured(6, False, mesh, True, "banded",
+                                           True)

@@ -497,6 +497,11 @@ def test_explain_reports_schedule_without_compiling():
     assert "kernel segment" in text and "mat:b0" in text
     assert "1 segments, 1 distinct kernels" in text
     assert not c._compiled            # planning only, nothing compiled
+    # the CPU-fallback sweep plan rides along when the native host
+    # library is available (review r5: plan_summary was test-only)
+    from quest_tpu import host as H
+    if H._load() is not None:
+        assert "cpu fallback host engine:" in text
 
     qft_text = qft_circuit(12).explain()
     assert qft_text.count("kernel segment") >= 2
