@@ -1016,6 +1016,16 @@ class Circuit:
                     a, n, (it.gre, it.gim), it.ql, it.w, it.preds))
             elif isinstance(it, F.DiagItem):
                 xla_fn = lambda a, it=it: _apply_one(a, n, it.op)
+            elif it.op.kind == "matrix":
+                # matrix passthroughs (cross-band multi-target ops,
+                # channel superops) stay in the (2, rows, 128) kernel
+                # layout — a flat round-trip at this size costs a
+                # full-state layout copy (the 8 GiB copy that OOMed the
+                # 30q density bench; see apply_matrix_rows)
+                op = it.op
+                return (lambda amps, op=op: A.apply_matrix_rows(
+                    amps, n, cplx.pack(op.operand), op.targets,
+                    op.controls, op.cstates))
             else:
                 xla_fn = lambda a, it=it: _apply_op(a, n, False, it.op)
             return (lambda amps, f=xla_fn:

@@ -214,9 +214,89 @@ def apply_matrix(
     re = amps[0].reshape(dims)
     im = amps[1].reshape(dims)
     taxes = [axis_of[t] for t in targets]
+    nre, nim = _flip_form(re, im, mre, mim, concrete, targets, dims,
+                          axis_of, taxes)
+    mask = control_mask(ndims, axis_of, controls, control_states)
+    if mask is not None:
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
+
+
+def apply_matrix_rows(amps3, n, op_pair, targets,
+                      controls: Sequence[int] = (),
+                      control_states: Sequence[int] = ()):
+    """apply_matrix on the fused-engine layout: `amps3` is the
+    (2, 2^(n-7), 128) shaped state the Pallas segment kernels consume,
+    and the result keeps that shape. The point is what does NOT happen:
+    no flat (2, 2^n) intermediate ever exists, so XLA never converts
+    between the (rows, 128)-tiled kernel layout and the flat layout — a
+    conversion that materializes a full-state copy (measured: the 8 GiB
+    copy_bitcast that pushed the 30-qubit density-channel bench past
+    HBM). All row-axis reshapes here split the major axis only, which is
+    layout-free. Matrix ops with a lane-qubit (< 7) target ride the
+    128x128 lane-block embedding (_laneblock_core); all-row-target ops
+    ride the flip-form butterfly over the row view with the lane axis as
+    trailing batch. Oversized operators (k > _UNROLL_MAX_TARGETS) fall
+    back to the flat path with one explicit round-trip."""
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
+    control_states = norm_control_states(controls, control_states)
+    k = len(targets)
+    if k > _UNROLL_MAX_TARGETS:
+        flat = apply_matrix(amps3.reshape(2, -1), n, op_pair, targets,
+                            controls, control_states)
+        return flat.reshape(amps3.shape)
+    if any(t < _LANE_QUBITS for t in targets):
+        return _laneblock_core(amps3, n, op_pair, targets, controls,
+                               control_states)
+    # every target in row space; controls may sit on either side
+    mre, mim, concrete = _as_pair(op_pair, amps3.dtype)
+    mre = mre.reshape(1 << k, 1 << k)
+    mim = mim.reshape(1 << k, 1 << k)
+    rows_n = n - _LANE_QUBITS
+    row_ts = tuple(t - _LANE_QUBITS for t in targets)
+    hi_cs = [(c - _LANE_QUBITS, s)
+             for c, s in zip(controls, control_states) if c >= _LANE_QUBITS]
+    lo_cs = [(c, s)
+             for c, s in zip(controls, control_states) if c < _LANE_QUBITS]
+    qubits = tuple(sorted(set(row_ts) | {c for c, _ in hi_cs},
+                          reverse=True))
+    rdims, axis_of = seg_view(rows_n, qubits)
+    dims = rdims + (_LANES,)
+    re = amps3[0].reshape(dims)
+    im = amps3[1].reshape(dims)
+    taxes = [axis_of[t] for t in row_ts]
+    nre, nim = _flip_form(re, im, mre, mim, concrete, row_ts, dims,
+                          axis_of, taxes)
+    mask = control_mask(len(dims), axis_of,
+                        tuple(c for c, _ in hi_cs),
+                        tuple(s for _, s in hi_cs))
+    if lo_cs:
+        # lane-qubit controls: a (128,) predicate on the lane axis — the
+        # lane axis is never split (that would break the 128-lane tiling)
+        lane = np.arange(_LANES)
+        lmask = np.ones(_LANES, dtype=bool)
+        for c, s in lo_cs:
+            lmask &= ((lane >> c) & 1) == s
+        lvec = jnp.asarray(lmask).reshape((1,) * (len(dims) - 1)
+                                          + (_LANES,))
+        mask = lvec if mask is None else (mask & lvec)
+    if mask is not None:
+        nre = jnp.where(mask, nre, re)
+        nim = jnp.where(mask, nim, im)
+    shape = amps3.shape[1:]
+    return jnp.stack([nre.reshape(shape), nim.reshape(shape)])
+
+
+def _flip_form(re, im, mre, mim, concrete, targets, dims, axis_of, taxes):
+    """The flip-form butterfly loop (module docstring): out = sum_d
+    C_d * rev_d(x) over the target axes `taxes` of the segment views
+    `re`/`im`. Control masking is the caller's job. Shared by the flat
+    apply_matrix and the shaped row-view path (apply_matrix_rows)."""
+    k = len(targets)
     lib = np if concrete else jnp
     rows = np.arange(1 << k)
-
     nre = None
     nim = None
     for d in range(1 << k):
@@ -246,11 +326,7 @@ def apply_matrix(
     if nre is None:  # all-zero matrix
         nre = jnp.zeros_like(re)
         nim = jnp.zeros_like(im)
-    mask = control_mask(ndims, axis_of, controls, control_states)
-    if mask is not None:
-        nre = jnp.where(mask, nre, re)
-        nim = jnp.where(mask, nim, im)
-    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
+    return nre, nim
 
 
 def _f64_mxu_enabled() -> bool:
@@ -445,7 +521,31 @@ def _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
     operator applies as (rows, 128) @ L_rc^T — the minor dim never drops
     below 128 lanes (TPU tiling stays 1x). Works for traced operands (the
     embedding is a linear combination of precomputed basis matrices)."""
-    mre, mim, concrete = _as_pair(op_pair, amps.dtype)
+    rows = 1 << (n - _LANE_QUBITS)
+    out = _laneblock_core(amps.reshape(2, rows, _LANES), n, op_pair,
+                          targets, controls, control_states)
+    return out.reshape(2, -1)
+
+
+_PASSTHROUGH_CHUNKS = 8          # capacity-mode sweep granularity
+_CHUNK_MIN_BYTES = 1 << 30       # chunk once a plane reaches 1 GiB
+
+
+def _laneblock_core(st2, n, op_pair, targets, controls,
+                    control_states, chunks=None):
+    """_apply_matrix_laneblock's body on the STACKED (2, rows, 128)
+    planes, returning the same shape — shared with apply_matrix_rows,
+    whose callers keep the state in the kernel layout and must never
+    see a flat (2, 2^n) intermediate (the layout round-trip costs a
+    full-state copy on TPU). The stacked carry matters for the chunked
+    path: a fori_loop over separate per-plane carries forces XLA to
+    materialize each plane as its own buffer (measured: +8 GiB at 30q),
+    while ONE stacked carry aliases the donated input. `chunks`: None =
+    auto (chunk the sweep once a plane reaches _CHUNK_MIN_BYTES), 1 =
+    whole-plane, N = force N chunks (tests exercise the chunked path at
+    small sizes)."""
+    rdtype = st2.dtype
+    mre, mim, concrete = _as_pair(op_pair, rdtype)
     k = len(targets)
     mre = mre.reshape(1 << k, 1 << k)
     mim = mim.reshape(1 << k, 1 << k)
@@ -463,11 +563,11 @@ def _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
     # float32 state to float64 under jax_enable_x64 (doubling the state
     # buffer — the very OOM this path prevents)
     if concrete:
-        basis_l = basis.astype(amps.dtype)
-        unsat_l = unsat.astype(amps.dtype)
+        basis_l = basis.astype(rdtype)
+        unsat_l = unsat.astype(rdtype)
     else:
-        basis_l = jnp.asarray(basis, dtype=amps.dtype)
-        unsat_l = jnp.asarray(unsat, dtype=amps.dtype)
+        basis_l = jnp.asarray(basis, dtype=rdtype)
+        unsat_l = jnp.asarray(unsat, dtype=rdtype)
 
     def _indices(hpat):
         """Matrix indices whose low bits sweep and high bits equal hpat."""
@@ -499,16 +599,9 @@ def _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
                        {c - _LANE_QUBITS for c, _ in hc}, reverse=True)
     rdims, raxis = seg_view(rows_n, tuple(high_bits))
     dims = rdims + (_LANES,)
-    re = amps[0].reshape(dims)
-    im = amps[1].reshape(dims)
+    view = st2.reshape((2,) + dims)
     taxes = [raxis[targets[j] - _LANE_QUBITS] for j in high_idx]
-
-    def block(x, combo):
-        idx = [slice(None)] * len(dims)
-        for b, ax in enumerate(taxes):
-            v = (combo >> b) & 1
-            idx[ax] = slice(v, v + 1)
-        return x[tuple(idx)]
+    ndims = len(dims)
 
     hi = precision.matmul_precision()
 
@@ -516,48 +609,97 @@ def _apply_matrix_laneblock(amps, n, op_pair, targets, controls,
         flat = x.reshape(-1, _LANES)
         return jnp.matmul(flat, L.T, precision=hi).reshape(x.shape)
 
-    out_re = [None] * (1 << kh)
-    out_im = [None] * (1 << kh)
-    for rh in range(1 << kh):
-        nr = ni = None
-        for ch in range(1 << kh):
-            Lre = lane_op(mre, rh, ch, with_unsat=(rh == ch))
-            Lim = lane_op(mim, rh, ch, with_unsat=False)
-            xr, xi_ = block(re, ch), block(im, ch)
-            if concrete and np.all(np.asarray(Lim) == 0.0):
-                if np.all(np.asarray(Lre) == 0.0):
-                    continue
-                tr, ti = matmul(xr, Lre), matmul(xi_, Lre)
-            else:
-                t1 = matmul(xr, Lre)
-                t2 = matmul(xi_, Lim)
-                t3 = matmul(xr + xi_, Lre + Lim)
-                tr, ti = t1 - t2, t3 - t1 - t2
-            nr = tr if nr is None else nr + tr
-            ni = ti if ni is None else ni + ti
-        if nr is None:
-            nr, ni = jnp.zeros_like(block(re, rh)), jnp.zeros_like(block(im, rh))
-        out_re[rh] = nr
-        out_im[rh] = ni
+    def apply_view(vre, vim):
+        """The block-matmul sweep on one view with the `dims` axis
+        structure (the chunked path calls it with a shorter free axis —
+        only sizes change, never axis numbering)."""
 
-    for b in range(kh):
-        ax = taxes[b]
-        out_re = [jnp.concatenate([out_re[2 * i], out_re[2 * i + 1]], axis=ax)
-                  for i in range(len(out_re) // 2)]
-        out_im = [jnp.concatenate([out_im[2 * i], out_im[2 * i + 1]], axis=ax)
-                  for i in range(len(out_im) // 2)]
-    nre, nim = out_re[0], out_im[0]
+        def block(x, combo):
+            idx = [slice(None)] * ndims
+            for b, ax in enumerate(taxes):
+                v = (combo >> b) & 1
+                idx[ax] = slice(v, v + 1)
+            return x[tuple(idx)]
 
-    if hc:
-        mask = None
-        for c, s in hc:
-            shape = [1] * len(dims)
-            shape[raxis[c - _LANE_QUBITS]] = 2
-            vec = jnp.arange(2).reshape(shape) == s
-            mask = vec if mask is None else (mask & vec)
-        nre = jnp.where(mask, nre, re)
-        nim = jnp.where(mask, nim, im)
-    return jnp.stack([nre.reshape(-1), nim.reshape(-1)])
+        out_re = [None] * (1 << kh)
+        out_im = [None] * (1 << kh)
+        for rh in range(1 << kh):
+            nr = ni = None
+            for ch in range(1 << kh):
+                Lre = lane_op(mre, rh, ch, with_unsat=(rh == ch))
+                Lim = lane_op(mim, rh, ch, with_unsat=False)
+                xr, xi_ = block(vre, ch), block(vim, ch)
+                if concrete and np.all(np.asarray(Lim) == 0.0):
+                    if np.all(np.asarray(Lre) == 0.0):
+                        continue
+                    tr, ti = matmul(xr, Lre), matmul(xi_, Lre)
+                else:
+                    t1 = matmul(xr, Lre)
+                    t2 = matmul(xi_, Lim)
+                    t3 = matmul(xr + xi_, Lre + Lim)
+                    tr, ti = t1 - t2, t3 - t1 - t2
+                nr = tr if nr is None else nr + tr
+                ni = ti if ni is None else ni + ti
+            if nr is None:
+                nr = jnp.zeros_like(block(vre, rh))
+                ni = jnp.zeros_like(block(vim, rh))
+            out_re[rh] = nr
+            out_im[rh] = ni
+
+        for b in range(kh):
+            ax = taxes[b]
+            out_re = [jnp.concatenate([out_re[2 * i], out_re[2 * i + 1]],
+                                      axis=ax)
+                      for i in range(len(out_re) // 2)]
+            out_im = [jnp.concatenate([out_im[2 * i], out_im[2 * i + 1]],
+                                      axis=ax)
+                      for i in range(len(out_im) // 2)]
+        nre, nim = out_re[0], out_im[0]
+
+        if hc:
+            mask = None
+            for c, s in hc:
+                shape = [1] * ndims
+                shape[raxis[c - _LANE_QUBITS]] = 2
+                vec = jnp.arange(2).reshape(shape) == s
+                mask = vec if mask is None else (mask & vec)
+            nre = jnp.where(mask, nre, vre)
+            nim = jnp.where(mask, nim, vim)
+        return nre, nim
+
+    # Near HBM capacity the block matmuls cost full-plane layout copies
+    # (measured at 30q: XLA hoists a 4 GiB transposed copy PER PLANE so
+    # the strided target-axis blocks become contiguous — with the state
+    # itself that is 20 GiB > v5e's 15.75). Chunk the sweep over the
+    # largest FREE segment axis (the op never mixes it): a fori_loop
+    # reads one chunk, applies the sweep, and writes it back in place,
+    # so only chunk-sized temps are ever live.
+    free_axes = [ax for ax in range(ndims - 1)
+                 if ax not in raxis.values()]
+    chunk_ax = max(free_axes, key=lambda ax: dims[ax], default=None)
+    if chunks is None:
+        plane_bytes = st2[0].size * st2.dtype.itemsize
+        chunks = _PASSTHROUGH_CHUNKS if plane_bytes >= _CHUNK_MIN_BYTES \
+            else 1
+    if chunk_ax is not None and chunks > 1:
+        chunks = min(chunks, dims[chunk_ax])   # powers of 2 throughout
+    if chunk_ax is not None and chunks > 1 \
+            and dims[chunk_ax] % chunks == 0:
+        cs = dims[chunk_ax] // chunks
+        vax = chunk_ax + 1                     # skip the plane axis
+
+        def body(i, carry):
+            start = i * cs
+            chunk = lax.dynamic_slice_in_dim(carry, start, cs, axis=vax)
+            nr, ni = apply_view(chunk[0], chunk[1])
+            return lax.dynamic_update_slice_in_dim(
+                carry, jnp.stack([nr, ni]), start, axis=vax)
+
+        out = lax.fori_loop(0, chunks, body, view)
+    else:
+        nre, nim = apply_view(view[0], view[1])
+        out = jnp.stack([nre, nim])
+    return out.reshape(st2.shape)
 
 
 def _apply_matrix_matmul(amps, n, op_pair, targets, controls,

@@ -164,6 +164,10 @@ def stage_report(n: int = None, reps: int = 5, out=None) -> dict:
             amps = jfn(amps)
         _ = np.asarray(amps[0, 0, :4])
         ms = (time.perf_counter() - t0) / reps * 1e3
+        del amps    # free this case's state BEFORE the next case
+                    # allocates its own — two live 30q states (8 GiB
+                    # each) exceed v5e HBM (seen as ResourceExhausted
+                    # while the next jit baked its operand constants)
         lo, hi = _estimate_ms([("segment", stages, arrays)], n, model)
         rec[label] = {"measured_ms": round(ms, 2),
                       "model_lo_ms": round(lo, 2),

@@ -638,3 +638,141 @@ def test_scan_applier_matches_sequential_with_stub_segment():
     for m, v in zip(mats, vecs):
         want = want @ m.T + v
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_apply_matrix_rows_matches_flat():
+    """apply_matrix on the (2, rows, 128) kernel layout must match the
+    flat path across target/control placements. The shaped path exists
+    because the flat round-trip at capacity costs a full-state layout
+    copy (the 8 GiB copy_bitcast that OOMed the 30q density bench)."""
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as A
+    n = 12
+    rng = np.random.default_rng(7)
+    amps = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    amps3 = jnp.asarray(amps.reshape(2, -1, 128))
+    cases = [
+        ((0, 8, 9, 11), (), ()),           # low + high targets (laneblock)
+        ((8, 10), (), ()),                 # all-row targets
+        ((7, 11), (3,), (1,)),             # row targets, lane control
+        ((9,), (8, 2), (0, 1)),            # row target, mixed controls
+        ((1, 3), (9,), (1,)),              # lane targets, row control
+        ((8, 9, 10, 11), (), ()),          # k=4 all-row
+        ((0, 5, 8, 11), (2, 10), (1, 0)),  # mixed everything
+        ((4, 7), (), ()),                  # straddling lane/row boundary
+    ]
+    for targets, controls, cstates in cases:
+        k = len(targets)
+        m = (rng.standard_normal((2, 1 << k, 1 << k)) * 0.5
+             ).astype(np.float32)
+        pair = (m[0], m[1])                # non-unitary on purpose
+        want = A.apply_matrix(jnp.asarray(amps), n, pair, targets,
+                              controls, cstates)
+        got = A.apply_matrix_rows(amps3, n, pair, targets, controls,
+                                  cstates)
+        assert got.shape == amps3.shape, (targets, controls)
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(2, -1), np.asarray(want),
+            atol=2e-5, rtol=0, err_msg=f"{targets} {controls} {cstates}")
+
+
+def test_apply_matrix_rows_traced_operand():
+    """The shaped path must accept traced operands (dynamic gate
+    parameters) on both the laneblock and row flip-form routes."""
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as A
+    n = 11
+    rng = np.random.default_rng(3)
+    amps = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    amps3 = jnp.asarray(amps.reshape(2, -1, 128))
+    for targets in [(0, 9), (8, 10)]:
+        m = (rng.standard_normal((2, 4, 4)) * 0.5).astype(np.float32)
+
+        def f(a3, mm):
+            return A.apply_matrix_rows(a3, n, (mm[0], mm[1]), targets)
+
+        got = jax.jit(f)(amps3, jnp.asarray(m))
+        want = A.apply_matrix(jnp.asarray(amps), n, (m[0], m[1]), targets)
+        np.testing.assert_allclose(np.asarray(got).reshape(2, -1),
+                                   np.asarray(want), atol=2e-5, rtol=0)
+
+
+def test_matrix_passthrough_runs_shaped():
+    """A scattered multi-target unitary no stage can host must fall
+    through as a matrix passthrough AND still match the per-gate engine
+    — through apply_matrix_rows, never a flat intermediate."""
+    rng = np.random.default_rng(11)
+    z = rng.normal(size=(8, 8)) + 1j * rng.normal(size=(8, 8))
+    u, _ = np.linalg.qr(z)
+    c = Circuit(N)
+    c.h(0)
+    c.gate(u, (0, 5, 9))
+    c.ry(8, 0.3)
+    parts = parts_of(c)
+    assert any(p[0] != "segment" for p in parts)   # the passthrough
+    check(c, tol=5e-5)
+
+
+def test_density_channel_passthrough_at_bench_shape():
+    """The bench's capacity scenario in miniature: a 2q Kraus map whose
+    doubled-register superop hits 4 scattered targets (0, nd-1, nd,
+    2nd-1) — the exact op that was OOMing nd=15 on chip — must ride the
+    shaped passthrough and match the per-gate engine on a density
+    register."""
+    from quest_tpu.ops import matrices as M
+    nd = 8
+    rng = np.random.default_rng(5)
+    c = Circuit(nd)
+    for q in range(nd):
+        c.rx(q, float(rng.uniform(0, 2 * np.pi)))
+    p = 0.15
+    paulis = [np.eye(2), M.PAULI_X, M.PAULI_Y, M.PAULI_Z]
+    ops2 = []
+    for i, a in enumerate(paulis):
+        for j, b in enumerate(paulis):
+            w = np.sqrt(1 - 15 * p / 16) if i == j == 0 else np.sqrt(p / 16)
+            ops2.append(w * np.kron(b, a))
+    c.kraus((0, nd - 1), ops2)
+    items = F.plan(c._flat_ops(2 * nd, True), 2 * nd,
+                   bands=PB.plan_bands(2 * nd))
+    parts = PB.segment_plan(items, 2 * nd)
+    kinds = [getattr(p[1].op, "kind", "?") for p in parts
+             if p[0] != "segment"]
+    assert "matrix" in kinds                      # the 4-target superop
+    check(c, n=2 * nd, density=True, tol=5e-5)
+
+
+def test_laneblock_chunked_sweep_matches():
+    """The capacity-mode chunked sweep (fori_loop over a free segment
+    axis, in-place chunk updates) must agree exactly with the
+    whole-plane sweep and the flat engine — including high controls and
+    zero-coefficient skipping."""
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as A
+    n = 13
+    rng = np.random.default_rng(21)
+    amps = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    st2 = jnp.asarray(amps.reshape(2, -1, 128))
+    cases = [
+        ((0, 12), (), ()),              # free interior axis q7..q11
+        ((2, 8, 12), (), ()),
+        ((1, 12), (9,), (0,)),          # high control rides the mask
+    ]
+    for targets, controls, cstates in cases:
+        k = len(targets)
+        m = (rng.standard_normal((2, 1 << k, 1 << k)) * 0.5
+             ).astype(np.float32)
+        pair = (m[0], m[1])
+        whole = A._laneblock_core(st2, n, pair, targets,
+                                  controls, cstates, chunks=1)
+        chunked = A._laneblock_core(st2, n, pair,
+                                    targets, controls, cstates, chunks=4)
+        np.testing.assert_allclose(np.asarray(chunked),
+                                   np.asarray(whole), atol=1e-6, rtol=0,
+                                   err_msg=f"{targets} {controls}")
+        want = A.apply_matrix(jnp.asarray(amps), n, pair, targets,
+                              controls, cstates)
+        np.testing.assert_allclose(
+            np.asarray(chunked).reshape(2, -1), np.asarray(want),
+            atol=2e-5, rtol=0)
