@@ -59,11 +59,12 @@ _LOOP_UNROLL_MAX = 32
 
 def _engine_mode_key():
     """The trace-time mode flags every compiled-program cache key must
-    carry: matmul precision AND the f64-MXU limb-scheme switch (both
-    change what ops/apply traces — omitting either returns stale
-    programs when a user flips the knob mid-process, the cache-key
-    discipline of ADVICE r4 item 2 / review r5)."""
-    return (precision.matmul_precision(), A._f64_mxu_enabled())
+    carry: matmul precision, the f64-MXU limb-scheme switch, and the
+    limb chunk size (all change what ops/apply traces — omitting any
+    returns stale programs when a user flips the knob mid-process, the
+    cache-key discipline of ADVICE r4 item 2 / review r5)."""
+    return (precision.matmul_precision(), A._f64_mxu_enabled(),
+            A._f64_chunk_elems())
 
 # named-gate recovery for Circuit.to_qasm (the builder stores operands;
 # the QASM recorder prefers gate names, like the eager API)

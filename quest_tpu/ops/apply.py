@@ -420,6 +420,71 @@ def _limb_band_contract(g64, x64):
     return sg.reshape(1, -1, 1) * sx * total
 
 
+def _f64_chunk_elems() -> int:
+    """Row-chunk size (elements) for the f64 limb path. The un-chunked
+    scheme materializes six full-band f32 limb slices per limbs() call
+    (three calls per complex contraction via Gauss) plus int32 partials
+    — ~4x the f64 state in HLO temps, which OOMed 28q on a 15.75 GiB
+    v5e (scripts/probe_f64.py, measured 2026-08-02). Chunking the
+    contraction bounds the temps at chunk size; the path is HBM-bound,
+    so per-chunk MXU efficiency is unaffected at this granularity.
+    QUEST_F64_CHUNK overrides (elements per chunk, power of two; 0
+    disables chunking); knobs parse loudly per the config convention."""
+    import os
+    v = os.environ.get("QUEST_F64_CHUNK")
+    if v is None:
+        return 1 << 24
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(
+            f"QUEST_F64_CHUNK must be an integer element count, got {v!r}")
+
+
+def _limb_apply_chunked(gre, gim, re, im, real_only, chunk_elems):
+    """The complex f64 band application of apply_band, computed through
+    _limb_band_contract one row-chunk at a time under jax.lax.map so
+    the limb slices and int32 partials never exceed chunk size. Chunks
+    the larger of the pre/post axes — a band at the top of the index
+    has pre == 1, where post splits instead (one layout pass each way;
+    two extra state touches against ~20 saved in temps)."""
+    pre, band, post = re.shape
+    nc_needed = max(1, re.size // int(chunk_elems))
+    gre64 = jnp.asarray(gre, jnp.float64)
+    gim64 = jnp.asarray(gim, jnp.float64)
+
+    if pre >= post:
+        nc = min(pre, nc_needed)
+
+        def resh(x):
+            return x.reshape(nc, pre // nc, band, post)
+
+        def unresh(x):
+            return x.reshape(pre, band, post)
+    else:
+        nc = min(post, nc_needed)
+        pc = post // nc
+
+        def resh(x):
+            return jnp.moveaxis(x.reshape(pre, band, nc, pc), 2, 0)
+
+        def unresh(x):
+            return jnp.moveaxis(x, 0, 2).reshape(pre, band, post)
+
+    def body(xs):
+        re_c, im_c = xs
+        if real_only:
+            return (_limb_band_contract(gre64, re_c),
+                    _limb_band_contract(gre64, im_c))
+        t1 = _limb_band_contract(gre64, re_c)
+        t2 = _limb_band_contract(gim64, im_c)
+        t3 = _limb_band_contract(gre64 + gim64, re_c + im_c)
+        return t1 - t2, t3 - t1 - t2
+
+    nre, nim = jax.lax.map(body, (resh(re), resh(im)))
+    return unresh(nre), unresh(nim)
+
+
 def apply_band(
     amps: jax.Array,
     n: int,
@@ -448,25 +513,32 @@ def apply_band(
     gim = jnp.asarray(gim).reshape(band, band)
     hi = precision.matmul_precision()
 
-    if amps.dtype == jnp.float64 and _f64_mxu_enabled():
-        # f64 on matmul hardware without f64 dots: exact-integer limb
-        # slices on the MXU (see _limb_band_contract)
-        def contract(g, x):
-            return _limb_band_contract(jnp.asarray(g, jnp.float64), x)
+    limb64 = amps.dtype == jnp.float64 and _f64_mxu_enabled()
+    chunk = _f64_chunk_elems() if limb64 else 0
+    if limb64 and chunk and re.size > chunk:
+        # large-register f64: chunked limb application keeps the HLO
+        # temps bounded (28q would OOM un-chunked; _f64_chunk_elems)
+        nre, nim = _limb_apply_chunked(gre, gim, re, im, real_only, chunk)
     else:
-        def contract(g, x):
-            return jnp.einsum("ab,pbq->paq", g, x, precision=hi)
+        if limb64:
+            # f64 on matmul hardware without f64 dots: exact-integer
+            # limb slices on the MXU (see _limb_band_contract)
+            def contract(g, x):
+                return _limb_band_contract(jnp.asarray(g, jnp.float64), x)
+        else:
+            def contract(g, x):
+                return jnp.einsum("ab,pbq->paq", g, x, precision=hi)
 
-    if real_only:
-        nre = contract(gre, re)
-        nim = contract(gre, im)
-    else:
-        # Gauss 3-multiplication complex matmul (25% fewer MXU passes)
-        t1 = contract(gre, re)
-        t2 = contract(gim, im)
-        t3 = contract(gre + gim, re + im)
-        nre = t1 - t2
-        nim = t3 - t1 - t2
+        if real_only:
+            nre = contract(gre, re)
+            nim = contract(gre, im)
+        else:
+            # Gauss 3-multiplication complex matmul (25% fewer MXU passes)
+            t1 = contract(gre, re)
+            t2 = contract(gim, im)
+            t3 = contract(gre + gim, re + im)
+            nre = t1 - t2
+            nim = t3 - t1 - t2
 
     if preds:
         mask = None

@@ -1,0 +1,89 @@
+"""Measure the QFT-30 cold-process cost with the fused-scan path on/off.
+
+probe_cold_start.py pinned the relay first-execution cost as PER-BYTE
+(program size), not per-kernel — so QUEST_FUSED_SCAN, which rolls QFT's
+repeated identical phase segments into ONE lax.scan body instead of
+inlining every copy, is the lever for VERDICT r4 item 3 (QFT-30 cold
+process 266 s; target <= 120 s).
+
+Each arm runs in a FRESH subprocess twice: run 1 populates the
+persistent XLA cache for that arm's program, run 2 isolates the
+relay-side per-program cost that dominates the cold wall.
+
+Usage: python scripts/probe_qft_cold.py [n]   (default 30)
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, sys, time
+t_proc = time.perf_counter()
+sys.path.insert(0, %(repo)r)
+from quest_tpu.precision import enable_compile_cache
+enable_compile_cache()
+import os
+import jax
+import jax.numpy as jnp
+import numpy as np
+from quest_tpu.circuit import qft_circuit
+from quest_tpu.state import basis_planes, fused_state_shape
+
+n = %(n)d
+c = qft_circuit(n)
+step = c.compiled_fused(n, density=False, donate=True)
+amps = basis_planes(0, n=n, rdt=jnp.float32, shape=fused_state_shape(n))
+t0 = time.perf_counter()
+amps = step(amps)
+_ = np.asarray(amps[0, 0, :4])
+first = time.perf_counter() - t0
+t0 = time.perf_counter()
+amps = step(amps)
+_ = np.asarray(amps[0, 0, :4])
+steady = time.perf_counter() - t0
+print("[probe-result] " + json.dumps(dict(
+    scan=os.environ.get("QUEST_FUSED_SCAN", "unset"), n=n,
+    platform=jax.devices()[0].platform,
+    first_s=round(first, 2), steady_s=round(steady, 3),
+    cold_process_s=round(time.perf_counter() - t_proc, 1))), flush=True)
+"""
+
+
+def run(flag, n):
+    env = dict(os.environ)
+    env["QUEST_FUSED_SCAN"] = flag
+    code = WORKER % dict(repo=REPO, n=n)
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=2400, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        print(f"[probe] TIMEOUT scan={flag}", flush=True)
+        return None
+    wall = time.time() - t0
+    for line in r.stdout.splitlines():
+        if line.startswith("[probe-result]"):
+            rec = json.loads(line[len("[probe-result]"):])
+            rec["process_wall_s"] = round(wall, 1)
+            print("[probe-result] " + json.dumps(rec), flush=True)
+            return rec
+    print(f"[probe] FAILED scan={flag}: {r.stdout[-300:]} "
+          f"{r.stderr[-1500:]}", flush=True)
+    return None
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    for flag in ("0", "1"):
+        # twice: run 1 warms the persistent cache for this arm's
+        # program; run 2 is the relay-cost measurement
+        run(flag, n)
+        run(flag, n)
+
+
+if __name__ == "__main__":
+    main()

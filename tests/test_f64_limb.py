@@ -122,3 +122,53 @@ def test_f32_path_untouched(force_limb):
                                             g.imag.astype(np.float32)),
                      ql=2, w=2)
     assert out.dtype == jnp.float32
+
+
+def test_chunked_limb_matches_unchunked(force_limb, monkeypatch):
+    """Large-register f64 runs the limb application CHUNKED under
+    jax.lax.map (apply.py _limb_apply_chunked) so the limb-slice temps
+    stay bounded — the un-chunked working set OOMed 28q on a 16 GiB
+    chip (scripts/probe_f64.py 2026-08-02). Forcing a tiny
+    QUEST_F64_CHUNK triggers the path at test size; both chunk axes
+    (low band: pre chunks; top band, pre == 1: post chunks) and both
+    operator classes (complex Gauss, real-only) must match the
+    un-chunked result exactly — identical per-element op order, just
+    bounded batches."""
+    n = 12
+    rng = np.random.default_rng(5)
+    gc = np.linalg.qr(rng.normal(size=(8, 8))
+                      + 1j * rng.normal(size=(8, 8)))[0]
+    gr = np.linalg.qr(rng.normal(size=(8, 8)))[0]    # real orthogonal
+    amps = rng.normal(size=(2, 1 << n))
+    amps /= np.sqrt((amps ** 2).sum())
+    for g in (gc, gr):
+        for ql in (2, n - 3):       # pre-chunk / post-chunk (pre == 1)
+            pair = (np.ascontiguousarray(g.real),
+                    np.ascontiguousarray(g.imag))
+            base = np.asarray(apply_band(jnp.asarray(amps), n, pair,
+                                         ql=ql, w=3))
+            monkeypatch.setenv("QUEST_F64_CHUNK", "1024")
+            got = np.asarray(apply_band(jnp.asarray(amps), n, pair,
+                                        ql=ql, w=3))
+            monkeypatch.delenv("QUEST_F64_CHUNK")
+            np.testing.assert_array_equal(got, base)
+
+
+def test_chunk_knob_in_cache_key(force_limb, monkeypatch):
+    """QUEST_F64_CHUNK changes the traced program, so it must be part
+    of the compiled-program cache key (circuit._engine_mode_key — the
+    stale-key class of ADVICE r4 item 2)."""
+    from quest_tpu.circuit import _engine_mode_key
+    k0 = _engine_mode_key()
+    monkeypatch.setenv("QUEST_F64_CHUNK", "4096")
+    k1 = _engine_mode_key()
+    assert k0 != k1
+
+
+def test_chunk_knob_parses_loudly(force_limb, monkeypatch):
+    """A malformed QUEST_F64_CHUNK raises instead of silently falling
+    back (the config-knob convention)."""
+    from quest_tpu.ops.apply import _f64_chunk_elems
+    monkeypatch.setenv("QUEST_F64_CHUNK", "lots")
+    with pytest.raises(ValueError, match="QUEST_F64_CHUNK"):
+        _f64_chunk_elems()
