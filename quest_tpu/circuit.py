@@ -59,12 +59,15 @@ _LOOP_UNROLL_MAX = 32
 
 def _engine_mode_key():
     """The trace-time mode flags every compiled-program cache key must
-    carry: matmul precision, the f64-MXU limb-scheme switch, and the
-    limb chunk size (all change what ops/apply traces — omitting any
-    returns stale programs when a user flips the knob mid-process, the
-    cache-key discipline of ADVICE r4 item 2 / review r5)."""
-    return (precision.matmul_precision(), A._f64_mxu_enabled(),
-            A._f64_chunk_elems())
+    carry: matmul precision, the f64-MXU limb-scheme switch, the limb
+    chunk size (all change what ops/apply traces) and the gate-scheduler
+    switch (changes what the fusing engines plan) — omitting any returns
+    stale programs when a user flips the knob mid-process, the cache-key
+    discipline of ADVICE r4 item 2 / review r5. The apply-level prefix
+    is A.mode_key(), shared with the eager per-gate jit workers
+    (ops/gates.py) whose cache needs the same discipline."""
+    from quest_tpu.ops import fusion as F
+    return A.mode_key() + (F._schedule_enabled(),)
 
 # named-gate recovery for Circuit.to_qasm (the builder stores operands;
 # the QASM recorder prefers gate names, like the eager API)
@@ -307,6 +310,12 @@ def _estimate_ms(parts, n, model=None):
                     + (model["b1_extra"] if st.kind == "b1" else 0.0))
         if isinstance(st, PB.PairStage):
             return model["pair"]
+        if isinstance(st, PB.MultiPhaseStage):
+            # PROJECTED from the measured per-phase constant, not yet
+            # calibrated on chip: each row keeps the mask-accumulate
+            # (~1/3 of a lone phase stage's mask + trig blend), and the
+            # trig + complex multiply tail is paid once for the group
+            return model["phase"] * (0.7 + 0.3 * len(st.forms))
         return model["phase"]
 
     lo = hi = 0.0
@@ -664,7 +673,10 @@ class Circuit:
 
         if engine == "banded":
             from quest_tpu.ops import fusion as F
-            items = F.plan(flat, n)
+            # the scheduler treats measure/classical ops as barriers, so
+            # dynamic circuits reorder only within measurement-free
+            # stretches
+            items = F.plan(F.maybe_schedule(flat, n), n)
 
             def run(amps, key):
                 outs = []
@@ -876,6 +888,16 @@ class Circuit:
     def _flat_ops(self, n: int, density: bool) -> List[GateOp]:
         return flatten_ops(self.ops, n, density)
 
+    def _planned_flat(self, n: int, density: bool) -> List[GateOp]:
+        """The flat op list the FUSING engines plan from: flattened,
+        then reordered/composed by the commutation-aware scheduler
+        (quest_tpu.ops.fusion.schedule, QUEST_SCHEDULE knob). The
+        per-gate XLA engine (compiled / trace) deliberately stays
+        unscheduled — it is the semantic oracle the scheduled engines
+        are fuzzed against (tests/test_scheduler.py)."""
+        from quest_tpu.ops import fusion as F
+        return F.maybe_schedule(self._flat_ops(n, density), n)
+
     def compiled_banded(self, n: int, density: bool, donate: bool = True,
                         iters: int = 1):
         """Compiled program using the band-fusion engine
@@ -892,7 +914,7 @@ class Circuit:
             return fn
 
         from quest_tpu.ops import fusion as F
-        items = F.plan(self._flat_ops(n, density), n)
+        items = F.plan(self._planned_flat(n, density), n)
 
         def run(amps):
             return _loop(lambda a: _apply_banded_items(a, n, items), amps,
@@ -963,7 +985,7 @@ class Circuit:
         trace (the un-jitted core of compiled_banded)."""
         self._reject_measure("banded_trace")
         from quest_tpu.ops import fusion as F
-        items = F.plan(self._flat_ops(n, density), n)
+        items = F.plan(self._planned_flat(n, density), n)
         return _apply_banded_items(amps, n, items)
 
     def apply_banded(self, q: Qureg, donate: bool = False) -> Qureg:
@@ -995,7 +1017,7 @@ class Circuit:
             self._compiled[key] = fn
             return fn
 
-        flat = self._flat_ops(n, density)
+        flat = self._planned_flat(n, density)
         # PB.plan_bands now matches fusion's default 7-wide layout, so the
         # same plan serves both the kernel segmentation and the f64 XLA
         # band path
@@ -1072,6 +1094,48 @@ class Circuit:
                                  interpret)
         return q.replace_amps(fn(q.amps))
 
+    def plan_stats(self, density: bool = False) -> dict:
+        """Hardware-independent plan statistics — the pass-count metric
+        the commutation-aware scheduler is judged by, assertable on CPU
+        (no compile, no chip): 'banded' is fusion.plan_stats's model
+        (BandOps + PassOps + maximal DiagItem runs, each one full-state
+        HBM pass on the banded XLA engine); 'fused' — when the register
+        reaches the kernel tier — counts the Pallas engine's segments +
+        passthroughs (each one HBM pass per application), plus the
+        scheduler's own counters. Computed under the CURRENT
+        QUEST_SCHEDULE setting; toggle the knob and diff to see what
+        scheduling buys (docs/SCHEDULER.md, tests/test_scheduler.py)."""
+        self._reject_measure("plan_stats")
+        from quest_tpu.ops import fusion as F
+        from quest_tpu.ops import pallas_band as PB
+
+        n = self.num_qubits * 2 if density else self.num_qubits
+        flat = self._flat_ops(n, density)
+        enabled = F._schedule_enabled()
+        # ONE scheduler run serves both the stats and the planned list
+        sched_ops, sstats = F.schedule(flat, n)
+        sstats["enabled"] = enabled
+        planned = sched_ops if enabled else flat
+        rec = {
+            "scheduled": enabled,
+            "flat_ops": len(flat),
+            "planned_ops": len(planned),
+            "scheduler": sstats,
+            "banded": F.plan_stats(F.plan(planned, n)),
+        }
+        if PB.usable(n):
+            items = F.plan(planned, n, bands=PB.plan_bands(n))
+            parts = PB.segment_plan(items, n)
+            segs = sum(1 for p in parts if p[0] == "segment")
+            rec["fused"] = {
+                "kernel_segments": segs,
+                "xla_passthroughs": len(parts) - segs,
+                "full_state_passes": len(parts),
+                "stages": sum(len(p[1]) for p in parts
+                              if p[0] == "segment"),
+            }
+        return rec
+
     def explain(self, density: bool = False) -> str:
         """Human-readable fused-engine schedule: what compiled_fused will
         actually execute, WITHOUT paying a compile — one line per part
@@ -1090,6 +1154,20 @@ class Circuit:
                  f"{self.num_qubits} qubits"
                  + (f" (density: {n}-qubit register)" if density else "")]
         flat = self._flat_ops(n, density)
+        # ONE scheduler run serves both the stats line and the plan below
+        sched_ops, sched = F.schedule(flat, n)
+        enabled = F._schedule_enabled()
+        if enabled:
+            lines.append(
+                f"  scheduler: on (QUEST_SCHEDULE=1): "
+                f"{sched['delayed']} diagonal op(s) delayed, "
+                f"{sched['hoisted']} hoisted, {sched['fused_ops']} "
+                f"composed into {sched['fused_groups']} group(s)")
+        else:
+            lines.append(
+                f"  scheduler: OFF (QUEST_SCHEDULE=0); on, it would "
+                f"compose {sched['fused_ops']} diagonal op(s) into "
+                f"{sched['fused_groups']} group(s)")
 
         def host_line():
             # the CPU-fallback story: what the native host engine would
@@ -1111,7 +1189,11 @@ class Circuit:
             host_line()
             return "\n".join(lines)
 
-        items = F.plan(flat, n, bands=PB.plan_bands(n))
+        # the plan compiled_fused will actually execute: scheduled when
+        # the knob is on (host_line above deliberately keeps the raw
+        # flat list — the host engine consumes Circuit.ops directly)
+        items = F.plan(sched_ops if enabled else flat, n,
+                       bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
         kernels = set()
         passes = 0
@@ -1229,7 +1311,21 @@ class Circuit:
             plan_lines = [f"  local ops: {rec['local_ops']}",
                           f"  device-qubit ops: {rec['global_ops']}"]
         else:
+            sch = rec.get("scheduler", {})
+            if sch.get("enabled"):
+                sch_line = (f"  scheduler: on "
+                            f"({sch.get('fused_ops', 0)} diagonal op(s) "
+                            f"composed into {sch.get('fused_groups', 0)} "
+                            f"group(s), {sch.get('hoisted', 0)} hoisted)")
+            else:
+                # the plan below is UNSCHEDULED — report the dry-run
+                # counts as hypothetical, like explain() does
+                sch_line = (f"  scheduler: OFF (QUEST_SCHEDULE=0); on, "
+                            f"it would compose {sch.get('fused_ops', 0)} "
+                            f"diagonal op(s) into "
+                            f"{sch.get('fused_groups', 0)} group(s)")
             plan_lines = [
+                sch_line,
                 f"  local band passes: {rec['local_band_passes']}",
                 f"  global-qubit items: {rec['global_qubit_items']}"]
         return "\n".join([

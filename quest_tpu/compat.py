@@ -1,0 +1,56 @@
+"""JAX version compatibility shims.
+
+The engines are written against the current public names; older JAX
+releases (this container ships 0.4.37) spell several of them differently.
+Every version-sensitive lookup lives HERE, resolved once at import, so an
+API rename is a one-line fix instead of a grep across engines:
+
+  shard_map       jax.shard_map (new) / jax.experimental.shard_map (old,
+                  where the replication check is spelled `check_rep`;
+                  SAME polarity as the new `check_vma` — True enables
+                  the check on both APIs, so the shim passes the value
+                  through unchanged)
+  enable_x64      jax.enable_x64 (new) / jax.experimental.enable_x64
+  Pallas TPU      pltpu.MemorySpace.{HBM,VMEM} (new) /
+                  pltpu.TPUMemorySpace.{ANY,VMEM} (old — ANY means
+                  "compiler-chosen, HBM-resident for large buffers")
+                  and CompilerParams / TPUCompilerParams
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # jax <= 0.4.x
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:  # jax <= 0.4.x
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def pallas_tpu_names():
+    """(memory-space enum with .HBM/.VMEM attributes, CompilerParams
+    class) for the installed Pallas TPU module."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    params = getattr(pltpu, "CompilerParams", None)
+    if params is None:
+        params = pltpu.TPUCompilerParams
+    spaces = getattr(pltpu, "MemorySpace", None)
+    if spaces is not None and hasattr(spaces, "HBM"):
+        return spaces, params
+
+    class _Spaces:
+        HBM = pltpu.TPUMemorySpace.ANY
+        VMEM = pltpu.TPUMemorySpace.VMEM
+
+    return _Spaces, params

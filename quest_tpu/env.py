@@ -49,8 +49,16 @@ class QuESTEnv:
 
     def sharding_for(self, num_state_qubits: int):
         """NamedSharding for a (2**n,) amplitude array, or None if the
-        register is too small to shard."""
-        if self.num_ranks == 1 or (1 << num_state_qubits) < self.num_ranks:
+        register is too small to shard. The floor is TWO amplitudes per
+        device — the same local_n >= 1 bound the shard_map engines
+        enforce (E_DISTRIB_QUREG_TOO_SMALL): a one-amp-per-device layout
+        buys nothing AND miscompiles under GSPMD on this runtime
+        (measured: the eager all-ones phase on a 3-qubit register over
+        8 devices returned 4x-scaled amplitudes — the seed-red
+        test_tutorial_circuit_exact; jax 0.4.37 XLA-CPU reshape of
+        fully-degenerate shards)."""
+        if (self.num_ranks == 1
+                or (1 << num_state_qubits) < 2 * self.num_ranks):
             return None
         return NamedSharding(self.mesh, P(None, AMP_AXIS))
 

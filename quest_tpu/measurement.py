@@ -252,9 +252,9 @@ def sample(q: Qureg, num_shots: int, key=None) -> jax.Array:
             body = partial(_sample_sharded_body, n=q.num_state_qubits,
                            density=q.is_density, num_shots=num_shots,
                            D=int(mesh.devices.size))
-            run = jax.jit(jax.shard_map(
-                body, mesh=mesh, in_specs=(P(None, AMP_AXIS), P()),
-                out_specs=P()))
+            from quest_tpu import compat
+            run = jax.jit(compat.shard_map(
+                body, mesh, (P(None, AMP_AXIS), P()), P()))
             return run(q.amps, key)
     return _sample_traced(q.amps, key, n=q.num_state_qubits,
                           density=q.is_density, num_shots=num_shots)

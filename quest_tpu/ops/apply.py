@@ -428,17 +428,38 @@ def _f64_chunk_elems() -> int:
     v5e (scripts/probe_f64.py, measured 2026-08-02). Chunking the
     contraction bounds the temps at chunk size; the path is HBM-bound,
     so per-chunk MXU efficiency is unaffected at this granularity.
-    QUEST_F64_CHUNK overrides (elements per chunk, power of two; 0
-    disables chunking); knobs parse loudly per the config convention."""
+    QUEST_F64_CHUNK overrides (elements per chunk; 0 disables chunking);
+    knobs parse loudly per the config convention — non-integers,
+    negatives and non-powers-of-two raise HERE instead of as an opaque
+    reshape error deep inside tracing (_limb_apply_chunked derives its
+    chunk count by exact division; ADVICE r5 item 1)."""
     import os
     v = os.environ.get("QUEST_F64_CHUNK")
     if v is None:
         return 1 << 24
     try:
-        return int(v)
+        c = int(v)
     except ValueError:
         raise ValueError(
             f"QUEST_F64_CHUNK must be an integer element count, got {v!r}")
+    if c < 0 or (c and c & (c - 1)):
+        raise ValueError(
+            f"QUEST_F64_CHUNK must be 0 (chunking off) or a positive "
+            f"power of two (state sizes are powers of two, so any other "
+            f"chunk cannot divide the row axis), got {c}")
+    return c
+
+
+def mode_key():
+    """The apply-level trace-mode flags: everything THIS module reads
+    from the environment at trace time. Any jit cache over functions
+    that trace through ops/apply must carry this key, or flipping
+    QUEST_F64_MXU / QUEST_F64_CHUNK / the matmul precision mid-process
+    returns stale programs (ADVICE r5 item 2: the eager per-gate
+    workers in ops/gates.py had exactly that hole). circuit's
+    _engine_mode_key extends this with planner-level flags."""
+    return (precision.matmul_precision(), _f64_mxu_enabled(),
+            _f64_chunk_elems())
 
 
 def _limb_apply_chunked(gre, gim, re, im, real_only, chunk_elems):

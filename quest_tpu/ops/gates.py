@@ -37,6 +37,13 @@ from quest_tpu.state import Qureg
 
 # ---------------------------------------------------------------------------
 # jitted workers
+#
+# Every worker carries a static `mode` argument fed A.mode_key(): the
+# traced program depends on environment read at TRACE time (matmul
+# precision tier, QUEST_F64_MXU, QUEST_F64_CHUNK), so the jit cache must
+# key on it — without it, flipping a knob mid-process returned the STALE
+# eager program (the cache-key discipline of ADVICE r5 item 2; the
+# compiled-circuit engines carry the same key via _engine_mode_key).
 # ---------------------------------------------------------------------------
 
 
@@ -46,9 +53,9 @@ def _shift(qubits, by):
 
 @partial(jax.jit, static_argnames=(
     "n", "targets", "controls", "cstates", "density", "op_re", "op_im",
-    "diagonal", "dual"))
+    "diagonal", "dual", "mode"))
 def _const_gate_worker(amps, *, n, targets, controls, cstates, density,
-                       op_re, op_im, diagonal, dual):
+                       op_re, op_im, diagonal, dual, mode):
     pair = (np.array(op_re, dtype=np.float64), np.array(op_im, dtype=np.float64))
     fn = A.apply_diagonal if diagonal else A.apply_matrix
     amps = fn(amps, n, pair, targets, controls, cstates)
@@ -60,9 +67,10 @@ def _const_gate_worker(amps, *, n, targets, controls, cstates, density,
 
 
 @partial(jax.jit, static_argnames=(
-    "n", "targets", "controls", "cstates", "density", "builder", "diagonal"))
+    "n", "targets", "controls", "cstates", "density", "builder", "diagonal",
+    "mode"))
 def _dyn_gate_worker(amps, params, *, n, targets, controls, cstates, density,
-                     builder, diagonal):
+                     builder, diagonal, mode):
     if builder is not None:
         pair = builder(*[jnp.asarray(p) for p in params])
     else:
@@ -76,16 +84,17 @@ def _dyn_gate_worker(amps, params, *, n, targets, controls, cstates, density,
     return amps
 
 
-@partial(jax.jit, static_argnames=("n", "targets", "density"))
-def _parity_phase_worker(amps, angle, *, n, targets, density):
+@partial(jax.jit, static_argnames=("n", "targets", "density", "mode"))
+def _parity_phase_worker(amps, angle, *, n, targets, density, mode):
     amps = A.apply_parity_phase(amps, n, targets, angle)
     if density:
         amps = A.apply_parity_phase(amps, n, _shift(targets, n // 2), -angle)
     return amps
 
 
-@partial(jax.jit, static_argnames=("n", "qubits", "density"))
-def _all_ones_phase_worker(amps, term_re, term_im, *, n, qubits, density):
+@partial(jax.jit, static_argnames=("n", "qubits", "density", "mode"))
+def _all_ones_phase_worker(amps, term_re, term_im, *, n, qubits, density,
+                           mode):
     amps = A.apply_phase_on_all_ones(amps, n, qubits, (term_re, term_im))
     if density:
         amps = A.apply_phase_on_all_ones(
@@ -118,17 +127,17 @@ def _run(q: Qureg, op, targets, controls=(), cstates=None, builder=None,
         amps = _const_gate_worker(
             q.amps, n=q.num_state_qubits, targets=targets, controls=controls,
             cstates=cstates, density=q.is_density, op_re=_tt(re),
-            op_im=_tt(im), diagonal=diagonal, dual=dual)
+            op_im=_tt(im), diagonal=diagonal, dual=dual, mode=A.mode_key())
     elif builder is None:
         amps = _dyn_gate_worker(
             q.amps, cplx.pack(op), n=q.num_state_qubits, targets=targets,
             controls=controls, cstates=cstates, density=q.is_density,
-            builder=None, diagonal=diagonal)
+            builder=None, diagonal=diagonal, mode=A.mode_key())
     else:
         amps = _dyn_gate_worker(
             q.amps, op, n=q.num_state_qubits, targets=targets,
             controls=controls, cstates=cstates, density=q.is_density,
-            builder=builder, diagonal=diagonal)
+            builder=builder, diagonal=diagonal, mode=A.mode_key())
     return q.replace_amps(amps)
 
 
@@ -136,7 +145,8 @@ def _phase_all_ones(q: Qureg, qubits, term_re, term_im) -> Qureg:
     amps = _all_ones_phase_worker(
         q.amps, jnp.asarray(term_re, dtype=q.real_dtype),
         jnp.asarray(term_im, dtype=q.real_dtype), n=q.num_state_qubits,
-        qubits=tuple(int(x) for x in qubits), density=q.is_density)
+        qubits=tuple(int(x) for x in qubits), density=q.is_density,
+        mode=A.mode_key())
     return q.replace_amps(amps)
 
 
@@ -343,11 +353,12 @@ def multi_rotate_z(q: Qureg, qubits: Sequence[int], angle) -> Qureg:
     val.validate_multi_targets(q, qubits)
     return q.replace_amps(_parity_phase_worker(
         q.amps, jnp.asarray(float(angle)), n=q.num_state_qubits,
-        targets=tuple(int(x) for x in qubits), density=q.is_density))
+        targets=tuple(int(x) for x in qubits), density=q.is_density,
+        mode=A.mode_key()))
 
 
-@partial(jax.jit, static_argnames=("n", "term", "conj"))
-def _pauli_rot_worker(amps, angle, *, n, term, conj):
+@partial(jax.jit, static_argnames=("n", "term", "conj", "mode"))
+def _pauli_rot_worker(amps, angle, *, n, term, conj, mode):
     """exp(-i angle/2 * P) = cos(angle/2) I - i sin(angle/2) P applied as
     ONE fused pass: the P image is the flip-form apply_pauli_string (no
     basis-rotation passes). conj=True applies the complex conjugate
@@ -386,14 +397,14 @@ def multi_rotate_pauli(q: Qureg, targets: Sequence[int], paulis: Sequence[int],
         return q
     angle = jnp.asarray(float(angle))
     amps = _pauli_rot_worker(q.amps, angle, n=n, term=tuple(term),
-                             conj=False)
+                             conj=False, mode=A.mode_key())
     if q.is_density:
         shift = n // 2
         dual = [0] * n
         for t, p in zip(targets, paulis):
             dual[int(t) + shift] = int(p)
         amps = _pauli_rot_worker(amps, angle, n=n, term=tuple(dual),
-                                 conj=True)
+                                 conj=True, mode=A.mode_key())
     return q.replace_amps(amps)
 
 
@@ -467,11 +478,11 @@ def apply_pauli_prod(q: Qureg, targets: Sequence[int], paulis: Sequence[int]) ->
     if not any(term):
         return q
     return q.replace_amps(_pauli_string_worker(
-        q.amps, n=q.num_state_qubits, term=tuple(term)))
+        q.amps, n=q.num_state_qubits, term=tuple(term), mode=A.mode_key()))
 
 
-@partial(jax.jit, static_argnames=("n", "term"))
-def _pauli_string_worker(amps, *, n, term):
+@partial(jax.jit, static_argnames=("n", "term", "mode"))
+def _pauli_string_worker(amps, *, n, term, mode):
     return A.apply_pauli_string(amps, n, term)
 
 

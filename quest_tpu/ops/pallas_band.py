@@ -66,8 +66,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from quest_tpu import compat
 from quest_tpu import precision
 from quest_tpu.ops import fusion as F
+
+_MEMSPACE, _COMPILER_PARAMS = compat.pallas_tpu_names()
 
 LANE_QUBITS = 7
 LANES = 1 << LANE_QUBITS
@@ -175,6 +178,21 @@ class PairStage:
     real_only: bool
     lane_preds: Tuple[Tuple[int, int], ...]
     row_preds: Tuple[Tuple[int, int], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiPhaseStage:
+    """A scheduler-composed GROUP of unit phases in ONE stage, applied
+    ADDITIVELY: each row contributes an angle (an allones row adds its
+    theta where all masked bits are 1; a parity row adds -half*(-1)^par)
+    and the stage pays cos/sin + one complex multiply ONCE for the whole
+    group — m mask-accumulates instead of m full phase stages (each with
+    its own trig blend), and ONE stage against MAX_SEGMENT_STAGES
+    instead of m. The (m, 8) operand rows are
+    [angle, lane_mask, row_mask_lo, row_mask_hi, 0, 0, 0, 0] (row masks
+    split at bit 15 so each half is exact in f32); `forms` carries the
+    static per-row interpretation: 'a' = allones, 'p' = parity."""
+    forms: Tuple[str, ...]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -339,6 +357,24 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
                       rm & 0x7FFF, rm >> 15, 0, 0, 0]], dtype=np.float32))
                 continue
             if op.kind == "diagonal":
+                parts_rel = getattr(op, "parts", ())
+                if parts_rel:
+                    # scheduler-composed phase group (fusion.ComposedDiag
+                    # with target-relative parts): one additive
+                    # MultiPhaseStage instead of a 2^k select chain
+                    rows, forms = [], []
+                    for form, bits, val in parts_rel:
+                        qs = [targets[b] for b in bits]
+                        lm = sum(1 << q for q in qs if q < LANE_QUBITS)
+                        rm = sum(1 << (q - LANE_QUBITS) for q in qs
+                                 if q >= LANE_QUBITS)
+                        ang = val if form == "allones" else -val / 2.0
+                        rows.append([ang, lm, rm & 0x7FFF, rm >> 15,
+                                     0, 0, 0, 0])
+                        forms.append("a" if form == "allones" else "p")
+                    stages.append(MultiPhaseStage(tuple(forms)))
+                    arrays.append(np.array(rows, dtype=np.float32))
+                    continue
                 d = np.asarray(op.operand, dtype=np.complex128).reshape(-1)
                 lane_p, row_p = _split_preds(
                     tuple(zip(op.controls, op.cstates or
@@ -811,6 +847,34 @@ def _apply_parity_stage(re, im, st: ParityStage, gref, row_ids):
     return nre, nim
 
 
+def _apply_multiphase_stage(re, im, st: MultiPhaseStage, gref, row_ids):
+    # (m, 8) operand rows: [angle, lane_mask, row_mask_lo, row_mask_hi,
+    # 0, 0, 0, 0]; st.forms[r] picks the static interpretation. The
+    # group's total angle accumulates per element, then ONE cos/sin +
+    # complex multiply applies the whole group (vs one trig blend per
+    # phase when each rides its own Phase/ParityStage).
+    g = gref[...]
+    lane = _lane_iota()
+    tot = None
+    for r, form in enumerate(st.forms):
+        ang = g[r, 0]
+        lm = g[r, 1].astype(jnp.int32)
+        rm = _row_halves(g[r, 2], g[r, 3])
+        if form == "a":
+            match = ((lane & lm) == lm) & ((row_ids & rm) == rm)
+            contrib = jnp.where(match, ang, 0.0)
+        else:
+            par = _xor_fold(lane & lm, 4) ^ _xor_fold(row_ids & rm, 16)
+            sign = 1.0 - 2.0 * par.astype(jnp.float32)
+            contrib = ang * sign
+        tot = contrib if tot is None else tot + contrib
+    cosf = jnp.cos(tot)
+    sinf = jnp.sin(tot)
+    nre = re * cosf - im * sinf
+    nim = re * sinf + im * cosf
+    return nre, nim
+
+
 def _bit_of(q, row_ids):
     """(broadcastable) value of bit `q` of each amplitude's global index."""
     if q < LANE_QUBITS:
@@ -941,6 +1005,8 @@ def _apply_stages(re, im, stages, mat_refs, geo: _Geometry, row_ids):
             re, im = _apply_pair_stage(re, im, st, ref, geo, row_ids)
         elif isinstance(st, PhaseStage):
             re, im = _apply_phase_stage(re, im, st, ref, row_ids)
+        elif isinstance(st, MultiPhaseStage):
+            re, im = _apply_multiphase_stage(re, im, st, ref, row_ids)
         elif isinstance(st, DiagVecStage):
             re, im = _apply_diagvec_stage(re, im, st, ref, row_ids)
         else:
@@ -1047,8 +1113,11 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
         get_in(0, 0).start()
 
         def step_body(s, _):
-            slot = jax.lax.rem(s, nbuf)
-            nslot = jax.lax.rem(s + 1, nbuf)
+            # explicit i32 operands: under jax_enable_x64 a Python-int
+            # operand traces as i64, and a mixed-dtype rem fails to
+            # lower (interpret mode) or legalize (Mosaic)
+            slot = jax.lax.rem(s, jnp.int32(nbuf))
+            nslot = jax.lax.rem(s + 1, jnp.int32(nbuf))
 
             @pl.when(s + 1 < steps)
             def _():
@@ -1067,13 +1136,14 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
             re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids)
             scratch[slot] = jnp.stack([re, im]).reshape(block_shape)
             get_out(s, slot).start()
-            return 0
+            return jnp.int32(0)
 
         # int32 bounds pin the loop counter (and everything derived from
         # it in idx_of) to 32 bits: under jax_enable_x64 Python-int
         # bounds trace as int64, which Mosaic cannot lower (the x64 test
         # suite's on-chip smoke run hits exactly this)
-        jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps), step_body, 0)
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps), step_body,
+                          jnp.int32(0))
         for j in range(nbuf):                # drain the tail out-DMAs
             s = steps - nbuf + j
             if s >= 0:
@@ -1196,17 +1266,17 @@ def compile_segment(stages: Sequence, n: int,
             block_shape=block_shape, nbuf=NBUF)
         # the state stays in HBM; the kernel DMAs its own blocks through
         # the in-place slot buffers. Operands are whole-array VMEM.
-        in_specs = [pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)]
+        in_specs = [pl.BlockSpec(memory_space=_MEMSPACE.HBM)]
         for _ in stages:
             in_specs.append(
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM))
+                pl.BlockSpec(memory_space=_MEMSPACE.VMEM))
         fn = pl.pallas_call(
             kernel,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            out_specs=pl.BlockSpec(memory_space=_MEMSPACE.HBM),
             out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
             input_output_aliases={0: 0},  # in-place on the state buffer
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=VMEM_LIMIT_BYTES),
             interpret=interpret,
         )
@@ -1223,6 +1293,9 @@ def compile_segment(stages: Sequence, n: int,
                 d = st.dim
                 in_specs.append(
                     pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
+            elif isinstance(st, MultiPhaseStage):
+                in_specs.append(
+                    pl.BlockSpec((len(st.forms), 8), lambda *ids: (0, 0)))
             elif isinstance(st, DiagVecStage):
                 k = len(st.targets)
                 in_specs.append(
@@ -1237,7 +1310,7 @@ def compile_segment(stages: Sequence, n: int,
             out_specs=pl.BlockSpec(block_shape, index_map),
             out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
             input_output_aliases={0: 0},  # in-place on the state buffer
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=VMEM_LIMIT_BYTES),
             interpret=interpret,
         )
@@ -1250,8 +1323,15 @@ def compile_segment(stages: Sequence, n: int,
         # retile copy per dispatch (the 8 GB HLO temp that OOMed 30q).
         # The kernel is pure f32/int32; trace it with x64 disabled —
         # under jax_enable_x64 stray int64 ops fail Mosaic legalization.
-        with jax.enable_x64(False):
+        # Interpret mode keeps the caller's x64 setting: its emulated
+        # grid loop mixes its own index dtypes with the surrounding
+        # trace, and flipping x64 mid-trace is what breaks it (i32
+        # carry vs i64 bound); there is no Mosaic pass to appease there.
+        if interpret:
             out = fn(amps.reshape(view_shape), *mat_arrays)
+        else:
+            with compat.enable_x64(False):
+                out = fn(amps.reshape(view_shape), *mat_arrays)
         return out.reshape(2, -1, LANES)
 
     return apply

@@ -116,6 +116,9 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
         if bands is None:
             bands = S._shard_bands(n, local_n)
         flat_r = S.engine_flat(ops, n, density, local_n)
+        # engine_flat schedules before relabeling; report the
+        # scheduler's counters alongside the plan it produced
+        rec["scheduler"] = F.schedule_summary(flat, n)
         items = F.plan(flat_r, n, bands=bands)
         rec["local_band_passes"] = sum(
             1 for it in items

@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from quest_tpu import compat
 from quest_tpu import cplx
 from quest_tpu.env import AMP_AXIS
 from quest_tpu import validation as val
@@ -439,13 +440,20 @@ def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
     executed one. relabel=None means on-unless-lazy; requesting both
     strategies explicitly raises."""
     from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
 
     if lazy and relabel:
         raise ValueError("lazy and relabel are mutually exclusive "
                          "relabeling strategies; pick one")
     if relabel is None:
         relabel = not lazy
-    flat = flatten_ops(ops, n, density)
+    # the commutation-aware scheduler runs BEFORE relabel planning: a
+    # reorder changes which qubits co-occur between exchanges, so the
+    # relabel pass must see the order that will actually execute (its
+    # composition-aware A/B guard then accepts or rejects events
+    # against the SCHEDULED list; composed diagonals price at zero
+    # exchange cost — diagonals never communicate at any position)
+    flat = F.maybe_schedule(flatten_ops(ops, n, density), n)
     if lazy:
         from quest_tpu.parallel.relabel import lazy_relabel_ops
         return lazy_relabel_ops(flat, n, local_n)
@@ -551,8 +559,8 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
                                       density=False, op=it.op)
         return chunk
 
-    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
-                            out_specs=P(None, AMP_AXIS))
+    sharded = compat.shard_map(run, mesh, P(None, AMP_AXIS),
+                               P(None, AMP_AXIS))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
@@ -683,8 +691,8 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
 
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, and every value here is explicitly per-device anyway
-    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
-                            out_specs=P(None, AMP_AXIS), check_vma=False)
+    sharded = compat.shard_map(run, mesh, P(None, AMP_AXIS),
+                               P(None, AMP_AXIS), check_vma=False)
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
@@ -740,8 +748,8 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
                                   density=density, op=op)
         return chunk
 
-    sharded = jax.shard_map(run, mesh=mesh, in_specs=P(None, AMP_AXIS),
-                            out_specs=P(None, AMP_AXIS))
+    sharded = compat.shard_map(run, mesh, P(None, AMP_AXIS),
+                               P(None, AMP_AXIS))
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
@@ -851,6 +859,12 @@ def plan_measured_program(flat: Sequence, n: int, local_n: int,
     def close_stretch(stretch):
         if not stretch:
             return
+        if engine != "xla":
+            # per-stretch scheduling: each measurement-free stretch is a
+            # static sub-schedule, reordered/composed before its relabel
+            # pass exactly like the static engines (barriers themselves
+            # never move — the stretch split happens first)
+            stretch = F.maybe_schedule(stretch, n)
         if relabel:
             from quest_tpu.parallel.relabel import plan_full_relabels
             stretch = plan_full_relabels(stretch, n, local_n)
@@ -998,10 +1012,10 @@ def compile_circuit_sharded_measured(ops: Sequence, n: int, density: bool,
                                              local_n=local_n, it=it)
         return chunk, jnp.stack(outs)
 
-    sharded = jax.shard_map(run, mesh=mesh,
-                            in_specs=(P(None, AMP_AXIS), P()),
-                            out_specs=(P(None, AMP_AXIS), P()),
-                            check_vma=engine != "fused")
+    sharded = compat.shard_map(run, mesh,
+                               (P(None, AMP_AXIS), P()),
+                               (P(None, AMP_AXIS), P()),
+                               check_vma=engine != "fused")
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

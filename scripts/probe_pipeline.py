@@ -48,9 +48,10 @@ if mode != "alias":
         if mode == "noalias":
             kw.pop("input_output_aliases", None)
         elif mode == "parallel":
-            from jax.experimental.pallas import tpu as pltpu
+            from quest_tpu import compat
             grid = kw.get("grid")
-            kw["compiler_params"] = pltpu.CompilerParams(
+            _, params_cls = compat.pallas_tpu_names()
+            kw["compiler_params"] = params_cls(
                 vmem_limit_bytes=PB.VMEM_LIMIT_BYTES,
                 dimension_semantics=("parallel",) * len(grid))
         return real_call(kernel, **kw)

@@ -65,9 +65,9 @@ for shard in out.addressable_shards:
 def _norm(chunk):
     return lax.psum(jnp.sum(chunk * chunk), AMP_AXIS)
 
-total = jax.jit(jax.shard_map(_norm, mesh=mesh,
-                              in_specs=P(None, AMP_AXIS),
-                              out_specs=P()))(out)
+from quest_tpu import compat
+total = jax.jit(compat.shard_map(_norm, mesh,
+                                 P(None, AMP_AXIS), P()))(out)
 total = float(jax.device_get(total))
 assert abs(total - 1.0) < 1e-5, total
 

@@ -18,6 +18,17 @@ from quest_tpu.circuit import Circuit, qft_circuit, random_circuit
 from quest_tpu.parallel import make_amp_mesh, shard_qureg
 from quest_tpu.state import to_dense
 
+# slow-marked as a MODULE: ~75 s of virtual-mesh execution that pushed
+# the tier-1 budget run past its 870 s timeout once the jax-0.4.37
+# shard_map shim (quest_tpu/compat.py) turned this suite green (it was
+# 100% red at seed on the missing API). Run explicitly (-m slow or no
+# marker filter) for the full mpirun-np-8 analogue; the budget run keeps
+# sharded coverage via tests/test_scheduler.py (scheduled sharded
+# banded+fused fuzz), tests/test_fuzz.py::test_fuzz_sharded_engines,
+# tests/test_f64_limb.py::test_sharded_banded_f64_limb and
+# tests/test_lazy_relabel.py.
+pytestmark = pytest.mark.slow
+
 from . import oracle
 from .helpers import max_mesh_devices
 

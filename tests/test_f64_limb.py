@@ -131,9 +131,20 @@ def test_chunked_limb_matches_unchunked(force_limb, monkeypatch):
     chip (scripts/probe_f64.py 2026-08-02). Forcing a tiny
     QUEST_F64_CHUNK triggers the path at test size; both chunk axes
     (low band: pre chunks; top band, pre == 1: post chunks) and both
-    operator classes (complex Gauss, real-only) must match the
-    un-chunked result exactly — identical per-element op order, just
-    bounded batches."""
+    operator classes (complex Gauss, real-only) must agree with the
+    un-chunked result to f64 REAL_EPS relative to the state scale.
+
+    Why a bound and not bit-equality: the chunked program IS the same
+    per-element arithmetic — calling _limb_band_contract on each chunk
+    by hand reproduces the un-chunked output bit-for-bit (the limb
+    pair-dots are exact integers, chunking cannot touch them). The
+    residual difference comes from XLA scheduling the final f64 stages
+    (the 6-term limb combine and the Gauss t3-t1-t2 subtraction)
+    differently inside the lax.map scan body than in the straight-line
+    program — fma contraction / reassociation a caller cannot pin from
+    the jaxpr level. Measured 7e-18 absolute (5e-16 of the state max)
+    at this size; the 1e-13 REAL_EPS class bound used by the other
+    limb tests leaves two orders of margin."""
     n = 12
     rng = np.random.default_rng(5)
     gc = np.linalg.qr(rng.normal(size=(8, 8))
@@ -151,7 +162,8 @@ def test_chunked_limb_matches_unchunked(force_limb, monkeypatch):
             got = np.asarray(apply_band(jnp.asarray(amps), n, pair,
                                         ql=ql, w=3))
             monkeypatch.delenv("QUEST_F64_CHUNK")
-            np.testing.assert_array_equal(got, base)
+            tol = 1e-13 * np.abs(base).max()
+            np.testing.assert_allclose(got, base, atol=tol, rtol=0)
 
 
 def test_chunk_knob_in_cache_key(force_limb, monkeypatch):

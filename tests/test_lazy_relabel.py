@@ -243,9 +243,10 @@ def test_relabel_op_matches_bit_swap_oracle(mesh):
     full = rng.standard_normal((2, 1 << n)).astype(np.float32)
     slots = tuple(int(s) for s in rng.permutation(local_n)[:g])
 
-    fn = jax.jit(jax.shard_map(
+    from quest_tpu import compat
+    fn = jax.jit(compat.shard_map(
         lambda c: _relabel_op(c, local_n=local_n, slots=slots),
-        mesh=mesh, in_specs=P(None, AMP_AXIS), out_specs=P(None, AMP_AXIS)))
+        mesh, P(None, AMP_AXIS), P(None, AMP_AXIS)))
     arr = jax.device_put(jnp.asarray(full),
                          NamedSharding(mesh, P(None, AMP_AXIS)))
     got = np.asarray(fn(arr))

@@ -503,8 +503,11 @@ def test_explain_reports_schedule_without_compiling():
     if H._load() is not None:
         assert "cpu fallback host engine:" in text
 
+    # the scheduler composes QFT-12's cross-band phases into ONE
+    # segment (was >= 2 pre-scheduler); its stats line rides along
     qft_text = qft_circuit(12).explain()
-    assert qft_text.count("kernel segment") >= 2
+    assert qft_text.count("kernel segment") >= 1
+    assert "scheduler: on" in qft_text and "multiphase" in qft_text
 
     small = Circuit(6)
     small.h(0)

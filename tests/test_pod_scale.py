@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r'''
@@ -79,12 +81,18 @@ print(json.dumps(out))
 '''
 
 
+@pytest.mark.slow
 def test_40q_class_fused_relabel_schedule():
     """The layer-amortized relabel pass on the 40q-class fused schedule
     (36q/64dev CI stand-in; the real 40q/256 lowering measured r4:
     95 whole-chunk exchanges / 3.26 TB -> 14 all-to-alls / 0.48 TB per
     device, an 85.3%% ICI-byte cut). Pinned loosely: well under the
-    VERDICT-r3 targets of <=65 exchanges and >=25%% byte cut."""
+    VERDICT-r3 targets of <=65 exchanges and >=25%% byte cut.
+
+    slow-marked: lowering the two depth-20 36q/64-device interpret
+    programs takes ~3 min on the CI host — outside the tier-1 time
+    budget (the lighter depth-2 lowering above keeps the 40q-class
+    path covered there)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
