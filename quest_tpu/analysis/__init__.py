@@ -1,0 +1,16 @@
+"""Project-invariant static analysis + runtime audit harness.
+
+quest-lint (``quest_tpu.analysis.lint``) enforces the compiled-path
+invariants that code review kept re-finding by hand (QL001-QL004:
+cache-key completeness, i32 kernel hygiene, tracer leaks, loud knob
+parsing); the audit harness (``quest_tpu.analysis.audit``) checks the
+dynamic halves — zero unexpected retraces over a golden circuit set and
+actual cache misses when a registered knob flips.
+
+CLI: ``python -m quest_tpu.analysis [paths ...]`` (defaults to the
+repository's quest_tpu/, scripts/ and tests/; exits non-zero on any
+violation). Tier-1 enforcement lives in tests/test_lint.py; the rule
+catalog with per-rule motivating bugs is docs/ANALYSIS.md.
+"""
+
+from quest_tpu.analysis.lint import RULES, Violation, run_lint  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m quest_tpu.analysis`` — run quest-lint from the shell."""
+
+import sys
+
+from quest_tpu.analysis.cli import main
+
+sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,65 @@
+"""Command-line front-end for quest-lint (python -m quest_tpu.analysis)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import List, Optional, Sequence
+
+from quest_tpu.analysis.lint import RULES, run_lint
+
+
+def _default_paths() -> List[str]:
+    """quest_tpu/, scripts/ and tests/ of the repository containing the
+    installed package (the layout the tier-1 test lints)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg)
+    out = [pkg]
+    for extra in ("scripts", "tests"):
+        p = os.path.join(repo, extra)
+        if os.path.isdir(p):
+            out.append(p)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m quest_tpu.analysis",
+        description="quest-lint: static analyzer for quest_tpu's "
+                    "compiled-path invariants (QL001-QL004; "
+                    "docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint (default: the "
+                         "repo's quest_tpu/, scripts/ and tests/)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset, e.g. QL001,QL004")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in sorted(RULES.items()):
+            print(f"{rule}  {doc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rule(s): {unknown}; known: {sorted(RULES)}")
+
+    paths = list(args.paths) or _default_paths()
+    violations = run_lint(paths, rules=rules)
+
+    if args.format == "json":
+        print(json.dumps([vars(v) for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v.render(root=os.getcwd()))
+        n = len(violations)
+        print(f"quest-lint: {n} violation{'s' if n != 1 else ''} in "
+              f"{len(paths)} path(s)")
+    return 1 if violations else 0

@@ -1,0 +1,814 @@
+"""quest-lint: AST static analyzer for quest_tpu's compiled-path invariants.
+
+The dominant bug classes here are mechanical, not algorithmic (PR-1
+post-mortem, ADVICE r4/r5): an env knob read at trace time but missing
+from the compiled-program cache key returns STALE programs when the knob
+flips; a Python int leaking into Pallas index math traces as i64 under
+x64 and fails Mosaic legalization; a host conversion (float()/np.asarray)
+on a tracer aborts tracing with an opaque error far from the cause. QuEST
+itself ships validation as a first-class layer (QuEST_validation.c); this
+module is the JAX/Pallas equivalent, enforced by tooling instead of
+reviewer memory.
+
+Rules (each suppressible per line with `# quest-lint: disable=RULE` or
+per file with `# quest-lint: disable-file=RULE`):
+
+  QL001  cache-key completeness — an environment knob read reachable
+         from a jitted / fused / Pallas path must be registered in
+         env.KNOBS as scope 'keyed' (threaded into engine_mode_key(),
+         hence into every compiled cache key and the eager workers'
+         static `mode` argument) or 'import_once' (resolved once per
+         process, stale-proof by construction).
+  QL002  i32 kernel hygiene — inside Pallas kernels, iota/arange must
+         pin an i32 dtype and index arithmetic must not name i64
+         dtypes or feed bare Python-int bounds to fori_loop: Python
+         ints trace as i64 under x64 and break Mosaic legalization.
+  QL003  tracer leaks — no float()/int()/bool()/complex()/.item()/
+         np.asarray()/np.array() on tracer-typed values in
+         jit-reachable code.
+  QL004  knobs parse loudly — every QUEST_* read in package code
+         routes through env.knob_value()'s validating parser, and
+         every QUEST_* name read anywhere is registered in env.KNOBS.
+
+The jit-reachability analysis is a conservative intra-package call
+graph: roots are functions decorated with jax.jit (directly or through
+functools.partial), functions passed to jax.jit(...) / shard_map(...) /
+pl.pallas_call(...), and callables handed to the lax control-flow
+primitives; edges follow plain calls, module-attribute calls through
+import aliases, and locally defined closures. Pallas-kernel reachability
+is the same propagation seeded only from pallas_call operands.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES = {
+    "QL001": "cache-key completeness: compiled-path knob reads must be "
+             "registered as keyed/import_once in env.KNOBS",
+    "QL002": "i32 kernel hygiene: Pallas index math must pin i32 dtypes",
+    "QL003": "tracer leaks: no host conversions on traced values in "
+             "jit-reachable code",
+    "QL004": "knobs parse loudly: QUEST_* reads route through the "
+             "registry's validating parser",
+}
+
+_DISABLE_MARK = "quest-lint:"
+
+# jnp/np spellings accepted as an explicit 32-bit (or narrower) index dtype
+_I32_NAMES = {"int32", "uint32", "int16", "int8", "i32"}
+_I64_NAMES = {"int64", "uint64", "i64"}
+
+# lax control-flow / mapping primitives whose callable arguments are
+# traced: a function handed to one of these inherits jit-reachability
+_HOF_NAMES = {"map", "scan", "fori_loop", "while_loop", "cond", "switch",
+              "vmap", "pmap", "checkpoint", "remat", "custom_jvp",
+              "custom_vjp", "run_scoped", "associative_scan"}
+
+# conversions that force a traced value onto the host (QL003)
+_CONVERSIONS = {"float", "int", "bool", "complex"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self, root: Optional[str] = None) -> str:
+        path = os.path.relpath(self.path, root) if root else self.path
+        return f"{path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# per-file model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _EnvRead:
+    name: str               # the QUEST_* (or other) variable name
+    line: int
+    col: int
+    func: Optional[str]     # enclosing function qualname (None: module scope)
+    via_registry: bool      # knob_value()/knob_current() vs raw os.environ
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    qualname: str
+    line: int
+    static_params: Set[str] = dataclasses.field(default_factory=set)
+    params: List[str] = dataclasses.field(default_factory=list)
+    calls: List[Tuple[Optional[str], str]] = dataclasses.field(
+        default_factory=list)          # (module or None=local, name)
+    jit_root: bool = False
+    kernel_root: bool = False
+    parent: Optional[str] = None       # enclosing function qualname
+    # names with positive evidence of being tracers: assigned from a
+    # jnp/lax call, or non-static parameters of a jit-root function
+    traced_names: Set[str] = dataclasses.field(default_factory=set)
+
+
+class _FileModel:
+    def __init__(self, path: str, module: Optional[str], tree: ast.Module,
+                 source: str):
+        self.path = path
+        self.module = module            # dotted name for package files
+        self.tree = tree
+        self.source = source
+        self.import_alias: Dict[str, str] = {}   # local alias -> module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name->(mod,orig)
+        self.funcs: Dict[str, _FuncInfo] = {}
+        self.env_reads: List[_EnvRead] = []
+        # cross-module callable operands of jit/pallas_call/HOFs:
+        # ((module, name), is_kernel) — resolved into extra roots during
+        # propagation
+        self.foreign_roots: List[Tuple[Tuple[str, str], bool]] = []
+        # (line, col, func, node) index of interesting calls for QL002/3
+        self.conversion_sites: List[Tuple[ast.AST, Optional[str]]] = []
+        self.kernel_sites: List[Tuple[ast.AST, Optional[str]]] = []
+        self.uses_pallas = "pallas" in source
+        self.suppressed_lines: Dict[int, Set[str]] = {}
+        self.suppressed_file: Set[str] = set()
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                text = tok.string.lstrip("#").strip()
+                if not text.startswith(_DISABLE_MARK):
+                    continue
+                body = text[len(_DISABLE_MARK):].strip()
+                if body.startswith("disable-file="):
+                    rules = body[len("disable-file="):]
+                    self.suppressed_file.update(
+                        r.strip() for r in rules.split(",") if r.strip())
+                elif body.startswith("disable="):
+                    rules = body[len("disable="):]
+                    self.suppressed_lines.setdefault(
+                        tok.start[0], set()).update(
+                        r.strip() for r in rules.split(",") if r.strip())
+        except tokenize.TokenError:        # pragma: no cover - parse guard
+            pass
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.suppressed_file:
+            return True
+        return rule in self.suppressed_lines.get(line, set())
+
+
+def _module_name_for(path: str, root: str) -> Optional[str]:
+    """Dotted module name for files under the quest_tpu package, None
+    for scripts/tests (they are linted but excluded from the package
+    call graph)."""
+    rel = os.path.relpath(path, root)
+    parts = rel.split(os.sep)
+    if "quest_tpu" in parts:
+        parts = parts[parts.index("quest_tpu"):]
+        if parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][:-3]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# AST visitors
+# ---------------------------------------------------------------------------
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    """functools.partial(f, ...) -> f (for jit decorators and
+    pallas_call kernels assembled through partial)."""
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func) or ""
+        if dotted.split(".")[-1] == "partial" and node.args:
+            return _unwrap_partial(node.args[0])
+    return node
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    dotted = _dotted(node) or ""
+    return dotted.split(".")[-1] == "jit"
+
+
+def _static_names_from_jit(call: ast.Call) -> Set[str]:
+    """static_argnames of a (possibly partial-wrapped) jax.jit call."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            val = kw.value
+            elems = val.elts if isinstance(val, (ast.Tuple, ast.List)) \
+                else [val]
+            for e in elems:
+                s = _const_str(e)
+                if s:
+                    out.add(s)
+    return out
+
+
+class _Collector(ast.NodeVisitor):
+    """One pass over a file: functions, call edges, env reads, and the
+    QL002/QL003 site indexes."""
+
+    def __init__(self, model: _FileModel):
+        self.m = model
+        self.stack: List[str] = []      # function qualname stack
+
+    # -- imports ----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.m.import_alias[alias.asname or alias.name.split(".")[0]] = \
+                alias.name
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            for alias in node.names:
+                local = alias.asname or alias.name
+                # `from quest_tpu.ops import apply as A` binds a MODULE
+                # alias; `from quest_tpu.env import knob_value` binds a
+                # function. Record both ways; resolution tries module
+                # first, then (module, original-name).
+                self.m.import_alias[local] = f"{node.module}.{alias.name}"
+                self.m.from_imports[local] = (node.module, alias.name)
+        self.generic_visit(node)
+
+    # -- functions --------------------------------------------------------
+    def _handle_func(self, node) -> None:
+        qual = ".".join(self.stack + [node.name]) if self.stack else node.name
+        info = _FuncInfo(qualname=qual, line=node.lineno,
+                         parent=self.stack[-1] if self.stack else None)
+        a = node.args
+        info.params = [x.arg for x in
+                       (list(getattr(a, "posonlyargs", [])) + list(a.args)
+                        + list(a.kwonlyargs))]
+        for dec in node.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):
+                inner = _unwrap_partial(dec)
+                if inner is not dec and _is_jit_expr(inner):
+                    info.jit_root = True
+                    info.static_params |= _static_names_from_jit(dec)
+                    continue
+                target = dec.func
+            if _is_jit_expr(target):
+                info.jit_root = True
+                if isinstance(dec, ast.Call):
+                    info.static_params |= _static_names_from_jit(dec)
+        if info.jit_root:
+            info.traced_names |= set(info.params) - info.static_params
+        self.m.funcs[qual] = info
+        self.stack.append(qual)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _handle_func
+    visit_AsyncFunctionDef = _handle_func
+
+    # -- calls ------------------------------------------------------------
+    def _resolve_local(self, name: str) -> Optional[_FuncInfo]:
+        """Function bound to a local bare name: innermost enclosing
+        scope's nested defs first, then module scope."""
+        scope = self.stack[-1] if self.stack else None
+        while scope:
+            f = self.m.funcs.get(scope + "." + name)
+            if f:
+                return f
+            scope = self.m.funcs[scope].parent \
+                if scope in self.m.funcs else None
+        return self.m.funcs.get(name)
+
+    def _record_callable_ref(self, node: ast.AST, kernel: bool = False):
+        """A value used as a callable operand (jit(f), pallas_call(k),
+        shard_map(f), a lax HOF body): the target is TRACED regardless
+        of whether the constructing function ever runs under jit, so
+        mark it a root directly; also record a call edge so closures
+        over kernel-reachable scopes propagate."""
+        node = _unwrap_partial(node)
+        name = _dotted(node)
+        if not name:
+            return
+        cur = self.stack[-1] if self.stack else None
+        head = name.split(".")[0]
+        if head in self.m.import_alias and "." in name:
+            tgt = (self.m.import_alias[head], name.split(".", 1)[1])
+        elif name in self.m.from_imports:
+            tgt = self.m.from_imports[name]
+        else:
+            tgt = (None, name)
+        if tgt[0] is None:
+            f = self._resolve_local(tgt[1])
+            if f is not None:
+                if kernel:
+                    f.kernel_root = True
+                else:
+                    f.jit_root = True
+        else:
+            # cross-module operand: recorded for the propagation pass
+            self.m.foreign_roots.append((tgt, kernel))
+        if cur:
+            self.m.funcs[cur].calls.append(tgt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        cur = self.stack[-1] if self.stack else None
+        dotted = _dotted(node.func) or ""
+        leaf = dotted.split(".")[-1]
+
+        # env reads: os.environ.get / os.getenv / knob_value / knob_current
+        if dotted in ("os.environ.get", "environ.get", "os.getenv",
+                      "getenv"):
+            var = _const_str(node.args[0]) if node.args else None
+            if var:
+                self.m.env_reads.append(_EnvRead(
+                    var, node.lineno, node.col_offset, cur, False))
+        elif leaf in ("knob_value", "knob_current"):
+            var = _const_str(node.args[0]) if node.args else None
+            if var:
+                self.m.env_reads.append(_EnvRead(
+                    var, node.lineno, node.col_offset, cur, True))
+
+        # jit roots by expression: jax.jit(f), shard_map(f, ...). lax
+        # HOFs trace their bodies even outside jit, so those are roots
+        # too — but only when the call is module-qualified or resolves
+        # to a jax import (the BUILTIN map() must not root host code).
+        if leaf == "jit" and node.args:
+            self._record_callable_ref(node.args[0])
+        elif leaf == "shard_map" and node.args:
+            self._record_callable_ref(node.args[0])
+        elif leaf == "pallas_call" and node.args:
+            self._record_callable_ref(node.args[0], kernel=True)
+        elif leaf in _HOF_NAMES and node.args:
+            from_jax = ("." in dotted) or (
+                dotted in self.m.from_imports
+                and self.m.from_imports[dotted][0].startswith("jax"))
+            if from_jax:
+                # first callable-looking positional arg is the body
+                for a in node.args:
+                    inner = _unwrap_partial(a)
+                    if _dotted(inner):
+                        self._record_callable_ref(a)
+                        break
+
+        # ordinary call edge
+        if cur and dotted:
+            head = dotted.split(".")[0]
+            if "." in dotted and head in self.m.import_alias:
+                self.m.funcs[cur].calls.append(
+                    (self.m.import_alias[head], dotted.split(".", 1)[1]))
+            elif "." not in dotted:
+                if dotted in self.m.from_imports:
+                    self.m.funcs[cur].calls.append(
+                        self.m.from_imports[dotted])
+                else:
+                    self.m.funcs[cur].calls.append((None, dotted))
+            elif dotted.startswith("self."):
+                self.m.funcs[cur].calls.append(
+                    (None, dotted.split(".", 1)[1]))
+
+        # QL003 conversion sites
+        if (leaf in _CONVERSIONS and not dotted.count(".")) \
+                or leaf == "item" \
+                or dotted in ("np.asarray", "np.array", "numpy.asarray",
+                              "numpy.array", "onp.asarray", "onp.array"):
+            self.m.conversion_sites.append((node, cur))
+
+        # QL002 kernel dtype sites
+        if leaf in ("arange", "iota", "broadcasted_iota", "fori_loop",
+                    "astype") or leaf in _I64_NAMES:
+            self.m.kernel_sites.append((node, cur))
+
+        self.generic_visit(node)
+
+    def _jax_numeric_call(self, node: ast.AST) -> bool:
+        """Whether `node` is a call into jax/jnp/lax (its result is a
+        traced array whenever the function runs under a trace)."""
+        if not isinstance(node, ast.Call):
+            return False
+        dotted = _dotted(node.func) or ""
+        head = dotted.split(".")[0]
+        mod = self.m.import_alias.get(head, head)
+        return mod.split(".")[0] == "jax"
+
+    def _handle_assign_value(self, targets, value) -> None:
+        if not self.stack or not self._jax_numeric_call(value):
+            return
+        f = self.m.funcs[self.stack[-1]]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    f.traced_names.add(e.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._handle_assign_value(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._handle_assign_value([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # os.environ["X"] reads (Load context only; stores are writes)
+        if isinstance(node.ctx, ast.Load):
+            dotted = _dotted(node.value) or ""
+            if dotted in ("os.environ", "environ"):
+                var = _const_str(node.slice)
+                if var:
+                    cur = self.stack[-1] if self.stack else None
+                    self.m.env_reads.append(_EnvRead(
+                        var, node.lineno, node.col_offset, cur, False))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# reachability
+# ---------------------------------------------------------------------------
+
+
+def _propagate(models: Dict[str, _FileModel], attr: str) -> Set[Tuple[str, str]]:
+    """Fixed-point propagation of a root flag ('jit_root'/'kernel_root')
+    through the call graph. Returns {(module, qualname)} reachable."""
+    # index: (module, bare name) -> [(module, qualname)]
+    by_name: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for mod, m in models.items():
+        for qual in m.funcs:
+            bare = qual.split(".")[-1]
+            by_name.setdefault((mod, bare), []).append((mod, qual))
+
+    reached: Set[Tuple[str, str]] = set()
+    work: List[Tuple[str, str]] = []
+    for mod, m in models.items():
+        for qual, f in m.funcs.items():
+            if getattr(f, attr):
+                reached.add((mod, qual))
+                work.append((mod, qual))
+        for (tmod, tname), is_kernel in m.foreign_roots:
+            if is_kernel != (attr == "kernel_root"):
+                continue
+            for hit in by_name.get((tmod, tname.split(".")[-1]), []):
+                if hit not in reached:
+                    reached.add(hit)
+                    work.append(hit)
+
+    def resolve(src_mod: str, src_qual: str,
+                tgt: Tuple[Optional[str], str]) -> List[Tuple[str, str]]:
+        tmod, tname = tgt
+        if tmod is not None:
+            # exact module match, else (from-import of a function) the
+            # module itself may be the function's home
+            hits = by_name.get((tmod, tname.split(".")[-1]), [])
+            if hits:
+                return hits
+            # `from quest_tpu.ops import apply as A` + A.foo: tmod is
+            # quest_tpu.ops.apply already handled; `from quest_tpu import
+            # env` + env.knob_value: same shape. Nothing else to try.
+            return []
+        # local: innermost enclosing scope first, then module scope
+        m = models[src_mod]
+        scope = src_qual
+        while scope:
+            qual = scope + "." + tname
+            if qual in m.funcs:
+                return [(src_mod, qual)]
+            scope = m.funcs[scope].parent if scope in m.funcs else None
+        if tname in m.funcs:
+            return [(src_mod, tname)]
+        # method call on self/instance: any class method with that name
+        hits = [h for h in by_name.get((src_mod, tname.split(".")[-1]), [])
+                if "." in h[1]]
+        return hits
+
+    while work:
+        mod, qual = work.pop()
+        f = models[mod].funcs[qual]
+        for tgt in f.calls:
+            for hit in resolve(mod, qual, tgt):
+                if hit not in reached:
+                    reached.add(hit)
+                    work.append(hit)
+        # nested defs referenced by bare name are resolved through
+        # `calls` already (closures are invoked or passed to HOFs)
+    return reached
+
+
+def _enclosing_chain(m: _FileModel, qual: Optional[str]) -> List[str]:
+    out = []
+    while qual:
+        out.append(qual)
+        qual = m.funcs[qual].parent if qual in m.funcs else None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _knob_registry():
+    from quest_tpu.env import KNOBS
+    return KNOBS
+
+
+def _is_i32_dtype_node(node: ast.AST) -> bool:
+    dotted = _dotted(node) or _const_str(node) or ""
+    return dotted.split(".")[-1] in _I32_NAMES
+
+
+def _check_ql001(models: Dict[str, _FileModel],
+                 reach: Set[Tuple[str, str]],
+                 out: List[Violation]) -> None:
+    knobs = _knob_registry()
+    for mod, m in models.items():
+        if m.module is None:
+            continue                      # scripts/tests are driver code
+        for r in m.env_reads:
+            if not r.name.lstrip("_").startswith("QUEST_"):
+                continue
+            if r.func is None:
+                continue                  # import-time read: stale-proof
+            chain = _enclosing_chain(m, r.func)
+            if not any((mod, q) in reach for q in chain):
+                continue
+            k = knobs.get(r.name)
+            if k is None or k.scope not in ("keyed", "import_once"):
+                scope = "unregistered" if k is None else f"scope={k.scope!r}"
+                out.append(Violation(
+                    "QL001", m.path, r.line, r.col,
+                    f"knob {r.name} is read on a jit/Pallas-reachable "
+                    f"path but is {scope} in env.KNOBS: register it as "
+                    f"scope='keyed' (threads it into engine_mode_key() "
+                    f"and every compiled cache key) or 'import_once', "
+                    f"or the compiled caches go stale when it flips"))
+
+
+def _check_ql002(models: Dict[str, _FileModel],
+                 kreach: Set[Tuple[str, str]],
+                 out: List[Violation]) -> None:
+    for mod, m in models.items():
+        if not m.uses_pallas:
+            continue
+        for node, func in m.kernel_sites:
+            chain = _enclosing_chain(m, func)
+            key_mod = mod if m.module else m.path
+            if not any((key_mod, q) in kreach for q in chain):
+                continue
+            dotted = _dotted(node.func) or ""
+            leaf = dotted.split(".")[-1]
+            if leaf in ("iota", "broadcasted_iota"):
+                dtype = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg in ("dtype",):
+                        dtype = kw.value
+                if dtype is None or not _is_i32_dtype_node(dtype):
+                    out.append(Violation(
+                        "QL002", m.path, node.lineno, node.col_offset,
+                        f"{leaf} inside a Pallas kernel must pin an i32 "
+                        f"dtype (jnp.int32): wider index dtypes trace as "
+                        f"i64 under x64 and fail Mosaic legalization"))
+            elif leaf == "arange":
+                dtype = None
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype = kw.value
+                if dtype is None or (not _is_i32_dtype_node(dtype)
+                                     and _dotted(dtype) is not None
+                                     and _dotted(dtype).split(".")[-1]
+                                     in _I64_NAMES):
+                    out.append(Violation(
+                        "QL002", m.path, node.lineno, node.col_offset,
+                        "jnp.arange inside a Pallas kernel must pass an "
+                        "explicit non-i64 dtype (index math: jnp.int32) — "
+                        "the default promotes to i64 under x64"))
+            elif leaf == "astype":
+                if node.args and _dotted(node.args[0]) and \
+                        _dotted(node.args[0]).split(".")[-1] in _I64_NAMES:
+                    out.append(Violation(
+                        "QL002", m.path, node.lineno, node.col_offset,
+                        "astype(i64) inside a Pallas kernel: Mosaic "
+                        "cannot lower 64-bit index math; use jnp.int32"))
+            elif leaf in _I64_NAMES:
+                out.append(Violation(
+                    "QL002", m.path, node.lineno, node.col_offset,
+                    f"{leaf} constructor inside a Pallas kernel: Mosaic "
+                    f"cannot lower 64-bit index math; use jnp.int32"))
+            elif leaf == "fori_loop":
+                for bound in node.args[:2]:
+                    if isinstance(bound, ast.Constant) \
+                            and isinstance(bound.value, int):
+                        out.append(Violation(
+                            "QL002", m.path, node.lineno, node.col_offset,
+                            "fori_loop bound is a bare Python int inside "
+                            "a Pallas kernel: it traces as i64 under x64 "
+                            "(pin with jnp.int32(...) so the carry stays "
+                            "32-bit)"))
+                        break
+
+
+def _check_ql003(models: Dict[str, _FileModel],
+                 reach: Set[Tuple[str, str]],
+                 out: List[Violation]) -> None:
+    """Tracer leaks need POSITIVE evidence of tracedness: the operand is
+    a non-static parameter of a jit-rooted function, a name assigned
+    from a jnp/lax call, or such a call inline. Trace-time host math on
+    concrete operands (baking a named gate's numpy matrix into the
+    program, normalizing static target tuples) is a deliberate idiom
+    here and must not be flagged."""
+    for mod, m in models.items():
+        if m.module is None:
+            continue
+        for node, func in m.conversion_sites:
+            chain = _enclosing_chain(m, func)
+            if not any((mod, q) in reach for q in chain):
+                continue
+            f = m.funcs.get(func) if func else None
+            dotted = _dotted(node.func) or ""
+            leaf = dotted.split(".")[-1]
+            if leaf == "item":
+                recv = node.func.value if isinstance(node.func,
+                                                     ast.Attribute) else None
+                if recv is not None and _traced_evidence(recv, f, m):
+                    out.append(Violation(
+                        "QL003", m.path, node.lineno, node.col_offset,
+                        ".item() on a traced value in jit-reachable code "
+                        "forces it onto the host and aborts tracing; keep "
+                        "the value on-device or hoist the read out of the "
+                        "compiled path"))
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not _traced_evidence(arg, f, m):
+                continue
+            if leaf in _CONVERSIONS:
+                out.append(Violation(
+                    "QL003", m.path, node.lineno, node.col_offset,
+                    f"{leaf}() on a traced value in jit-reachable code "
+                    f"aborts tracing at run time (ConcretizationTypeError "
+                    f"far from the cause); convert outside the compiled "
+                    f"path or mark the argument static"))
+            else:
+                out.append(Violation(
+                    "QL003", m.path, node.lineno, node.col_offset,
+                    f"{dotted}() materializes a traced value on the host; "
+                    f"inside jit-reachable code that is a tracer leak — "
+                    f"use the jnp equivalent or hoist it out"))
+
+
+def _traced_evidence(arg: ast.AST, f: Optional[_FuncInfo],
+                     m: _FileModel) -> bool:
+    """Whether the expression demonstrably involves a traced value."""
+    if isinstance(arg, ast.Name):
+        return bool(f and arg.id in f.traced_names)
+    if isinstance(arg, (ast.Attribute, ast.Subscript)):
+        # x[0] / x.real of a traced x — but x.shape[i] etc. are static
+        base = arg
+        while isinstance(base, (ast.Attribute, ast.Subscript)):
+            if isinstance(base, ast.Attribute) and base.attr in (
+                    "shape", "ndim", "size", "dtype"):
+                return False
+            base = base.value
+        return _traced_evidence(base, f, m)
+    if isinstance(arg, ast.Call):
+        dotted = _dotted(arg.func) or ""
+        head = dotted.split(".")[0]
+        mod = m.import_alias.get(head, head)
+        if mod.split(".")[0] == "jax":
+            return True
+        return any(_traced_evidence(a, f, m) for a in arg.args)
+    if isinstance(arg, ast.BinOp):
+        return _traced_evidence(arg.left, f, m) \
+            or _traced_evidence(arg.right, f, m)
+    if isinstance(arg, ast.UnaryOp):
+        return _traced_evidence(arg.operand, f, m)
+    return False
+
+
+def _check_ql004(models: Dict[str, _FileModel],
+                 out: List[Violation]) -> None:
+    knobs = _knob_registry()
+    for mod, m in models.items():
+        for r in m.env_reads:
+            if not r.name.lstrip("_").startswith("QUEST_"):
+                continue
+            if r.name not in knobs:
+                out.append(Violation(
+                    "QL004", m.path, r.line, r.col,
+                    f"knob {r.name} is not registered in env.KNOBS: "
+                    f"every QUEST_* knob needs a registry entry with a "
+                    f"validating parser (name, parse, default, scope)"))
+                continue
+            if (m.module is not None and m.module != "quest_tpu.env"
+                    and not r.via_registry):
+                out.append(Violation(
+                    "QL004", m.path, r.line, r.col,
+                    f"direct os.environ read of {r.name} bypasses the "
+                    f"registry's validating parser; use "
+                    f"env.knob_value({r.name!r}) so malformed input "
+                    f"raises at the read site"))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.abspath(
+                            os.path.join(dirpath, fn)))
+    return out
+
+
+def run_lint(paths: Sequence[str],
+             rules: Optional[Sequence[str]] = None,
+             root: Optional[str] = None) -> List[Violation]:
+    """Lint `paths` (files or directories); returns unsuppressed
+    violations sorted by location. `rules` restricts to a subset of
+    RULES; `root` anchors module-name resolution (default: the common
+    ancestor containing the quest_tpu package)."""
+    files = collect_files(paths)
+    if root is None:
+        root = os.path.commonpath(files) if files else os.getcwd()
+        while root != os.path.dirname(root) and not os.path.isdir(
+                os.path.join(root, "quest_tpu")):
+            root = os.path.dirname(root)
+
+    models: Dict[str, _FileModel] = {}
+    violations: List[Violation] = []
+    for path in files:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            violations.append(Violation(
+                "QL000", path, e.lineno or 0, e.offset or 0,
+                f"syntax error: {e.msg}"))
+            continue
+        module = _module_name_for(path, root)
+        m = _FileModel(path, module, tree, source)
+        _Collector(m).visit(tree)
+        # key: dotted module for package files, path for driver files
+        models[module or path] = m
+
+    reach = _propagate(models, "jit_root")
+    kreach = _propagate(models, "kernel_root")
+
+    active = set(rules) if rules else set(RULES)
+    if "QL001" in active:
+        _check_ql001(models, reach, violations)
+    if "QL002" in active:
+        _check_ql002(models, kreach, violations)
+    if "QL003" in active:
+        _check_ql003(models, reach, violations)
+    if "QL004" in active:
+        _check_ql004(models, violations)
+
+    by_path = {m.path: m for m in models.values()}
+    kept = [v for v in violations
+            if not (v.path in by_path
+                    and by_path[v.path].suppressed(v.rule, v.line))]
+    kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return kept

@@ -12,7 +12,6 @@ reference architecture cannot express, and the main single-chip perf lever
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -59,15 +58,19 @@ _LOOP_UNROLL_MAX = 32
 
 def _engine_mode_key():
     """The trace-time mode flags every compiled-program cache key must
-    carry: matmul precision, the f64-MXU limb-scheme switch, the limb
-    chunk size (all change what ops/apply traces) and the gate-scheduler
-    switch (changes what the fusing engines plan) — omitting any returns
-    stale programs when a user flips the knob mid-process, the cache-key
-    discipline of ADVICE r4 item 2 / review r5. The apply-level prefix
-    is A.mode_key(), shared with the eager per-gate jit workers
+    carry, DERIVED from the knob registry (env.engine_mode_key): every
+    keyed knob's effective value — matmul precision, the f64-MXU
+    limb-scheme switch, the limb chunk size (all change what ops/apply
+    traces), the gate-scheduler and fused-scan switches (change what
+    the fusing engines plan) and the host-engine block size. Omitting
+    any returns stale programs when a user flips the knob mid-process —
+    the cache-key discipline of ADVICE r4 item 2 / review r5; the knob
+    registry makes the list mechanical instead of hand-maintained
+    (quest-lint QL001 checks read sites against it). The apply-layer
+    subset is A.mode_key(), shared with the eager per-gate jit workers
     (ops/gates.py) whose cache needs the same discipline."""
-    from quest_tpu.ops import fusion as F
-    return A.mode_key() + (F._schedule_enabled(),)
+    from quest_tpu.env import engine_mode_key
+    return engine_mode_key()
 
 # named-gate recovery for Circuit.to_qasm (the builder stores operands;
 # the QASM recorder prefers gate names, like the eager API)
@@ -937,11 +940,11 @@ class Circuit:
         traced operands so callers fall back loudly."""
         self._reject_measure("compiled_host")
         from quest_tpu import host as H
-        # QUEST_HOST_BLOCK is read at encode time — key it so flipping it
+        # QUEST_HOST_BLOCK is read at encode time; it is a keyed knob in
+        # the registry, so _engine_mode_key() covers it — flipping it
         # mid-process can't return a stale program (the cache-key
         # discipline from ADVICE r4 item 2)
-        key = ("host", n, density, iters,
-               os.environ.get("QUEST_HOST_BLOCK", ""))
+        key = ("host", n, density, iters, _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is None:
             fn = H.compile_circuit_host(self.ops, n, density, iters)
@@ -972,8 +975,7 @@ class Circuit:
         compile_circuit_host_measured); density registers collapse
         both spaces natively."""
         from quest_tpu import host as H
-        key = ("host-measured", n, density,
-               os.environ.get("QUEST_HOST_BLOCK", ""))
+        key = ("host-measured", n, density, _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is None:
             fn = H.compile_circuit_host_measured(self.ops, n, density)
@@ -1006,8 +1008,11 @@ class Circuit:
         self._reject_measure("compiled_fused")
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
-        scan_flag = os.environ.get("QUEST_FUSED_SCAN") == "1"
-        key = ("fused", n, density, donate, interpret, iters, scan_flag,
+        from quest_tpu.env import knob_value
+        scan_flag = knob_value("QUEST_FUSED_SCAN")
+        # scan_flag is a keyed registry knob, so _engine_mode_key()
+        # already carries it in the cache key below
+        key = ("fused", n, density, donate, interpret, iters,
                _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is not None:

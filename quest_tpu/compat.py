@@ -37,6 +37,28 @@ else:  # jax <= 0.4.x
     from jax.experimental import enable_x64  # noqa: F401
 
 
+def enable_cpu_collectives() -> bool:
+    """Switch the CPU backend's cross-process collectives onto gloo,
+    returning whether the option exists. Must run BEFORE
+    jax.distributed.initialize. jax 0.4.x ships a CPU backend whose
+    default collectives implementation is 'none' — a multi-process
+    global mesh then fails at dispatch with 'Multiprocess computations
+    aren't implemented on the CPU backend' (the tier-1 env-failure of
+    tests/test_multihost.py). Newer releases select gloo automatically
+    and drop the config knob, hence the hasattr guard."""
+    # probe by update, not hasattr: jax.config only materializes option
+    # attributes on first read, so hasattr is False for never-read
+    # options even when the knob exists (measured on 0.4.37)
+    for key, value in (("jax_cpu_collectives_implementation", "gloo"),
+                       ("jax_cpu_enable_gloo_collectives", True)):
+        try:
+            jax.config.update(key, value)
+            return True
+        except (AttributeError, KeyError, ValueError):
+            continue
+    return False
+
+
 def pallas_tpu_names():
     """(memory-space enum with .HBM/.VMEM attributes, CompilerParams
     class) for the installed Pallas TPU module."""

@@ -15,13 +15,288 @@ wire up DCN before the mesh is built (the analogue of MPI_Init).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+import os
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AMP_AXIS = "amp"
+
+
+# ---------------------------------------------------------------------------
+# QUEST_* knob registry — the single source of truth for every runtime
+# environment knob (ISSUE 2 satellite; the analogue of the reference's
+# one-table validation front-end, QuEST_validation.c). Each entry records
+# the validating parser (raises ValueError on malformed input — knobs
+# parse LOUDLY), the default, and the knob's compile scope:
+#
+#   keyed        read at TRACE time inside compiled paths; its effective
+#                value is part of engine_mode_key(), so every compiled-
+#                program cache (circuit-level engines AND the eager
+#                per-gate jit workers) misses when it flips (the
+#                stale-program class of ADVICE r4 item 2 / r5 item 2)
+#   import_once  resolved once per process (module import or first
+#                compile) and deliberately never re-read — stale-proof
+#                by construction; mid-process flips are ignored, sweeps
+#                go through subprocesses (pallas_band's block knobs)
+#   runtime      read outside any compiled path (host tooling, bench,
+#                test harness); can never return a stale program
+#
+# quest-lint enforces the registry statically: QL001 checks that every
+# knob read reachable from a jitted/fused/Pallas path is keyed or
+# import_once, QL004 that every read routes through knob_value()'s
+# validating parser (quest_tpu/analysis/). The knob-flip audit
+# (quest_tpu/analysis/audit.py) checks the keyed contract dynamically.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered QUEST_* environment knob."""
+    name: str                       # full variable name, e.g. QUEST_SCHEDULE
+    parse: Callable[[str], Any]     # raw string -> value; ValueError if bad
+    default: Any                    # value when unset (callable = dynamic)
+    scope: str                      # "keyed" | "import_once" | "runtime"
+    layer: str                      # subsystem: apply|planner|host|kernel|
+                                    #            infra|bench|test|build
+    doc: str                        # one-liner (docs/CONFIG.md parity)
+    malformed: Optional[str] = None     # sample raw value parse() must
+                                        # reject (None: every string parses)
+    flips: Optional[Tuple[str, str]] = None  # two raw values with distinct
+                                             # effective values (flip audit)
+    current: Optional[Callable[[], Any]] = None  # effective-value getter
+                                                 # override (setter-backed
+                                                 # knobs); default reads env
+
+
+def _bool01(name: str) -> Callable[[str], bool]:
+    def parse(raw: str) -> bool:
+        if raw not in ("0", "1"):
+            raise ValueError(f"{name} must be '0' or '1', got {raw!r}")
+        return raw == "1"
+    return parse
+
+
+def _int_range(name: str, lo: Optional[int] = None,
+               hi: Optional[int] = None) -> Callable[[str], int]:
+    def parse(raw: str) -> int:
+        try:
+            v = int(raw)
+        except ValueError:
+            raise ValueError(f"{name} must be an integer, got {raw!r}")
+        if (lo is not None and v < lo) or (hi is not None and v > hi):
+            raise ValueError(
+                f"{name} must be in [{lo}, {'inf' if hi is None else hi}], "
+                f"got {v}")
+        return v
+    return parse
+
+
+def _parse_f64_chunk(raw: str) -> int:
+    try:
+        c = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"QUEST_F64_CHUNK must be an integer element count, got {raw!r}")
+    if c < 0 or (c and c & (c - 1)):
+        raise ValueError(
+            f"QUEST_F64_CHUNK must be 0 (chunking off) or a positive "
+            f"power of two (state sizes are powers of two, so any other "
+            f"chunk cannot divide the row axis), got {c}")
+    return c
+
+
+def _parse_matmul_precision(raw: str):
+    table = {"default": jax.lax.Precision.DEFAULT,
+             "high": jax.lax.Precision.HIGH,
+             "highest": jax.lax.Precision.HIGHEST}
+    if raw.lower() not in table:
+        raise ValueError(
+            f"matmul precision must be one of {sorted(table)} "
+            f"(via QUEST_MATMUL_PRECISION or set_matmul_precision), "
+            f"got {raw!r}")
+    return table[raw.lower()]
+
+
+def _parse_choice(name: str, choices: Tuple[str, ...]) -> Callable[[str], str]:
+    def parse(raw: str) -> str:
+        if raw not in choices:
+            raise ValueError(f"{name} must be one of {sorted(choices)}, "
+                             f"got {raw!r}")
+        return raw
+    return parse
+
+
+def _parse_engine_ladder(raw: str) -> Tuple[str, ...]:
+    ladder = tuple(raw.split(","))
+    bad = [e for e in ladder if e not in ("banded", "fused", "xla", "host")]
+    if bad:
+        raise ValueError(f"unknown engine(s) in QUEST_BENCH_ENGINES: {bad}")
+    return ladder
+
+
+def _default_f64_mxu() -> bool:
+    # on for TPU backends (native f64 dots are software-emulated there —
+    # the measured 9 gates/s @ 26q wall, VERDICT r4), off elsewhere
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:       # pragma: no cover - no backend
+        return False
+
+
+def _current_matmul_precision():
+    from quest_tpu import precision
+    return precision.matmul_precision()
+
+
+_KNOB_LIST = (
+    Knob("QUEST_MATMUL_PRECISION", _parse_matmul_precision,
+         jax.lax.Precision.HIGHEST,
+         scope="keyed", layer="apply",
+         doc="lax.Precision tier for state-amplitude contractions: "
+             "default, high or highest (default: highest — bit-exact f32)",
+         malformed="ultra", flips=("highest", "high"),
+         current=_current_matmul_precision),
+    Knob("QUEST_F64_MXU", _bool01("QUEST_F64_MXU"), _default_f64_mxu,
+         scope="keyed", layer="apply",
+         doc="f64 band contractions ride the MXU limb scheme: 1/0 "
+             "(default: 1 on TPU backends, 0 elsewhere)",
+         malformed="yes", flips=("0", "1")),
+    Knob("QUEST_F64_CHUNK", _parse_f64_chunk, 1 << 24,
+         scope="keyed", layer="apply",
+         doc="row-chunk size in elements for the f64 limb path; 0 turns "
+             "chunking off (default: 2^24)",
+         malformed="1000", flips=(str(1 << 24), str(1 << 12))),
+    Knob("QUEST_SCHEDULE", _bool01("QUEST_SCHEDULE"), True,
+         scope="keyed", layer="planner",
+         doc="commutation-aware gate scheduler in front of the fusing "
+             "engines' planners: 1/0 (default: 1)",
+         malformed="2", flips=("1", "0")),
+    Knob("QUEST_FUSED_SCAN", _bool01("QUEST_FUSED_SCAN"), False,
+         scope="keyed", layer="planner",
+         doc="lax.scan over repeated-structure kernel segments in the "
+             "fused engine (program-size lever): 1/0 (default: 0)",
+         malformed="on", flips=("0", "1")),
+    Knob("QUEST_HOST_BLOCK", _int_range("QUEST_HOST_BLOCK", 1, 30), 17,
+         scope="keyed", layer="host",
+         doc="log2 amplitudes per cache block of the native host engine "
+             "(default: 17 = 1 MiB blocks)",
+         malformed="big", flips=("17", "15")),
+    Knob("QUEST_FUSED_NBUF", _int_range("QUEST_FUSED_NBUF", 2, 8), 3,
+         scope="import_once", layer="kernel",
+         doc="VMEM slot buffers in the manually pipelined Pallas driver "
+             "(default: 3); malformed values warn and fall back",
+         malformed="9"),
+    Knob("QUEST_ROWS_EFF_BITS", _int_range("QUEST_ROWS_EFF_BITS", 3), None,
+         scope="import_once", layer="kernel",
+         doc="log2 block rows per Pallas kernel step (default: auto from "
+             "VMEM); upper bound checked at first compile",
+         malformed="x"),
+    Knob("QUEST_FUSED_DRIVER",
+         _parse_choice("QUEST_FUSED_DRIVER", ("pipelined", "grid")),
+         "pipelined",
+         scope="import_once", layer="kernel",
+         doc="Pallas segment driver: pipelined (manual slot DMA, default) "
+             "or grid (automatic BlockSpec pipeline)",
+         malformed="turbo"),
+    Knob("QUEST_AXON_PORT", _int_range("QUEST_AXON_PORT", 0), 8093,
+         scope="runtime", layer="infra",
+         doc="local TCP relay port probed before the tunneled-backend "
+             "liveness check; 0 disables the port probe",
+         malformed="abc"),
+    Knob("QUEST_NATIVE_LIB", str, None,
+         scope="runtime", layer="host",
+         doc="override path of the native host-engine shared library "
+             "(e.g. the ASan build in CI)"),
+    Knob("QUEST_HBM_BYTES", _int_range("QUEST_HBM_BYTES", 1), None,
+         scope="runtime", layer="bench",
+         doc="per-device HBM capacity in bytes for the bench's OOM gate "
+             "when the device hides memory stats",
+         malformed="16G"),
+    Knob("QUEST_BENCH_ENGINES", _parse_engine_ladder, None,
+         scope="runtime", layer="bench",
+         doc="comma-separated engine fallback ladder for bench.py "
+             "(default: fused,banded,xla on TPU; host,banded,xla off it)",
+         malformed="warp,xla"),
+    Knob("QUEST_TEST_PLATFORM", str, "cpu",
+         scope="runtime", layer="test",
+         doc="JAX platform the test suite pins before importing jax "
+             "(conftest.py; tpu_pod_tests.sh sets the chip platform)"),
+    Knob("QUEST_SLOW_TESTS", _bool01("QUEST_SLOW_TESTS"), False,
+         scope="runtime", layer="test",
+         doc="opt into multi-minute subprocess tests (16-device dryrun)",
+         malformed="yes"),
+    Knob("QUEST_METRICS_FILE", str, "/tmp/tpu_smoke_metrics.log",
+         scope="runtime", layer="test",
+         doc="file collecting on-chip smoke-test measurement lines "
+             "(pytest capture swallows stderr of passing tests)"),
+    Knob("QUEST_TUNNEL_POLL_S", _int_range("QUEST_TUNNEL_POLL_S", 1), 30,
+         scope="runtime", layer="infra",
+         doc="poll interval of scripts/tunnel_watch.sh (shell-only)"),
+    Knob("QUEST_MEMCHECK", _bool01("QUEST_MEMCHECK"), False,
+         scope="runtime", layer="build",
+         doc="build the native host engine under AddressSanitizer "
+             "(native/Makefile, CI job; shell-only)",
+         malformed="on"),
+    Knob("_QUEST_DRYRUN_BOOTSTRAPPED", _parse_choice(
+         "_QUEST_DRYRUN_BOOTSTRAPPED", ("1",)), None,
+         scope="runtime", layer="infra",
+         doc="internal sentinel marking the virtual-mesh bootstrap child "
+             "of the driver dryrun / 16-device test (not user-facing)",
+         malformed="0"),
+)
+
+KNOBS = {k.name: k for k in _KNOB_LIST}
+
+
+def knob_value(name: str):
+    """Effective value of a registered knob: the validating parse of the
+    environment when set (raises ValueError on malformed input — knobs
+    parse loudly), else the registered default. The ONE read path for
+    QUEST_* knobs in package code (quest-lint QL004 flags direct
+    os.environ reads)."""
+    k = KNOBS[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return k.default() if callable(k.default) else k.default
+    return k.parse(raw)
+
+
+def knob_current(name: str):
+    """Like knob_value, but honoring setter-backed effective values
+    (e.g. set_matmul_precision beats the env var once called)."""
+    k = KNOBS[name]
+    if k.current is not None:
+        return k.current()
+    return knob_value(name)
+
+
+# keyed-knob sublists per layer, computed once: the registry is
+# immutable and engine_mode_key sits on the eager per-gate dispatch
+# path (ops/gates.py feeds A.mode_key() to every worker call), so only
+# the knob_current() reads belong in the per-call cost
+_KEYED_SORTED = tuple(sorted((k for k in _KNOB_LIST if k.scope == "keyed"),
+                             key=lambda k: k.name))
+_KEYED_BY_LAYER = {None: _KEYED_SORTED}
+for _k in _KEYED_SORTED:
+    _KEYED_BY_LAYER.setdefault(_k.layer, ())
+    _KEYED_BY_LAYER[_k.layer] += (_k,)
+del _k
+
+
+def engine_mode_key(layer: Optional[str] = None) -> Tuple:
+    """The trace-time mode-flag tuple every compiled-program cache key
+    must carry, DERIVED from the registry: every keyed knob's effective
+    value, sorted by name (omitting any would return stale programs when
+    a user flips the knob mid-process — the cache-key discipline of
+    ADVICE r4 item 2 / r5 item 2). `layer` restricts to one subsystem's
+    knobs: the eager per-gate jit workers carry layer='apply' (all that
+    their traces read), the circuit-level engines carry the full key."""
+    return tuple((k.name, knob_current(k.name))
+                 for k in _KEYED_BY_LAYER.get(layer, ()))
 
 
 class QuESTEnv:
@@ -154,9 +429,15 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
     # timeout, it never skips the probe. QUEST_AXON_PORT=0 disables.
     if "axon" in os.environ.get("JAX_PLATFORMS", ""):
         try:
-            port = int(os.environ.get("QUEST_AXON_PORT") or "8093")
-        except ValueError:
-            port = 8093   # unparseable value must not break the fallback path
+            port = knob_value("QUEST_AXON_PORT")
+        except ValueError as e:
+            # unparseable value must not break the fallback path — warn
+            # and use the registry default (knobs parse loudly, but THIS
+            # caller's job is to keep the process alive)
+            print(f"[quest_tpu] {e}; using default port "
+                  f"{KNOBS['QUEST_AXON_PORT'].default}",
+                  file=sys.stderr, flush=True)
+            port = KNOBS["QUEST_AXON_PORT"].default
         if port and not _tcp_port_open("127.0.0.1", port):
             timeout_s = min(timeout_s, 45)
             print(f"[quest_tpu] axon relay port {port} not listening; "

@@ -25,18 +25,17 @@ callers fall back loudly.
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import List, Sequence, Tuple
 
 import numpy as np
 
 from quest_tpu import native
 
-_DEFAULT_BLOCK_LOG = 17     # 2^17 amps x 2 planes x 4 B = 1 MiB, inside a
-                            # 2 MiB L2. Measured on the bench circuit
-                            # (16 rx over qubits 1..16 @ 24q): 2^17 ->
-                            # 140 gates/s, 2^16 -> 114, 2^18 -> 130,
-                            # 2^15 -> 121 (reference CPU build: 8.98)
+# block-size default (QUEST_HOST_BLOCK) lives in the knob registry
+# (env.KNOBS): 2^17 amps x 2 planes x 4 B = 1 MiB, inside a 2 MiB L2.
+# Measured on the bench circuit (16 rx over qubits 1..16 @ 24q):
+# 2^17 -> 140 gates/s, 2^16 -> 114, 2^18 -> 130, 2^15 -> 121
+# (reference CPU build: 8.98)
 _MAX_TARGETS = 6
 
 
@@ -112,8 +111,8 @@ def _encode(flat_ops, n: int):
     """(prog int32[], coef float64[], groups int32[], block_log) for the
     native runner. Raises HostEngineUnsupported on anything the C side
     does not implement."""
-    block_log = int(os.environ.get("QUEST_HOST_BLOCK", _DEFAULT_BLOCK_LOG))
-    block_log = max(1, min(block_log, n))
+    from quest_tpu.env import knob_value
+    block_log = min(knob_value("QUEST_HOST_BLOCK"), n)
 
     prog: List[int] = []
     coef: List[float] = []

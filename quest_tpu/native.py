@@ -22,8 +22,10 @@ import numpy as np
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 # QUEST_NATIVE_LIB overrides the library (e.g. libquest_host_asan.so in
 # the ASan CI job, run with LD_PRELOAD=libasan)
-_LIB_PATH = os.environ.get(
-    "QUEST_NATIVE_LIB", os.path.join(_NATIVE_DIR, "libquest_host.so"))
+from quest_tpu.env import knob_value as _knob_value
+
+_LIB_PATH = (_knob_value("QUEST_NATIVE_LIB")
+             or os.path.join(_NATIVE_DIR, "libquest_host.so"))
 
 _lib = None
 _lib_tried = False

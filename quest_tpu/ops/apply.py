@@ -335,15 +335,10 @@ def _f64_mxu_enabled() -> bool:
     f64 dots are software-emulated scalar-by-scalar — the measured
     9 gates/s @ 26q wall, VERDICT r4 item 2), off elsewhere (XLA-CPU
     has real f64 units). QUEST_F64_MXU=1/0 forces either way (1 is how
-    the CPU test suite exercises the scheme's numerics)."""
-    import os
-    v = os.environ.get("QUEST_F64_MXU")
-    if v is not None:
-        return v == "1"
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:       # pragma: no cover - no backend
-        return False
+    the CPU test suite exercises the scheme's numerics); parse and
+    default live in the knob registry (env.KNOBS)."""
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_F64_MXU")
 
 
 _LIMB_BITS = 8          # limb width: bf16-exact integers (<= 2^8)
@@ -429,68 +424,82 @@ def _f64_chunk_elems() -> int:
     contraction bounds the temps at chunk size; the path is HBM-bound,
     so per-chunk MXU efficiency is unaffected at this granularity.
     QUEST_F64_CHUNK overrides (elements per chunk; 0 disables chunking);
-    knobs parse loudly per the config convention — non-integers,
-    negatives and non-powers-of-two raise HERE instead of as an opaque
-    reshape error deep inside tracing (_limb_apply_chunked derives its
-    chunk count by exact division; ADVICE r5 item 1)."""
-    import os
-    v = os.environ.get("QUEST_F64_CHUNK")
-    if v is None:
-        return 1 << 24
-    try:
-        c = int(v)
-    except ValueError:
-        raise ValueError(
-            f"QUEST_F64_CHUNK must be an integer element count, got {v!r}")
-    if c < 0 or (c and c & (c - 1)):
-        raise ValueError(
-            f"QUEST_F64_CHUNK must be 0 (chunking off) or a positive "
-            f"power of two (state sizes are powers of two, so any other "
-            f"chunk cannot divide the row axis), got {c}")
-    return c
+    knobs parse loudly per the config convention — the registry parser
+    (env.KNOBS) rejects non-integers, negatives and non-powers-of-two
+    HERE instead of as an opaque reshape error deep inside tracing
+    (_limb_apply_chunked derives its chunk count by exact division;
+    ADVICE r5 item 1)."""
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_F64_CHUNK")
 
 
 def mode_key():
     """The apply-level trace-mode flags: everything THIS module reads
-    from the environment at trace time. Any jit cache over functions
-    that trace through ops/apply must carry this key, or flipping
-    QUEST_F64_MXU / QUEST_F64_CHUNK / the matmul precision mid-process
-    returns stale programs (ADVICE r5 item 2: the eager per-gate
-    workers in ops/gates.py had exactly that hole). circuit's
-    _engine_mode_key extends this with planner-level flags."""
-    return (precision.matmul_precision(), _f64_mxu_enabled(),
-            _f64_chunk_elems())
+    from the environment at trace time, derived from the knob registry
+    (env.engine_mode_key, layer='apply' = matmul precision, the f64-MXU
+    switch, the limb chunk size). Any jit cache over functions that
+    trace through ops/apply must carry this key, or flipping a knob
+    mid-process returns stale programs (ADVICE r5 item 2: the eager
+    per-gate workers in ops/gates.py had exactly that hole). circuit's
+    _engine_mode_key is the all-layer superset."""
+    from quest_tpu.env import engine_mode_key
+    return engine_mode_key(layer="apply")
+
+
+def _chunk_grid(pre: int, band: int, post: int,
+                chunk_elems: int) -> Tuple[int, int]:
+    """(chunks along pre, chunks along post) for _limb_apply_chunked.
+    The larger axis splits first (its chunks stay contiguous); the
+    other axis splits ONLY when the first alone cannot reach the
+    needed chunk count — the wide-band/unbalanced case (e.g. pre=4,
+    band=128, post=4096 with a small QUEST_F64_CHUNK) where the old
+    single-axis split left chunks of band*post elements and broke the
+    "temps never exceed chunk size" guarantee (ADVICE r5 item 3).
+
+    Every quantity is a power of two (state sizes are; the registry
+    parser pins chunk_elems), so all divisions here are exact. The
+    resulting chunk size (pre//ncp) * band * (post//ncq) is <=
+    chunk_elems whenever chunk_elems >= band; one band row is the
+    floor — the band axis itself is never split (the contraction
+    needs it whole)."""
+    size = pre * band * post
+    nc_needed = max(1, size // int(chunk_elems))
+    if pre >= post:
+        ncp = min(pre, nc_needed)
+        ncq = min(post, nc_needed // ncp)
+    else:
+        ncq = min(post, nc_needed)
+        ncp = min(pre, nc_needed // ncq)
+    chunk = (pre // ncp) * band * (post // ncq)
+    assert chunk <= max(int(chunk_elems), band), \
+        (pre, band, post, chunk_elems, ncp, ncq)
+    return ncp, ncq
 
 
 def _limb_apply_chunked(gre, gim, re, im, real_only, chunk_elems):
     """The complex f64 band application of apply_band, computed through
     _limb_band_contract one row-chunk at a time under jax.lax.map so
-    the limb slices and int32 partials never exceed chunk size. Chunks
-    the larger of the pre/post axes — a band at the top of the index
-    has pre == 1, where post splits instead (one layout pass each way;
-    two extra state touches against ~20 saved in temps)."""
+    the limb slices and int32 partials never exceed chunk size (strict
+    for chunk_elems >= band; the band axis is the floor — see
+    _chunk_grid). The larger of the pre/post axes chunks first and the
+    other splits only when needed, so balanced shapes keep the old
+    single-relayout behavior while wide-band/unbalanced shapes still
+    honor the bound."""
     pre, band, post = re.shape
-    nc_needed = max(1, re.size // int(chunk_elems))
+    ncp, ncq = _chunk_grid(pre, band, post, chunk_elems)
+    pc, qc = pre // ncp, post // ncq
     gre64 = jnp.asarray(gre, jnp.float64)
     gim64 = jnp.asarray(gim, jnp.float64)
 
-    if pre >= post:
-        nc = min(pre, nc_needed)
+    def resh(x):
+        x = x.reshape(ncp, pc, band, ncq, qc)
+        x = jnp.moveaxis(x, 3, 1)           # (ncp, ncq, pc, band, qc)
+        return x.reshape(ncp * ncq, pc, band, qc)
 
-        def resh(x):
-            return x.reshape(nc, pre // nc, band, post)
-
-        def unresh(x):
-            return x.reshape(pre, band, post)
-    else:
-        nc = min(post, nc_needed)
-        pc = post // nc
-
-        def resh(x):
-            return jnp.moveaxis(x.reshape(pre, band, nc, pc), 2, 0)
-
-        def unresh(x):
-            return jnp.moveaxis(x, 0, 2).reshape(pre, band, post)
+    def unresh(x):
+        x = x.reshape(ncp, ncq, pc, band, qc)
+        x = jnp.moveaxis(x, 1, 3)
+        return x.reshape(pre, band, post)
 
     def body(xs):
         re_c, im_c = xs

@@ -40,7 +40,6 @@ sufficient condition, checked structurally — no numerics involved.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -407,13 +406,8 @@ def _schedule_enabled() -> bool:
     scheduler in front of every fusing engine's planner; '0' disables.
     Parsed loudly per the config convention; part of every compiled
     program's cache key (circuit._engine_mode_key)."""
-    v = os.environ.get("QUEST_SCHEDULE")
-    if v is None:
-        return True
-    if v not in ("0", "1"):
-        raise ValueError(
-            f"QUEST_SCHEDULE must be '0' or '1', got {v!r}")
-    return v == "1"
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_SCHEDULE")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1037,22 +1037,14 @@ def _nbuf_override() -> int:
     not started. nbuf=3 gives the drain a whole step of slack at 3
     block buffers of VMEM. Malformed/out-of-range values fall back to
     the default, loudly (same discipline as _rows_eff_override)."""
-    raw = os.environ.get("QUEST_FUSED_NBUF")
-    if not raw:
-        return 3
+    from quest_tpu.env import KNOBS, knob_value
     try:
-        v = int(raw)
-    except ValueError:
+        return knob_value("QUEST_FUSED_NBUF")
+    except ValueError as e:
         import sys
-        print(f"[pallas_band] ignoring malformed QUEST_FUSED_NBUF={raw!r} "
-              f"(want an int)", file=sys.stderr)
-        return 3
-    if not 2 <= v <= 8:
-        import sys
-        print(f"[pallas_band] ignoring QUEST_FUSED_NBUF={v} outside [2, 8]",
+        print(f"[pallas_band] ignoring QUEST_FUSED_NBUF: {e}",
               file=sys.stderr)
-        return 3
-    return v
+        return KNOBS["QUEST_FUSED_NBUF"].default
 
 
 NBUF = _nbuf_override()
@@ -1164,20 +1156,23 @@ def _rows_eff_override():
     silently return stale kernels — sweep via subprocesses instead,
     like scripts' block experiments do). Malformed/out-of-range values
     fall back to the default, loudly."""
-    raw = os.environ.get("QUEST_ROWS_EFF_BITS")
-    if not raw:
-        return ROWS_EFF_BITS
+    from quest_tpu.env import knob_value
     try:
-        v = int(raw)
-    except ValueError:
+        v = knob_value("QUEST_ROWS_EFF_BITS")
+    except ValueError as e:
         import sys
-        print(f"[pallas_band] ignoring malformed QUEST_ROWS_EFF_BITS="
-              f"{raw!r} (want an int)", file=sys.stderr)
+        print(f"[pallas_band] ignoring QUEST_ROWS_EFF_BITS: {e}",
+              file=sys.stderr)
         return ROWS_EFF_BITS
-    if not 3 <= v <= max_block_row_bits():
+    if v is None:
+        return ROWS_EFF_BITS
+    if v > max_block_row_bits():
+        # upper bound depends on the device's VMEM — checkable only here,
+        # not in the registry parser
         import sys
-        print(f"[pallas_band] ignoring QUEST_ROWS_EFF_BITS={v} outside "
-              f"[3, {max_block_row_bits()}]", file=sys.stderr)
+        print(f"[pallas_band] ignoring QUEST_ROWS_EFF_BITS={v} above "
+              f"max_block_row_bits()={max_block_row_bits()}",
+              file=sys.stderr)
         return ROWS_EFF_BITS
     return v
 
@@ -1199,12 +1194,14 @@ def _driver_override() -> str:
     global _DRIVER_EFFECTIVE
     if _DRIVER_EFFECTIVE is not None:
         return _DRIVER_EFFECTIVE
-    v = os.environ.get("QUEST_FUSED_DRIVER", "pipelined")
-    if v not in ("pipelined", "grid"):
+    from quest_tpu.env import KNOBS, knob_value
+    try:
+        v = knob_value("QUEST_FUSED_DRIVER")
+    except ValueError as e:
         import sys
-        print(f"[pallas_band] ignoring unknown QUEST_FUSED_DRIVER={v!r}",
+        print(f"[pallas_band] ignoring QUEST_FUSED_DRIVER: {e}",
               file=sys.stderr)
-        v = "pipelined"
+        v = KNOBS["QUEST_FUSED_DRIVER"].default
     _DRIVER_EFFECTIVE = v
     return v
 

@@ -115,10 +115,12 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
             bands = S.fused_shard_bands(n, local_n)
         if bands is None:
             bands = S._shard_bands(n, local_n)
-        flat_r = S.engine_flat(ops, n, density, local_n)
-        # engine_flat schedules before relabeling; report the
-        # scheduler's counters alongside the plan it produced
-        rec["scheduler"] = F.schedule_summary(flat, n)
+        # engine_flat schedules before relabeling; ONE scheduler run
+        # serves both the plan and the reported counters
+        sstats: dict = {}
+        flat_r = S.engine_flat(ops, n, density, local_n,
+                               sched_stats=sstats)
+        rec["scheduler"] = sstats
         items = F.plan(flat_r, n, bands=bands)
         rec["local_band_passes"] = sum(
             1 for it in items

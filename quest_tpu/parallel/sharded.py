@@ -30,7 +30,7 @@ reference's local/distributed split is resolved per call.
 from __future__ import annotations
 
 import math
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -432,13 +432,17 @@ def _apply_gateop(chunk, dev, *, D, local_n, density, op):
 
 
 def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
-                lazy: bool = False, relabel: bool = None):
+                lazy: bool = False, relabel: bool = None,
+                sched_stats: Optional[dict] = None):
     """The flat op list the banded/fused sharded engines EXECUTE:
     flatten_ops plus the one relabel-rewrite policy. The single home of
     that policy — parallel.introspect reads plan statistics through
     this same function, so the reported schedule cannot drift from the
     executed one. relabel=None means on-unless-lazy; requesting both
-    strategies explicitly raises."""
+    strategies explicitly raises. `sched_stats`, when a dict, receives
+    the scheduler's counters from the SAME scheduler run that produced
+    the returned list (introspect's consumer — a second schedule() pass
+    just for stats would double the O(ops x pool) planning cost)."""
     from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
 
@@ -453,7 +457,15 @@ def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
     # composition-aware A/B guard then accepts or rejects events
     # against the SCHEDULED list; composed diagonals price at zero
     # exchange cost — diagonals never communicate at any position)
-    flat = F.maybe_schedule(flatten_ops(ops, n, density), n)
+    flat0 = flatten_ops(ops, n, density)
+    if sched_stats is None:
+        flat = F.maybe_schedule(flat0, n)
+    else:
+        enabled = F._schedule_enabled()
+        sched, stats = F.schedule(flat0, n)
+        stats["enabled"] = enabled
+        sched_stats.update(stats)
+        flat = sched if enabled else list(flat0)
     if lazy:
         from quest_tpu.parallel.relabel import lazy_relabel_ops
         return lazy_relabel_ops(flat, n, local_n)

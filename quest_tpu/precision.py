@@ -57,15 +57,10 @@ def set_matmul_precision(p) -> None:
     traced with."""
     global _matmul_precision
     if isinstance(p, str):
-        table = {"default": jax.lax.Precision.DEFAULT,
-                 "high": jax.lax.Precision.HIGH,
-                 "highest": jax.lax.Precision.HIGHEST}
-        if p.lower() not in table:
-            raise ValueError(
-                f"matmul precision must be one of {sorted(table)} "
-                f"(via QUEST_MATMUL_PRECISION or set_matmul_precision), "
-                f"got {p!r}")
-        p = table[p.lower()]
+        # the knob registry's parser is the ONE string validator
+        # (env.KNOBS; quest-lint QL004)
+        from quest_tpu.env import KNOBS
+        p = KNOBS["QUEST_MATMUL_PRECISION"].parse(p)
     _matmul_precision = p
 
 
@@ -78,9 +73,8 @@ def matmul_precision():
     QUEST_MATMUL_PRECISION=high or set_matmul_precision."""
     global _matmul_precision
     if _matmul_precision is None:
-        import os
-        set_matmul_precision(os.environ.get("QUEST_MATMUL_PRECISION",
-                                            "highest"))
+        from quest_tpu.env import knob_value
+        set_matmul_precision(knob_value("QUEST_MATMUL_PRECISION"))
     return _matmul_precision
 
 

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Run the project's static analysis: quest-lint (the project-invariant
+# analyzer, docs/ANALYSIS.md) plus ruff's errors-only baseline
+# ([tool.ruff] in pyproject.toml). Exits non-zero on any violation.
+# ruff is optional tooling — environments without it (the TPU container
+# bakes only the jax toolchain) still get the quest-lint half, and
+# tests/test_lint.py skips its ruff case with the same probe.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== quest-lint (python -m quest_tpu.analysis) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m quest_tpu.analysis quest_tpu/ scripts/ tests/ || rc=1
+
+echo "== ruff (errors-only baseline) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check quest_tpu scripts tests || rc=1
+else
+    echo "ruff not installed; skipping (pip install ruff to enable)"
+fi
+
+exit $rc

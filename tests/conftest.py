@@ -46,3 +46,14 @@ def tol(dtype):
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture
+def compile_auditor():
+    """A fresh CompileAuditor (quest_tpu.analysis.audit): enter it
+    around a code block to count jit traces/compiles, then
+    `assert_no_retrace()` to pin that warm reruns compile nothing —
+    the mechanical guard against unstable compiled-program cache keys
+    (docs/ANALYSIS.md)."""
+    from quest_tpu.analysis.audit import CompileAuditor
+    return CompileAuditor()

@@ -49,6 +49,27 @@ def test_api_reference_covers_every_parity_row():
     assert not missing, f"parity functions without docs: {sorted(missing)}"
 
 
+def test_knob_docs_parity():
+    """docs/CONFIG.md <-> env.KNOBS parity (the knob analogue of the
+    api_parity pin): every registered QUEST_* knob has a table row in
+    the doc, every knob named in the doc's table exists in the
+    registry, and the documented scope matches the registered one —
+    fails loudly when either side drifts."""
+    from quest_tpu.env import KNOBS
+    with open(os.path.join(REPO, "docs", "CONFIG.md")) as f:
+        text = f.read()
+    rows = re.findall(
+        r"^\| `(_?QUEST_[A-Z0-9_]+)` \| (\w+) \|", text, re.M)
+    documented = {name: scope for name, scope in rows}
+    missing = sorted(set(KNOBS) - set(documented))
+    assert not missing, f"knobs missing from docs/CONFIG.md: {missing}"
+    stale = sorted(set(documented) - set(KNOBS))
+    assert not stale, f"docs/CONFIG.md rows without a registry entry: {stale}"
+    wrong = {n: (documented[n], KNOBS[n].scope) for n in KNOBS
+             if documented[n] != KNOBS[n].scope}
+    assert not wrong, f"documented scope drifted: {wrong}"
+
+
 def test_backend_probe_api():
     """Pin the jax internal explain() uses to detect a committed backend
     (circuit.py explain; ADVICE r4 item 3): if a JAX upgrade renames

@@ -166,6 +166,51 @@ def test_chunked_limb_matches_unchunked(force_limb, monkeypatch):
             np.testing.assert_allclose(got, base, atol=tol, rtol=0)
 
 
+def test_chunk_grid_bound_is_strict():
+    """ADVICE r5 item 3: when one axis alone cannot reach the target
+    chunk count (wide band + unbalanced pre/post), BOTH axes must
+    split so the "temps never exceed chunk size" guarantee stays
+    strict whenever chunk_elems >= band; a single band row is the
+    floor (the band axis is never split). Sweeps every power-of-two
+    shape class at several chunk sizes."""
+    from quest_tpu.ops.apply import _chunk_grid
+    for pre_b in range(0, 11):
+        for band_b in (1, 3, 5, 7):
+            for post_b in range(0, 11):
+                pre, band, post = 1 << pre_b, 1 << band_b, 1 << post_b
+                for chunk_b in (3, 6, 10, 14, 24):
+                    chunk = 1 << chunk_b
+                    ncp, ncq = _chunk_grid(pre, band, post, chunk)
+                    assert pre % ncp == 0 and post % ncq == 0
+                    got = (pre // ncp) * band * (post // ncq)
+                    assert got <= max(chunk, band), \
+                        (pre, band, post, chunk, ncp, ncq)
+
+
+def test_chunked_limb_wide_band_unbalanced(force_limb, monkeypatch):
+    """The shape class the old single-axis split got wrong: pre small,
+    band wide, post large, chunk smaller than band*post — the pre-only
+    split left band*post-element temps. Both-axis chunking must still
+    reproduce the un-chunked numerics (same bound rationale as
+    test_chunked_limb_matches_unchunked)."""
+    n = 12
+    w = 5                      # band = 32
+    ql = 5                     # pre = 2^(12-5-5) = 4, post = 2^5...
+    rng = np.random.default_rng(11)
+    g = np.linalg.qr(rng.normal(size=(32, 32))
+                     + 1j * rng.normal(size=(32, 32)))[0]
+    amps = rng.normal(size=(2, 1 << n))
+    amps /= np.sqrt((amps ** 2).sum())
+    pair = (np.ascontiguousarray(g.real), np.ascontiguousarray(g.imag))
+    base = np.asarray(apply_band(jnp.asarray(amps), n, pair, ql=ql, w=w))
+    # chunk = 256 elements < band * post: pre alone (4) cannot reach
+    # the needed chunk count — the post axis must split too
+    monkeypatch.setenv("QUEST_F64_CHUNK", "256")
+    got = np.asarray(apply_band(jnp.asarray(amps), n, pair, ql=ql, w=w))
+    tol = 1e-13 * np.abs(base).max()
+    np.testing.assert_allclose(got, base, atol=tol, rtol=0)
+
+
 def test_chunk_knob_in_cache_key(force_limb, monkeypatch):
     """QUEST_F64_CHUNK changes the traced program, so it must be part
     of the compiled-program cache key (circuit._engine_mode_key — the

@@ -22,10 +22,11 @@ def _read(rel):
 
 def test_default_port_in_sync():
     lib = _read("scripts/tunnel_lib.sh")
-    envpy = _read("quest_tpu/env.py")
     sh_port = re.search(r'QUEST_AXON_PORT:-(\d+)', lib).group(1)
-    py_port = re.search(r'QUEST_AXON_PORT"?\) or "(\d+)"', envpy).group(1)
-    assert sh_port == py_port == "8093"
+    # the python default lives in the knob registry (env.KNOBS), the
+    # single source of truth docs/CONFIG.md mirrors
+    from quest_tpu.env import KNOBS
+    assert sh_port == str(KNOBS["QUEST_AXON_PORT"].default) == "8093"
 
 
 def test_shell_scripts_source_the_shared_lib():
