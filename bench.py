@@ -183,7 +183,10 @@ def _engine_step(circ, n: int, engine: str, iters: int, density: bool):
 def _warm_step(n: int, build=_build_circuit):
     """Compile + warm the benchmark step through the fastest engine that
     works on this platform (jit errors only surface at first call, so the
-    warmup runs inside the ladder). Returns (step, warmed_state, engine).
+    warmup runs inside the ladder). Returns (step, warmed_state, engine,
+    compile_s) — compile_s is the winning engine's compile+warmup wall
+    seconds, reported in the JSON line so the trajectory sees what the
+    first run paid (the f64-26q warmup alone is ~297 s on chip).
     Fallbacks are loud, not silent; override via QUEST_BENCH_ENGINES."""
     import jax.numpy as jnp
 
@@ -212,17 +215,17 @@ def _warm_step(n: int, build=_build_circuit):
             state = _basis_state(shape)
             state = step(state)  # warmup/compile
             _sync(state)
-            _log(f"n={n} engine={name} compile+warmup "
-                 f"{time.perf_counter()-t0:.1f}s")
-            return step, state, name
+            compile_s = time.perf_counter() - t0
+            _log(f"n={n} engine={name} compile+warmup {compile_s:.1f}s")
+            return step, state, name, compile_s
         except Exception as e:
             last = e
             _log(f"engine {name} failed at n={n}:\n{traceback.format_exc()}")
     raise RuntimeError(f"no engine available at n={n}") from last
 
 
-def _measure_jax(n: int, reps: int) -> float:
-    step, state, engine = _warm_step(n)
+def _measure_jax(n: int, reps: int):
+    step, state, engine, compile_s = _warm_step(n)
     t0 = time.perf_counter()
     for _ in range(reps):
         state = step(state)
@@ -232,7 +235,7 @@ def _measure_jax(n: int, reps: int) -> float:
     eff_bw = gps * 2 * (1 << n) * 4 * 2  # r+w of both f32 planes per gate
     _log(f"n={n} engine={engine}: {gps:.1f} gates/s "
          f"({eff_bw/1e9:.1f} GB/s effective per-gate traffic)")
-    return gps
+    return gps, engine, compile_s
 
 
 def _measure_chain(n: int, reps: int):
@@ -240,7 +243,8 @@ def _measure_chain(n: int, reps: int):
     size — the engine's per-stage floor. Returns None on any failure so
     the headline JSON never breaks."""
     try:
-        step, state, engine = _warm_step(n, build=_build_chain_circuit)
+        step, state, engine, compile_s = _warm_step(
+            n, build=_build_chain_circuit)
         t0 = time.perf_counter()
         for _ in range(reps):
             state = step(state)
@@ -249,11 +253,11 @@ def _measure_chain(n: int, reps: int):
         gps = GATES_PER_STEP * INNER_STEPS * reps / dt
         _log(f"chain n={n} engine={engine}: {gps:.1f} gates/s "
              f"(dependent chain, no fusion)")
-        return gps
+        return gps, compile_s
     except Exception:
         _log(f"chain variant failed (headline unaffected):\n"
              f"{traceback.format_exc()}")
-        return None
+        return None, None
 
 
 def _measure_numpy_amps_per_sec(n: int, num_gates: int = 8) -> float:
@@ -309,9 +313,10 @@ def _build_density_circuit(nd: int):
 
 
 def _measure_density(reps: int):
-    """(ops/sec, nd) through the fused engine on a density register, or
-    (None, None) — the density figure must never break the headline
-    JSON. Ladder over register sizes like the statevector bench."""
+    """(ops/sec, nd, compile_s) through the fused engine on a density
+    register, or (None, None, None) — the density figure must never
+    break the headline JSON. Ladder over register sizes like the
+    statevector bench."""
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     sizes = (15, 14, 13) if on_tpu else (12, 10)
     # Pallas kernels need the chip; CPU degradation leads with the native
@@ -330,8 +335,9 @@ def _measure_density(reps: int):
                 state = _basis_state(shape)     # |0><0| flat
                 state = step(state)
                 _sync(state)
+                compile_s = time.perf_counter() - t0
                 _log(f"density nd={nd} engine={engine} compile+warmup "
-                     f"{time.perf_counter()-t0:.1f}s")
+                     f"{compile_s:.1f}s")
                 t0 = time.perf_counter()
                 for _ in range(reps):
                     state = step(state)
@@ -342,11 +348,11 @@ def _measure_density(reps: int):
                      f"{ops_per_sec:.1f} ops/s "
                      f"({num_ops} ops: {nd} rotations + damping + 2q-depol "
                      f"+ 4-op Kraus)")
-                return ops_per_sec, nd
+                return ops_per_sec, nd, compile_s
             except Exception:
                 _log(f"density nd={nd} engine={engine} failed; trying "
                      f"next:\n{traceback.format_exc()}")
-    return None, None
+    return None, None, None
 
 
 def _measure_f64(reps: int):
@@ -356,13 +362,13 @@ def _measure_f64(reps: int):
     the headline JSON never breaks. TPU-only: the CPU fallback's f64
     story is the host engine's, already covered by the headline."""
     if jax.devices()[0].platform not in ("tpu", "axon"):
-        return None, None
+        return None, None, None
     prior_x64 = bool(jax.config.jax_enable_x64)
     if not prior_x64:
         try:
             jax.config.update("jax_enable_x64", True)
         except Exception:
-            return None, None
+            return None, None, None
     try:
         return _measure_f64_inner(reps)
     finally:
@@ -388,7 +394,8 @@ def _measure_f64_inner(reps: int):
             state = _basis_state(shape, rdt=jnp.float64)
             state = step(state)
             _sync(state)
-            _log(f"f64 n={n} compile+warmup {time.perf_counter()-t0:.1f}s")
+            compile_s = time.perf_counter() - t0
+            _log(f"f64 n={n} compile+warmup {compile_s:.1f}s")
             t0 = time.perf_counter()
             for _ in range(reps):
                 state = step(state)
@@ -396,11 +403,25 @@ def _measure_f64_inner(reps: int):
             dt = time.perf_counter() - t0
             gps = GATES_PER_STEP * iters * reps / dt
             _log(f"f64 banded n={n}: {gps:.1f} gates/s (MXU limb dots)")
-            return gps, n
+            return gps, n, compile_s
         except Exception:
             _log(f"f64 n={n} failed; trying next size down:\n"
                  f"{traceback.format_exc()}")
-    return None, None
+    return None, None, None
+
+
+def _sweep_metrics(build, n: int):
+    """(hbm_sweeps, per-sweep stage counts) of a bench circuit through
+    Circuit.plan_stats — pure host planning (no compile, no chip), the
+    CPU-assertable metric behind the sweep-fusion layer
+    (docs/SWEEPS.md). Returns (None, None) on any failure so the
+    headline JSON never breaks."""
+    try:
+        rec = build(n).plan_stats()["fused"]
+        return rec["hbm_sweeps"], rec["sweep_stages"]
+    except Exception:
+        _log(f"sweep metrics failed at n={n}:\n{traceback.format_exc()}")
+        return None, None
 
 
 def _baseline_gates_per_sec(n: int) -> tuple[float, str]:
@@ -447,9 +468,10 @@ def main():
 
     gates_per_sec = None
     n = None
+    engine = compile_s = None
     for cand in sizes:
         try:
-            gates_per_sec = _measure_jax(cand, reps)
+            gates_per_sec, engine, compile_s = _measure_jax(cand, reps)
             n = cand
             break
         except Exception:
@@ -466,9 +488,12 @@ def main():
          f"— the reference build runs PRECISION=1 on ONE host CPU core "
          f"(this host has one; its OpenMP build rejects modern GCC)")
 
-    density_ops, density_nd = _measure_density(reps=3)
-    f64_gps, f64_n = _measure_f64(reps=2)
-    chain_gps = _measure_chain(n, reps)
+    density_ops, density_nd, density_compile_s = _measure_density(reps=3)
+    f64_gps, f64_n, f64_compile_s = _measure_f64(reps=2)
+    chain_gps, chain_compile_s = _measure_chain(n, reps)
+    sweeps, sweep_stages = _sweep_metrics(_build_circuit, n)
+    chain_sweeps, chain_sweep_stages = _sweep_metrics(
+        _build_chain_circuit, n)
 
     line = {
         "metric": f"single-qubit gates/sec @ {n}q statevec ({platform})",
@@ -476,22 +501,33 @@ def main():
         "unit": "gates/sec",
         "vs_baseline": round(vs_baseline, 3),
         "baseline_note": "reference PRECISION=1 on one host CPU core",
+        "engine": engine,
+        "compile_s": round(compile_s, 1),
     }
+    if sweeps is not None:
+        line["hbm_sweeps"] = sweeps
+        line["sweep_stages"] = sweep_stages
     if density_ops is not None:
         line["density_metric"] = (f"channel+gate ops/sec @ {density_nd}q "
                                   f"density ({platform})")
         line["density_value"] = round(density_ops, 2)
         line["density_unit"] = "ops/sec"
+        line["density_compile_s"] = round(density_compile_s, 1)
     if f64_gps is not None:
         line["f64_metric"] = (f"single-qubit gates/sec @ {f64_n}q "
                               f"statevec f64/MXU-limb ({platform})")
         line["f64_value"] = round(f64_gps, 2)
         line["f64_unit"] = "gates/sec"
+        line["f64_compile_s"] = round(f64_compile_s, 1)
     if chain_gps is not None:
         line["chain_metric"] = (f"dependent-chain gates/sec @ {n}q "
                                 f"statevec, fusion-resistant ({platform})")
         line["chain_value"] = round(chain_gps, 2)
         line["chain_unit"] = "gates/sec"
+        line["chain_compile_s"] = round(chain_compile_s, 1)
+        if chain_sweeps is not None:
+            line["chain_hbm_sweeps"] = chain_sweeps
+            line["chain_sweep_stages"] = chain_sweep_stages
     print(json.dumps(line))
 
 

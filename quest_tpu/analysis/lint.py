@@ -21,8 +21,10 @@ per file with `# quest-lint: disable-file=RULE`):
          process, stale-proof by construction).
   QL002  i32 kernel hygiene — inside Pallas kernels, iota/arange must
          pin an i32 dtype and index arithmetic must not name i64
-         dtypes or feed bare Python-int bounds to fori_loop: Python
-         ints trace as i64 under x64 and break Mosaic legalization.
+         dtypes, feed bare Python-int bounds to fori_loop, or pass
+         bare Python-int operands to lax.rem/div (the sweep drivers'
+         slot arithmetic): Python ints trace as i64 under x64 and
+         break Mosaic legalization.
   QL003  tracer leaks — no float()/int()/bool()/complex()/.item()/
          np.asarray()/np.array() on tracer-typed values in
          jit-reachable code.
@@ -115,6 +117,13 @@ class _FuncInfo:
     # names with positive evidence of being tracers: assigned from a
     # jnp/lax call, or non-static parameters of a jit-root function
     traced_names: Set[str] = dataclasses.field(default_factory=set)
+    # local callable aliases: `kernel = functools.partial(f, ...)` binds
+    # a name later handed to pallas_call/jit — the compile_segment
+    # idiom. Without this map the kernel body is INVISIBLE to the
+    # kernel-reachability propagation and QL002 never checks it (found
+    # while extending coverage to the sweep drivers, this PR).
+    local_callables: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
 
 
 class _FileModel:
@@ -321,6 +330,18 @@ class _Collector(ast.NodeVisitor):
         if not name:
             return
         cur = self.stack[-1] if self.stack else None
+        if "." not in name:
+            # resolve partial-alias locals through enclosing scopes:
+            # `kernel = functools.partial(f, ...)` then
+            # `pallas_call(kernel, ...)` must root f
+            scope = cur
+            while scope:
+                alias = self.m.funcs[scope].local_callables.get(name)
+                if alias is not None:
+                    name = alias
+                    break
+                scope = self.m.funcs[scope].parent \
+                    if scope in self.m.funcs else None
         head = name.split(".")[0]
         if head in self.m.import_alias and "." in name:
             tgt = (self.m.import_alias[head], name.split(".", 1)[1])
@@ -404,9 +425,11 @@ class _Collector(ast.NodeVisitor):
                               "numpy.array", "onp.asarray", "onp.array"):
             self.m.conversion_sites.append((node, cur))
 
-        # QL002 kernel dtype sites
+        # QL002 kernel dtype sites (rem/div: the pipelined sweep
+        # driver's slot arithmetic — a bare Python-int operand makes
+        # the mixed-dtype op fail to lower under x64)
         if leaf in ("arange", "iota", "broadcasted_iota", "fori_loop",
-                    "astype") or leaf in _I64_NAMES:
+                    "astype", "rem", "div") or leaf in _I64_NAMES:
             self.m.kernel_sites.append((node, cur))
 
         self.generic_visit(node)
@@ -422,9 +445,20 @@ class _Collector(ast.NodeVisitor):
         return mod.split(".")[0] == "jax"
 
     def _handle_assign_value(self, targets, value) -> None:
-        if not self.stack or not self._jax_numeric_call(value):
+        if not self.stack:
             return
         f = self.m.funcs[self.stack[-1]]
+        if isinstance(value, ast.Call):
+            inner = _unwrap_partial(value)
+            if inner is not value:
+                # callable alias: `kernel = functools.partial(fn, ...)`
+                name = _dotted(inner)
+                if name:
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            f.local_callables[t.id] = name
+        if not self._jax_numeric_call(value):
+            return
         for t in targets:
             elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
             for e in elts:
@@ -634,6 +668,22 @@ def _check_ql002(models: Dict[str, _FileModel],
                             "a Pallas kernel: it traces as i64 under x64 "
                             "(pin with jnp.int32(...) so the carry stays "
                             "32-bit)"))
+                        break
+            elif leaf in ("rem", "div") and "." in dotted:
+                # the sweep/pipelined drivers' slot arithmetic
+                # (lax.rem(step, nbuf)): a bare Python-int operand
+                # traces as i64 under x64, and a mixed-dtype rem fails
+                # to lower in interpret mode and legalize in Mosaic
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, int):
+                        out.append(Violation(
+                            "QL002", m.path, node.lineno, node.col_offset,
+                            f"lax.{leaf} with a bare Python-int operand "
+                            f"inside a Pallas kernel: it traces as i64 "
+                            f"under x64 and the mixed-dtype op fails "
+                            f"Mosaic legalization (pin with "
+                            f"jnp.int32(...))"))
                         break
 
 

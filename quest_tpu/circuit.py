@@ -1028,6 +1028,22 @@ class Circuit:
         # band path
         items = F.plan(flat, n, bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
+        # sweep fusion (QUEST_SWEEP_FUSION, keyed — _engine_mode_key
+        # carries it): merge geometry-compatible consecutive segments
+        # into single-launch HBM sweeps, INCLUDING across the unrolled
+        # iterations of this program — a repeated block-resident circuit
+        # (the bench's headline/chain steps) collapses from `iters`
+        # kernel launches per dispatch to ~iters/k, each streaming the
+        # state once (quest_tpu/ops/pallas_band.py sweep_plan,
+        # docs/SWEEPS.md). Unrolling the parts list here replaces
+        # _loop's own unroll for the same iteration range, so program
+        # size is unchanged when nothing merges.
+        unroll = iters if 1 < iters <= _LOOP_UNROLL_MAX else 1
+        if PB.sweep_enabled():
+            parts = PB.sweep_plan(parts * unroll, n)
+        else:
+            unroll = 1
+        loop_iters = iters // unroll
         seg_cache = {}  # identical-structure segments share one kernel
 
         def make_applier(part):
@@ -1083,7 +1099,7 @@ class Circuit:
                 for f in appliers:
                     a = f(a)
                 return a
-            out = _loop(body, amps.reshape(2, -1, PB.LANES), iters)
+            out = _loop(body, amps.reshape(2, -1, PB.LANES), loop_iters)
             return out.reshape(shape)
 
         fn = jax.jit(run, donate_argnums=(0,) if donate else ())
@@ -1132,12 +1148,22 @@ class Circuit:
             items = F.plan(planned, n, bands=PB.plan_bands(n))
             parts = PB.segment_plan(items, n)
             segs = sum(1 for p in parts if p[0] == "segment")
+            # hbm_sweeps: HBM passes per application AFTER sweep fusion
+            # (pallas_band.sweep_plan) under the current
+            # QUEST_SWEEP_FUSION setting — the fused engine's
+            # memory-traffic metric, CPU-assertable like the pass
+            # counts above (tests/test_sweeps.py holds the goldens)
+            swept = PB.maybe_sweep(parts, n)
+            sw = PB.sweep_stats(swept)
             rec["fused"] = {
                 "kernel_segments": segs,
                 "xla_passthroughs": len(parts) - segs,
                 "full_state_passes": len(parts),
                 "stages": sum(len(p[1]) for p in parts
                               if p[0] == "segment"),
+                "sweeps_enabled": PB.sweep_enabled(),
+                "hbm_sweeps": sw["hbm_sweeps"],
+                "sweep_stages": sw["sweep_stages"],
             }
         return rec
 
@@ -1200,6 +1226,23 @@ class Circuit:
         items = F.plan(sched_ops if enabled else flat, n,
                        bands=PB.plan_bands(n))
         parts = PB.segment_plan(items, n)
+        # sweep fusion: report the plan compiled_fused will execute for
+        # ONE application (cross-iteration merging depends on iters,
+        # which explain() doesn't take); the hypothetical count rides
+        # along when the knob is off, mirroring the scheduler line
+        swept = PB.sweep_plan(parts, n)
+        nseg = sum(1 for p in parts if p[0] == "segment")
+        nsw = sum(1 for p in swept if p[0] == "segment")
+        if PB.sweep_enabled():
+            lines.append(
+                f"  sweep fusion: on (QUEST_SWEEP_FUSION=1): {nseg} "
+                f"kernel segment(s) -> {nsw} sweep(s), {len(swept)} HBM "
+                f"pass(es) per application")
+            parts = swept
+        else:
+            lines.append(
+                f"  sweep fusion: OFF (QUEST_SWEEP_FUSION=0); on, it "
+                f"would merge {nseg} segment(s) into {nsw} sweep(s)")
         kernels = set()
         passes = 0
         for i, part in enumerate(parts):
@@ -1333,6 +1376,11 @@ class Circuit:
                 sch_line,
                 f"  local band passes: {rec['local_band_passes']}",
                 f"  global-qubit items: {rec['global_qubit_items']}"]
+            if "kernel_sweeps" in rec:
+                plan_lines.append(
+                    f"  local kernel sweeps: {rec['kernel_sweeps']} per "
+                    f"device (from {rec['kernel_segments']} segment(s); "
+                    f"QUEST_SWEEP_FUSION)")
         return "\n".join([
             f"sharded ({engine}) schedule for {len(self.ops)} ops on "
             f"{self.num_qubits} qubits over {rec['devices']} devices"

@@ -180,6 +180,16 @@ _KNOB_LIST = (
          doc="lax.scan over repeated-structure kernel segments in the "
              "fused engine (program-size lever): 1/0 (default: 0)",
          malformed="on", flips=("0", "1")),
+    Knob("QUEST_SWEEP_FUSION", _bool01("QUEST_SWEEP_FUSION"), True,
+         scope="keyed", layer="planner",
+         doc="sweep-fusion layer: merge consecutive geometry-compatible "
+             "kernel segments (incl. across unrolled iterations) into one "
+             "HBM sweep per kernel launch: 1/0 (default: 1)",
+         malformed="2", flips=("1", "0")),
+    Knob("QUEST_COMPILE_CACHE_DIR", str, None,
+         scope="runtime", layer="infra",
+         doc="persistent XLA compile-cache directory for "
+             "enable_compile_cache (default: .jax_cache under the repo)"),
     Knob("QUEST_HOST_BLOCK", _int_range("QUEST_HOST_BLOCK", 1, 30), 17,
          scope="keyed", layer="host",
          doc="log2 amplitudes per cache block of the native host engine "

@@ -221,6 +221,35 @@ def _split_preds(preds):
     return tuple(lane_p), tuple(row_p)
 
 
+def stage_requirements(stages) -> Tuple[set, int]:
+    """(scattered GLOBAL row bits, sublane floor) a stage list needs
+    resident in one block — the block-geometry contract shared by
+    compile_segment (which sizes the block from it) and the sweep-fusion
+    layer (which merges segments only when the UNION still fits the
+    budgets). One accounting, two consumers, so the merge rule cannot
+    drift from what the kernel actually allocates."""
+    scat: set = set()
+    floor = 0
+    for st in stages:
+        if isinstance(st, MatStage):
+            if st.kind == "sc":
+                scat.add(st.bit)
+            elif st.kind == "scb":
+                scat |= set(range(st.bit, st.bit + st.dim.bit_length() - 1))
+            elif st.kind == "b1":
+                floor = max(floor, st.dim.bit_length() - 1)
+        elif isinstance(st, PairStage):
+            if st.sliced_kind == "scat":
+                scat.add(st.sliced_bit)
+            if st.op_kind == "sc":
+                scat.add(st.op_bit)
+            if st.op_kind == "b1":
+                floor = max(floor, LANE_QUBITS)
+            if st.sliced_kind == "sub":
+                floor = max(floor, st.sliced_bit + 1)
+    return scat, floor
+
+
 def max_block_row_bits() -> int:
     """The in-block row-bit budget for the ACTIVE kernel driver. Both
     budgets are currently 13 — the pipelined driver's in-place slots
@@ -496,6 +525,124 @@ def _try_pair_stage(it, scatter_max):
 def _embed_2x2(sub, pos):
     """Embed a 2x2 at bit `pos` of a 7-bit space (lane or sublane)."""
     return F.embed_operator(sub, [pos], [], [], LANE_QUBITS)
+
+
+# ---------------------------------------------------------------------------
+# sweep fusion: many segments per HBM pass
+# ---------------------------------------------------------------------------
+#
+# segment_plan flushes a segment whenever the NEXT stage's block
+# requirement would outgrow the running budget — a greedy, forward-only
+# split. Two split causes are recoverable after the fact:
+#
+#   * the MAX_SEGMENT_STAGES cap (a VMEM-operand-residency guard sized
+#     for the worst case of 32 dense 128x128 operators — most stages'
+#     operands are a few hundred bytes);
+#   * the per-APPLICATION boundary: Circuit engines repeat the whole
+#     part list `iters` times per dispatch, and the last segment of one
+#     application is usually block-compatible with the first segment of
+#     the next (the fusion-resistant chain benchmark is the extreme
+#     case — every application is ONE segment, so consecutive
+#     applications always merge until a sweep budget binds).
+#
+# sweep_plan re-merges CONSECUTIVE segment parts whose combined stage
+# list still fits one block geometry: scattered-bit UNION within the
+# scatter budget, sublane floor + scattered axes within the row budget
+# (stage_requirements — the same accounting compile_segment sizes the
+# block from), bounded stage count, and an explicit operand-byte budget
+# replacing the blunt per-segment stage cap (operand arrays are
+# whole-array VMEM-resident for the duration of a launch, next to the
+# NBUF in-place block slots of the pipelined driver). Any non-segment
+# part (an XLA passthrough) is a barrier. The merged kernel streams
+# each state block HBM->VMEM ONCE, applies the whole stage sequence,
+# and writes back — with the pipelined driver's double-buffered
+# make_async_copy schedule overlapping the next block's DMA-in and the
+# previous block's DMA-out with compute (docs/SWEEPS.md).
+
+MAX_SWEEP_STAGES = 64   # stages per merged sweep: twice the per-segment
+# cap. NOT validated on silicon — Mosaic register pressure grows with
+# the stage chain (the 2^14-row spills of PIPELINED_MAX_BLOCK_ROW_BITS
+# were chain-wide), so the first on-chip run should A/B this against
+# QUEST_SWEEP_FUSION=0 before trusting deep sweeps.
+SWEEP_OPERAND_BYTES = 48 * (1 << 20)  # VMEM operand budget per sweep:
+# 100 MiB scoped limit minus NBUF (3) double-buffered 8 MiB block slots
+# and headroom for stage temporaries. 48 MiB holds ~380 dense 128x128
+# operator pairs — the stage cap binds first on real plans.
+
+
+def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
+               row_budget: int = None, max_stages: int = MAX_SWEEP_STAGES,
+               operand_bytes: int = SWEEP_OPERAND_BYTES):
+    """Merge consecutive ("segment", stages, arrays) parts of a
+    segment_plan (or a concatenation of several applications' plans)
+    into maximal single-launch sweeps, preserving program order.
+    Returns the same part format, so every downstream consumer
+    (compile_segment, _scan_partition, the sharded compilers) is
+    unchanged. `n` is unused by the merge rule itself but kept so the
+    layer sits uniformly between segment_plan(items, n) and the kernel
+    compilers."""
+    del n
+    if row_budget is None:
+        row_budget = max_block_row_bits()
+    out = []
+    cur_scat: set = set()
+    cur_floor = 0
+    cur_bytes = 0
+    for part in parts:
+        if part[0] != "segment":
+            out.append(part)            # XLA passthrough: a sweep barrier
+            cur_scat, cur_floor, cur_bytes = set(), 0, 0
+            continue
+        stages, arrays = list(part[1]), list(part[2])
+        scat, floor = stage_requirements(stages)
+        nbytes = sum(a.nbytes for a in arrays)
+        if out and out[-1][0] == "segment":
+            u_scat = cur_scat | scat
+            u_floor = max(cur_floor, floor)
+            prev = out[-1]
+            if (len(prev[1]) + len(stages) <= max_stages
+                    and len(u_scat) <= scatter_max
+                    and u_floor + len(u_scat) <= row_budget
+                    and cur_bytes + nbytes <= operand_bytes):
+                out[-1] = ("segment", prev[1] + stages, prev[2] + arrays)
+                cur_scat, cur_floor = u_scat, u_floor
+                cur_bytes += nbytes
+                continue
+        out.append(("segment", stages, arrays))
+        cur_scat, cur_floor, cur_bytes = set(scat), floor, nbytes
+    return out
+
+
+def sweep_enabled() -> bool:
+    """QUEST_SWEEP_FUSION knob: '1' (default) runs sweep fusion behind
+    every fused-engine planner; '0' executes the raw segment plan.
+    Keyed in the registry, so every compiled-program cache key carries
+    it (env.engine_mode_key; flip-audited in tests/test_lint.py)."""
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_SWEEP_FUSION")
+
+
+def maybe_sweep(parts, n: int):
+    """sweep_plan honoring the QUEST_SWEEP_FUSION knob — the engines'
+    entry point (stats consumers call sweep_plan/sweep_stats)."""
+    if not sweep_enabled():
+        return list(parts)
+    return sweep_plan(parts, n)
+
+
+def sweep_stats(parts) -> dict:
+    """CPU-assertable sweep statistics of a (possibly swept) part list:
+    every part — kernel sweep or XLA passthrough — is one full-state
+    HBM pass per application, so `hbm_sweeps` is THE fused-engine
+    memory-traffic metric (Circuit.plan_stats reports it next to the
+    per-stage pass counts it undercuts)."""
+    segs = [p for p in parts if p[0] == "segment"]
+    return {
+        "hbm_sweeps": len(parts),
+        "kernel_sweeps": len(segs),
+        "xla_passthroughs": len(parts) - len(segs),
+        "sweep_stages": [len(p[1]) for p in segs],
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1219,29 +1366,9 @@ def compile_segment(stages: Sequence, n: int,
         rows_eff_bits = _ROWS_EFF_BITS_EFFECTIVE
     total_row_bits = n - LANE_QUBITS
     rows_eff_bits = min(rows_eff_bits, total_row_bits)
-    scat_bits = {st.bit for st in stages
-                 if isinstance(st, MatStage) and st.kind == "sc"}
-    for st in stages:
-        if isinstance(st, MatStage) and st.kind == "scb":
-            scat_bits |= set(range(st.bit,
-                                   st.bit + st.dim.bit_length() - 1))
-        if isinstance(st, PairStage):
-            if st.sliced_kind == "scat":
-                scat_bits.add(st.sliced_bit)
-            if st.op_kind == "sc":
-                scat_bits.add(st.op_bit)
-    # in-block floors: the sublane band's contraction needs its whole
-    # operator in-block, and a PairStage needs its op space plus any
-    # sliced sublane bit
-    need_bits = [st.dim.bit_length() - 1 for st in stages
-                 if isinstance(st, MatStage) and st.kind == "b1"]
-    for st in stages:
-        if isinstance(st, PairStage):
-            if st.op_kind == "b1":
-                need_bits.append(LANE_QUBITS)
-            if st.sliced_kind == "sub":
-                need_bits.append(st.sliced_bit + 1)
-    b1_bits = max(need_bits, default=0)
+    # block geometry from the shared requirements accounting (the same
+    # scat/floor contract sweep_plan merges under)
+    scat_bits, b1_bits = stage_requirements(stages)
     rows_eff_bits = max(rows_eff_bits, b1_bits + len(scat_bits))
     geo = _geometry(n, scat_bits, rows_eff_bits)
     dims, blocks = geo.view_dims()

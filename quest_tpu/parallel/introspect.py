@@ -111,8 +111,10 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
         # items are the lowered collective-permutes; its relabel
         # events are the all-to-alls)
         bands = None
+        fused_bands = None
         if engine == "fused":
-            bands = S.fused_shard_bands(n, local_n)
+            fused_bands = S.fused_shard_bands(n, local_n)
+            bands = fused_bands
         if bands is None:
             bands = S._shard_bands(n, local_n)
         # engine_flat schedules before relabeling; ONE scheduler run
@@ -130,6 +132,21 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
             if isinstance(it, F.BandOp) and it.ql >= local_n)
         rec["relabel_events"] = sum(
             1 for op in flat_r if op.kind == "relabel")
+        if fused_bands is not None:
+            # per-shard sweep metrics through the SAME structural
+            # planner the fused compiler executes
+            # (sharded.plan_fused_structural + pallas_band.maybe_sweep);
+            # sweep_stats keeps the metric definition consistent with
+            # plan_stats — EVERY part (kernel sweep or sharded item)
+            # counts as one full-state pass in hbm_sweeps
+            from quest_tpu.ops import pallas_band as PB
+            sparts = S.plan_fused_structural(items, local_n)
+            sw = PB.sweep_stats(PB.maybe_sweep(sparts, local_n))
+            rec["kernel_segments"] = sum(
+                1 for p in sparts if p[0] == "segment")
+            rec["hbm_sweeps"] = sw["hbm_sweeps"]
+            rec["kernel_sweeps"] = sw["kernel_sweeps"]
+            rec["sweep_stages"] = sw["sweep_stages"]
     return rec
 
 

@@ -587,13 +587,14 @@ def _apply_plan_item(chunk, dev, *, D, local_n, it):
                          op=op)
 
 
-def _plan_fused_parts(items, local_n: int, interpret: bool, seg_cache: dict):
-    """Group maximal runs of purely-local fusion-plan items into Pallas
-    kernel segments; everything else stays an explicit sharded item.
-    Returns [("kernel", applier, arrays) | ("sharded", item)]. Shared by
-    the static fused engine and the dynamic (measured) engine's
-    measurement-free stretches; `seg_cache` lets identical-structure
-    segments across stretches share one compiled kernel."""
+def plan_fused_structural(items, local_n: int):
+    """Structural fused plan of a sharded item stream: maximal runs of
+    purely-local fusion-plan items become ("segment", stages, arrays)
+    parts via pallas_band.segment_plan; everything else is a
+    ("sharded", item) entry (which also acts as a sweep barrier). Pure
+    planning — nothing is compiled — shared by _plan_fused_parts below
+    and parallel.introspect, so the reported per-shard sweep counts
+    cannot drift from the executed ones."""
     from quest_tpu.ops import pallas_band as PB
 
     def local_only(it) -> bool:
@@ -608,9 +609,7 @@ def _plan_fused_parts(items, local_n: int, interpret: bool, seg_cache: dict):
             return
         for sub in PB.segment_plan(run_items, local_n):
             if sub[0] == "segment":
-                seg = PB.compile_segment_cached(seg_cache, sub[1], local_n,
-                                                interpret=interpret)
-                parts.append(("kernel", seg, sub[2]))
+                parts.append(sub)
             else:
                 parts.append(("sharded", sub[1]))
         run_items = []
@@ -622,6 +621,30 @@ def _plan_fused_parts(items, local_n: int, interpret: bool, seg_cache: dict):
             close_run()
             parts.append(("sharded", it))
     close_run()
+    return parts
+
+
+def _plan_fused_parts(items, local_n: int, interpret: bool, seg_cache: dict):
+    """Group maximal runs of purely-local fusion-plan items into Pallas
+    kernel segments, sweep-fuse geometry-compatible consecutive
+    segments into single-launch HBM sweeps (pallas_band.maybe_sweep —
+    the PER-SHARD sweep decision, taken after relabel planning since
+    engine_flat rewrites the op stream first), and compile each sweep.
+    Returns [("kernel", applier, arrays) | ("sharded", item)]. Shared by
+    the static fused engine and the dynamic (measured) engine's
+    measurement-free stretches; `seg_cache` lets identical-structure
+    sweeps across stretches share one compiled kernel."""
+    from quest_tpu.ops import pallas_band as PB
+
+    parts = []
+    for sub in PB.maybe_sweep(plan_fused_structural(items, local_n),
+                              local_n):
+        if sub[0] == "segment":
+            seg = PB.compile_segment_cached(seg_cache, sub[1], local_n,
+                                            interpret=interpret)
+            parts.append(("kernel", seg, sub[2]))
+        else:
+            parts.append(sub)
     return parts
 
 

@@ -78,15 +78,91 @@ def matmul_precision():
     return _matmul_precision
 
 
-def enable_compile_cache(path: str = "/tmp/jax_cache_quest_tpu",
+_CACHE_STATS = {"hits": 0, "misses": 0, "dir": None}
+_cache_listener_installed = False
+
+
+def _install_cache_listener() -> None:
+    """Register a jax monitoring listener that logs persistent-cache
+    hits/misses on stderr: every MISS is announced as it happens (a
+    miss is when you pay the compile — the f64-26q warmup is ~297 s on
+    chip), hits are counted and summarized at exit so repeat bench runs
+    show what the cache saved without per-dispatch spam. Left installed
+    for the process lifetime (jax 0.4.x has no public unregister), like
+    analysis.audit.CompileAuditor's listener."""
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    import atexit
+    import sys
+    from jax._src import monitoring
+
+    def on_event(event: str, **kw) -> None:
+        if event.endswith("/cache_hits"):
+            _CACHE_STATS["hits"] += 1
+            if _CACHE_STATS["hits"] == 1:
+                print(f"[quest_tpu] compile cache HIT "
+                      f"({_CACHE_STATS['dir']})", file=sys.stderr,
+                      flush=True)
+        elif event.endswith("/cache_misses"):
+            _CACHE_STATS["misses"] += 1
+            print(f"[quest_tpu] compile cache MISS "
+                  f"#{_CACHE_STATS['misses']} (compiling; cached for "
+                  f"the next run)", file=sys.stderr, flush=True)
+
+    monitoring.register_event_listener(on_event)
+
+    def summary() -> None:
+        if _CACHE_STATS["hits"] or _CACHE_STATS["misses"]:
+            print(f"[quest_tpu] compile cache: {_CACHE_STATS['hits']} "
+                  f"hit(s), {_CACHE_STATS['misses']} miss(es) "
+                  f"({_CACHE_STATS['dir']})", file=sys.stderr, flush=True)
+
+    atexit.register(summary)
+    _cache_listener_installed = True
+
+
+def enable_compile_cache(path: str = None,
                          min_compile_secs: float = 1.0) -> None:
     """Turn on JAX's persistent compile cache (one shared location for the
     test suite, bench, probes and the driver entry points — circuit
-    programs are compile-dominated on first run)."""
+    programs are compile-dominated on first run). The default location
+    is `.jax_cache` under the repo so the cache survives /tmp cleanups
+    and rides along with checkouts; override with `path` or the
+    QUEST_COMPILE_CACHE_DIR knob (docs/CONFIG.md). Hits/misses are
+    logged on stderr (_install_cache_listener)."""
+    import os
+
     import jax
+    if path is None:
+        from quest_tpu.env import knob_value
+        path = knob_value("QUEST_COMPILE_CACHE_DIR")
+        if path is None:
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__)))
+            path = os.path.join(repo, ".jax_cache")
+            # the repo default only makes sense for checkout use; an
+            # INSTALLED package would resolve into site-packages —
+            # fall back to the old always-writable /tmp location
+            # rather than silently losing persistence (or polluting
+            # site-packages)
+            try:
+                os.makedirs(path, exist_ok=True)
+                writable = os.access(path, os.W_OK)
+            except OSError:
+                writable = False
+            if not writable:
+                import sys
+                import tempfile
+                path = os.path.join(tempfile.gettempdir(),
+                                    "jax_cache_quest_tpu")
+                print(f"[quest_tpu] repo cache dir not writable; "
+                      f"compile cache at {path}", file=sys.stderr)
+    _CACHE_STATS["dir"] = path
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+    _install_cache_listener()
 
 
 def accum_dtype(plane_dtype=None):

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""CI smoke gate for the sweep-fusion layer (docs/SWEEPS.md): fails if
+QFT-30 or the fusion-resistant chain benchmark regress above their
+committed golden `hbm_sweeps` values, asserted CPU-side through
+Circuit.plan_stats() — pure host planning, no compile, no chip.
+
+The goldens live HERE (the CI gate) and are mirrored by the tier-1
+assertions in tests/test_sweeps.py; a planner change that moves either
+must update both, consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+QFT30_GOLDEN_SWEEPS = 6
+CHAIN30_GOLDEN_SWEEPS = 1
+
+
+def main() -> int:
+    import bench
+    from quest_tpu.circuit import qft_circuit
+
+    qft = qft_circuit(30).plan_stats()["fused"]
+    chain = bench._build_chain_circuit(30).plan_stats()["fused"]
+    rec = {
+        "qft30_hbm_sweeps": qft["hbm_sweeps"],
+        "qft30_stages": qft["stages"],
+        "chain30_hbm_sweeps": chain["hbm_sweeps"],
+        "chain30_stages": chain["stages"],
+    }
+    print(json.dumps(rec))
+    ok = True
+    if qft["hbm_sweeps"] > QFT30_GOLDEN_SWEEPS:
+        print(f"REGRESSION: QFT-30 hbm_sweeps {qft['hbm_sweeps']} > "
+              f"golden {QFT30_GOLDEN_SWEEPS}", file=sys.stderr)
+        ok = False
+    if not qft["hbm_sweeps"] < qft["stages"]:
+        print("REGRESSION: QFT-30 hbm_sweeps not strictly below the "
+              "per-stage pass count", file=sys.stderr)
+        ok = False
+    if chain["hbm_sweeps"] > CHAIN30_GOLDEN_SWEEPS:
+        print(f"REGRESSION: chain hbm_sweeps {chain['hbm_sweeps']} > "
+              f"golden {CHAIN30_GOLDEN_SWEEPS}", file=sys.stderr)
+        ok = False
+    if not 2 * chain["hbm_sweeps"] <= chain["stages"]:
+        print("REGRESSION: chain sweep reduction below 2x",
+              file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

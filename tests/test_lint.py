@@ -141,6 +141,71 @@ def test_ql002_clean_kernel_passes(tmp_path):
     assert not [v for v in vs if v.rule == "QL002"], vs
 
 
+def test_ql002_fires_on_unpinned_sweep_driver(tmp_path):
+    """The sweep-fusion driver shape (pallas_call -> partial-wrapped
+    kernel -> pl.run_scoped body -> fori_loop over grid steps with
+    lax.rem slot arithmetic): an UNPINNED loop bound and a bare
+    Python-int rem operand inside that nested-closure chain must both
+    fire — proving QL002's kernel reachability follows the whole sweep
+    driver, not just the top-level kernel function."""
+    vs = _lint_fixture(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _sweep_kernel(in_hbm, out_hbm, *, stages, steps, nbuf):
+            def body(scratch, sems):
+                def step_body(s, _):
+                    slot = jax.lax.rem(s, 3)
+                    return jnp.int32(0)
+                jax.lax.fori_loop(0, steps, step_body, jnp.int32(0))
+            pl.run_scoped(body, scratch=pltpu.VMEM((2, 8, 128),
+                                                   jnp.float32),
+                          sems=pltpu.SemaphoreType.DMA((2,)))
+
+        def compile_sweep(stages, steps):
+            kernel = functools.partial(_sweep_kernel, stages=stages,
+                                       steps=steps, nbuf=3)
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32))
+    """, name="badsweep.py")
+    lines = sorted(v.line for v in vs if v.rule == "QL002")
+    assert lines == [11, 13], vs          # lax.rem int, fori_loop bound
+
+
+def test_ql002_clean_sweep_driver_passes(tmp_path):
+    """The shipped sweep-driver idiom (every slot-arithmetic operand and
+    loop bound pinned jnp.int32) stays clean under the extended rule."""
+    vs = _lint_fixture(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _sweep_kernel(in_hbm, out_hbm, *, steps, nbuf):
+            def body(scratch, sems):
+                def step_body(s, _):
+                    slot = jax.lax.rem(s, jnp.int32(nbuf))
+                    return jnp.int32(0)
+                jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps),
+                                  step_body, jnp.int32(0))
+            pl.run_scoped(body, scratch=pltpu.VMEM((2, 8, 128),
+                                                   jnp.float32),
+                          sems=pltpu.SemaphoreType.DMA((2,)))
+
+        def compile_sweep(steps):
+            kernel = functools.partial(_sweep_kernel, steps=steps, nbuf=3)
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32))
+    """, name="goodsweep.py")
+    assert not [v for v in vs if v.rule == "QL002"], vs
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax

@@ -1,0 +1,391 @@
+"""Sweep-fusion layer tests (quest_tpu/ops/pallas_band.py sweep_plan):
+merge rules, golden hbm_sweeps values for the benchmark circuits, and a
+randomized equivalence suite proving sweep-fused execution matches the
+unfused semantics within documented eps (docs/SWEEPS.md) — across f32
+interpret-mode kernels, the f64 banded fallback, and the sharded fused
+engine. CPU-only: the merge decision and the hbm_sweeps metric are pure
+host planning; execution runs in the Pallas interpreter.
+
+References are the dense NumPy oracle (tests/oracle.py), NOT the
+per-gate XLA engine: a deep unrolled per-gate program costs minutes of
+XLA-CPU compile at x64, while the oracle is exact and compile-free.
+
+Structure templates: the randomized circuits draw their GATE PATTERN
+from a small template pool and their parameters per instance, so
+identical-structure sweeps share one compiled kernel
+(compile_segment_cached) and 50 circuits cost ~a dozen interpret-mode
+compiles, not 50 (the tier-1 budget note in ROADMAP.md).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import bench
+from quest_tpu.circuit import Circuit, GateOp, qft_circuit
+from quest_tpu.ops import fusion as F
+from quest_tpu.ops import pallas_band as PB
+from tests import oracle
+
+pytestmark = pytest.mark.dtype_agnostic
+
+N = 10
+
+# documented equivalence eps (docs/SWEEPS.md): f32 kernels vs the f64
+# oracle, relative to the largest amplitude — the same envelope the
+# per-stage Pallas tests use, widened for multi-application sweeps
+EPS_F32 = 1e-4
+EPS_F64 = 1e-11
+
+
+def plan_parts(c: Circuit, n: int = N, density: bool = False):
+    items = F.plan(c._planned_flat(n * (2 if density else 1), density), n,
+                   bands=PB.plan_bands(n))
+    return PB.segment_plan(items, n)
+
+
+# ---------------------------------------------------------------------------
+# goldens: the benchmark circuits' hbm_sweeps (acceptance metric)
+# ---------------------------------------------------------------------------
+
+QFT30_GOLDEN_SWEEPS = 6      # committed golden (scripts/check_sweep_golden.py
+CHAIN30_GOLDEN_SWEEPS = 1    # runs the same assertions in CI)
+
+
+def test_qft30_golden_hbm_sweeps():
+    rec = qft_circuit(30).plan_stats()["fused"]
+    assert rec["hbm_sweeps"] == QFT30_GOLDEN_SWEEPS, rec
+    # strictly below the per-stage pass count (what a no-fusion engine
+    # would pay) AND no worse than the pre-sweep segment plan
+    assert rec["hbm_sweeps"] < rec["stages"], rec
+    assert rec["hbm_sweeps"] <= rec["full_state_passes"], rec
+    assert sum(rec["sweep_stages"]) == rec["stages"], rec
+
+
+def test_chain30_golden_hbm_sweeps():
+    """The fusion-resistant chain: every gate is its own stage, yet one
+    application is ONE HBM sweep — >= 2x below the per-stage count."""
+    rec = bench._build_chain_circuit(30).plan_stats()["fused"]
+    assert rec["hbm_sweeps"] == CHAIN30_GOLDEN_SWEEPS, rec
+    assert rec["stages"] == bench.GATES_PER_STEP
+    assert 2 * rec["hbm_sweeps"] <= rec["stages"], rec
+
+
+def test_cross_iteration_sweeps_collapse_bench_dispatch():
+    """The bench's INNER_STEPS=16 unrolled applications merge across
+    iteration boundaries: the headline step becomes ONE kernel launch
+    per dispatch (16 -> 1 HBM sweeps) and the chain collapses 16 -> 4
+    (the MAX_SWEEP_STAGES budget binds at 64 stages) — the 'G sweeps ->
+    ~G/k' floor the sweep layer exists for."""
+    for build, want_sweeps in ((bench._build_circuit, 1),
+                               (bench._build_chain_circuit, 4)):
+        c = build(30)
+        parts = plan_parts(c, 30)
+        swept = PB.sweep_plan(parts * bench.INNER_STEPS, 30)
+        assert len(swept) == want_sweeps, (build.__name__, len(swept))
+        assert all(len(p[1]) <= PB.MAX_SWEEP_STAGES for p in swept)
+        # stage multiset preserved, order concatenated
+        assert sum(len(p[1]) for p in swept) == \
+            bench.INNER_STEPS * sum(len(p[1]) for p in parts
+                                    if p[0] == "segment")
+
+
+# ---------------------------------------------------------------------------
+# merge rules
+# ---------------------------------------------------------------------------
+
+
+def _seg(stages, arrays=None):
+    return ("segment", list(stages),
+            list(arrays) if arrays is not None
+            else [np.zeros((1, 8), np.float32) for _ in stages])
+
+
+def test_sweep_respects_xla_barrier():
+    c = Circuit(N)
+    c.h(0)
+    parts = plan_parts(c)
+    assert len(parts) == 1
+    barrier = ("xla", object())
+    swept = PB.sweep_plan([parts[0], barrier, parts[0]], N)
+    assert [p[0] for p in swept] == ["segment", "xla", "segment"]
+
+
+def test_sweep_scatter_budget_blocks_merge():
+    """Two segments whose scattered-bit UNION exceeds the scatter budget
+    stay separate sweeps; within budget they merge."""
+    n = 23
+    c1 = Circuit(n)
+    for q in range(14, 21):
+        c1.ry(q, 0.3)              # scb: scat bits 7..13
+    c2 = Circuit(n)
+    c2.ry(21, 0.4)
+    c2.ry(22, 0.5)                 # scb: scat bits 14, 15
+    (p1,) = plan_parts(c1, n)
+    (p2,) = plan_parts(c2, n)
+    assert len(PB.sweep_plan([p1, p2], n)) == 2      # union: 9 bits > 7
+    assert len(PB.sweep_plan([p2, p2], n)) == 1      # union: 2 bits
+
+
+def test_sweep_row_budget_blocks_merge():
+    """A b1 sublane floor plus scattered axes above max_block_row_bits()
+    blocks the merge (the same budget compile_segment sizes blocks by)."""
+    n = 23
+    cb1 = Circuit(n)
+    for q in range(7, 14):
+        cb1.ry(q, 0.2)             # b1: floor 7
+    chigh = Circuit(n)
+    for q in range(14, 21):
+        chigh.ry(q, 0.3)           # scb: 7 scat bits
+    (pb1,) = plan_parts(cb1, n)
+    (ph,) = plan_parts(chigh, n)
+    # floor 7 + 7 scat = 14 > 13: no merge (the measured Mosaic spill
+    # wall of PIPELINED_MAX_BLOCK_ROW_BITS)
+    assert len(PB.sweep_plan([pb1, ph], n)) == 2
+    assert len(PB.sweep_plan([pb1, pb1], n)) == 1
+
+
+def test_sweep_stage_and_operand_budgets():
+    c = Circuit(N)
+    for q in range(7):
+        c.h(q)
+    (p,) = plan_parts(c)
+    assert len(PB.sweep_plan([p] * 4, N, max_stages=2)) == 2
+    nbytes = sum(a.nbytes for a in p[2])
+    assert len(PB.sweep_plan([p] * 4, N, operand_bytes=2 * nbytes)) == 2
+    assert len(PB.sweep_plan([p] * 4, N)) == 1
+
+
+def test_stage_requirements_matches_segment_geometry():
+    """stage_requirements (the shared merge/geometry accounting) agrees
+    with what segment_plan reserved: every planned segment fits the
+    budgets it was planned under."""
+    rng = np.random.default_rng(5)
+    for n in (N, 17, 23):
+        c = Circuit(n)
+        for _ in range(24):
+            q = int(rng.integers(0, n))
+            c.ry(q, float(rng.uniform(0, 2 * np.pi)))
+            if rng.integers(0, 2):
+                r = int(rng.integers(0, n))
+                if r != q:
+                    c.cz(r, q)
+        for part in plan_parts(c, n):
+            if part[0] != "segment":
+                continue
+            scat, floor = PB.stage_requirements(part[1])
+            assert len(scat) <= PB.SCATTER_MAX
+            assert floor + len(scat) <= PB.max_block_row_bits()
+
+
+def test_maybe_sweep_honors_knob(monkeypatch):
+    c = Circuit(N)
+    for q in range(7):
+        c.h(q)
+    (p,) = plan_parts(c)
+    monkeypatch.setenv("QUEST_SWEEP_FUSION", "0")
+    assert len(PB.maybe_sweep([p, p], N)) == 2
+    rec = c.plan_stats()["fused"]
+    assert not rec["sweeps_enabled"]
+    assert rec["hbm_sweeps"] == rec["full_state_passes"]
+    monkeypatch.setenv("QUEST_SWEEP_FUSION", "1")
+    assert len(PB.maybe_sweep([p, p], N)) == 1
+
+
+def test_sweep_stats_shape():
+    c = Circuit(N)
+    c.h(0)
+    parts = plan_parts(c)
+    sw = PB.sweep_stats(PB.sweep_plan(parts * 3, N))
+    assert sw["hbm_sweeps"] == sw["kernel_sweeps"] == 1
+    assert sw["xla_passthroughs"] == 0
+    assert sw["sweep_stages"] == [3]
+
+
+# ---------------------------------------------------------------------------
+# randomized equivalence: 50 mixed circuits vs the dense oracle
+# ---------------------------------------------------------------------------
+
+_SEG_CACHE: dict = {}   # shared across the suite: identical-structure
+# sweeps compile once (operands ride as kernel inputs)
+
+
+def _template_circuit(n: int, tmpl: int, inst: int) -> Circuit:
+    """A random mixed circuit whose gate PATTERN depends only on `tmpl`
+    (so kernel structures repeat across instances) and whose parameters
+    on (tmpl, inst). Mixes diagonal, non-diagonal and 2-qubit gates
+    over every band of the register."""
+    srng = np.random.default_rng(1000 + tmpl)        # structure
+    arng = np.random.default_rng(7000 + 97 * tmpl + inst)  # angles
+    c = Circuit(n)
+    for _ in range(10):
+        kind = int(srng.integers(0, 8))
+        q = int(srng.integers(0, n))
+        r = int(srng.integers(0, n))
+        if r == q:
+            r = (q + 1) % n
+        ang = float(arng.uniform(0, 2 * np.pi))
+        if kind == 0:
+            c.h(q)
+        elif kind == 1:
+            c.rx(q, ang)
+        elif kind == 2:
+            c.ry(q, ang)
+        elif kind == 3:
+            c.rz(q, ang)
+        elif kind == 4:
+            c.phase(q, ang)                          # diagonal
+        elif kind == 5:
+            c.cz(q, r)                               # allones
+        elif kind == 6:
+            c.cnot(q, r)                             # controlled matrix
+        else:
+            c.multi_rotate_z(sorted({q, r}), ang)    # parity
+    return c
+
+
+def _oracle_vec(amps_planes: np.ndarray) -> np.ndarray:
+    return (amps_planes[0].astype(np.complex128)
+            + 1j * amps_planes[1].astype(np.complex128))
+
+
+def _oracle_apply_ops(vec: np.ndarray, n: int, ops) -> np.ndarray:
+    """Apply original GateOps to a dense complex vector (tests/oracle)."""
+    for op in ops:
+        k = len(op.targets)
+        if op.kind == "matrix":
+            mat = np.asarray(op.operand, dtype=np.complex128)
+        elif op.kind == "diagonal":
+            mat = np.diag(np.asarray(op.operand,
+                                     dtype=np.complex128).reshape(-1))
+        elif op.kind == "parity":
+            diag = np.ones(1 << k, dtype=np.complex128)
+            half = float(op.operand) / 2.0
+            for i in range(1 << k):
+                par = bin(i).count("1") & 1
+                diag[i] = np.exp(-1j * half * (-1.0) ** par)
+            mat = np.diag(diag)
+        elif op.kind == "allones":
+            diag = np.ones(1 << k, dtype=np.complex128)
+            diag[-1] = complex(op.operand)
+            mat = np.diag(diag)
+        else:
+            raise AssertionError(op.kind)
+        vec = oracle.apply_to_vector(vec, n, mat, op.targets,
+                                     op.controls, op.cstates)
+    return vec
+
+
+def _run_swept_parts(parts, n: int, amps_planes: np.ndarray) -> np.ndarray:
+    """Execute a (swept) part list in the Pallas interpreter, sharing
+    compiled kernels through the suite-wide structure cache."""
+    out = jnp.asarray(amps_planes).reshape(2, -1, PB.LANES)
+    for part in parts:
+        assert part[0] == "segment", "templates avoid XLA passthroughs"
+        fn = PB.compile_segment_cached(_SEG_CACHE, tuple(part[1]), n,
+                                       interpret=True)
+        out = fn(out, part[2])
+    return np.asarray(out).reshape(2, -1)
+
+
+_CASES_F32 = [(t, i) for t in range(8) for i in range(5)]      # 40
+_CASES_F64 = [(8, i) for i in range(5)]                        # 5
+_CASES_SHARDED = [(9, 0, np.float32), (9, 1, np.float32),
+                  (9, 2, np.float32), (10, 0, np.float64),
+                  (10, 1, np.float64)]                         # 5 -> 50
+
+
+@pytest.mark.parametrize("tmpl,inst", _CASES_F32)
+def test_sweep_fused_matches_oracle_f32(tmpl, inst):
+    """Two applications' segment plans concatenated and sweep-fused
+    (the cross-iteration merge in miniature) executed through the
+    interpreter must match the oracle applying the circuit twice."""
+    c = _template_circuit(N, tmpl, inst)
+    rng = np.random.default_rng(inst)
+    amps = rng.standard_normal((2, 1 << N)).astype(np.float32)
+    parts = plan_parts(c)
+    swept = PB.sweep_plan(parts * 2, N)
+    assert len(swept) <= len(parts) * 2
+    got = _run_swept_parts(swept, N, amps)
+    want = _oracle_apply_ops(_oracle_vec(amps), N, list(c.ops) * 2)
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got[0] + 1j * got[1], want,
+                               atol=EPS_F32 * scale, rtol=0)
+
+
+@pytest.mark.parametrize("tmpl,inst", _CASES_F64)
+def test_sweep_fused_matches_oracle_f64_limb(tmpl, inst):
+    """f64 registers ride the fused engine's banded-XLA fallback; the
+    sweep knob must leave their numerics bit-faithful to the oracle at
+    f64 eps (sweeps only regroup f32 kernel launches)."""
+    c = _template_circuit(N, tmpl, inst)
+    rng = np.random.default_rng(100 + inst)
+    amps = rng.standard_normal((2, 1 << N)).astype(np.float64)
+    fn = c.compiled_fused(N, density=False, donate=False, interpret=True)
+    got = np.asarray(fn(jnp.asarray(amps))).reshape(2, -1)
+    want = _oracle_apply_ops(_oracle_vec(amps), N, c.ops)
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got[0] + 1j * got[1], want,
+                               atol=EPS_F64 * scale, rtol=0)
+
+
+@pytest.mark.parametrize("tmpl,inst,rdt", _CASES_SHARDED)
+def test_sweep_fused_matches_oracle_sharded(tmpl, inst, rdt):
+    """Per-shard sweeps (parallel.sharded._plan_fused_parts) on a
+    2-device CPU mesh: the sharded fused engine with sweep fusion on
+    must match the oracle — f32 through interpret-mode kernels, f64
+    through the banded schedule over the same plan."""
+    from quest_tpu.parallel.mesh import make_amp_mesh
+
+    n = 11                      # local_n = 10: kernel tier on each shard
+    mesh = make_amp_mesh(2)
+    c = _template_circuit(n, tmpl, inst)
+    rng = np.random.default_rng(200 + 10 * tmpl + inst)
+    amps = rng.standard_normal((2, 1 << n)).astype(rdt)
+    fn = c.compiled_sharded_fused(n, density=False, mesh=mesh,
+                                  donate=False, interpret=True)
+    got = np.asarray(fn(jnp.asarray(amps))).reshape(2, -1)
+    want = _oracle_apply_ops(_oracle_vec(amps), n, c.ops)
+    eps = EPS_F32 if rdt == np.float32 else EPS_F64
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got[0] + 1j * got[1], want,
+                               atol=eps * scale, rtol=0)
+
+
+def test_compiled_fused_cross_iteration_end_to_end():
+    """The engine-level integration: compiled_fused(iters=4) merges the
+    unrolled applications into one launch (plan-asserted) and matches
+    the oracle applying the circuit 4 times."""
+    n = N
+    c = Circuit(n)
+    for q in range(7):
+        c.h(q)
+    c.cz(0, 8)
+    c.rz(9, 0.4)
+    parts = plan_parts(c)
+    assert len(PB.sweep_plan(parts * 4, n)) == 1
+    rng = np.random.default_rng(3)
+    amps = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    fn = c.compiled_fused(n, density=False, donate=False,
+                          interpret=True, iters=4)
+    got = np.asarray(fn(jnp.asarray(amps))).reshape(2, -1)
+    want = _oracle_apply_ops(_oracle_vec(amps), n, list(c.ops) * 4)
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got[0] + 1j * got[1], want,
+                               atol=EPS_F32 * scale, rtol=0)
+
+
+def test_explain_reports_sweeps(monkeypatch):
+    monkeypatch.setenv("QUEST_SWEEP_FUSION", "1")
+    c = bench._build_circuit(16)
+    assert "sweep fusion: on" in c.explain()
+    monkeypatch.setenv("QUEST_SWEEP_FUSION", "0")
+    assert "sweep fusion: OFF" in c.explain()
+
+
+def test_explain_sharded_reports_sweeps():
+    from quest_tpu.parallel.mesh import make_amp_mesh
+    c = _template_circuit(11, 0, 0)
+    text = c.explain_sharded(make_amp_mesh(2), engine="fused")
+    assert "local kernel sweeps:" in text
