@@ -32,6 +32,10 @@ class GateOp:
     controls: Tuple[int, ...] = ()
     cstates: Tuple[int, ...] = ()
     operand: object = None    # matrix / diag vector / angle / phase term
+    meta: object = None       # side-channel the engines may read but never
+    # execute from: Circuit.kraus stores ("kraus", <raw operator tuple>)
+    # so the trajectory unraveling (trajectories.run_batched) can recover
+    # the channel's Kraus decomposition from the superoperator op
 
 
 def dual_of(op: GateOp, shift: int):
@@ -395,6 +399,64 @@ def make_scan_applier(seg, arrays_run):
     return apply
 
 
+def _xla_part_applier(part, n):
+    """Per-STATE applier (on the (2, rows, 128) kernel layout) for a
+    non-segment plan part — the XLA passthrough path shared by
+    compiled_fused and the batched engine, which jax.vmap's it over the
+    leading batch axis (the kernel segments get a real batch grid
+    dimension instead; quest_tpu/ops/pallas_band.py)."""
+    from quest_tpu.ops import fusion as F
+
+    it = part[1]
+    if isinstance(it, F.BandOp):
+        xla_fn = (lambda a, it=it: A.apply_band(
+            a, n, (it.gre, it.gim), it.ql, it.w, it.preds))
+    elif isinstance(it, F.DiagItem):
+        xla_fn = lambda a, it=it: _apply_one(a, n, it.op)
+    elif it.op.kind == "matrix":
+        # matrix passthroughs (cross-band multi-target ops, channel
+        # superops) stay in the (2, rows, 128) kernel layout — a flat
+        # round-trip at this size costs a full-state layout copy (the
+        # 8 GiB copy that OOMed the 30q density bench; see
+        # apply_matrix_rows)
+        op = it.op
+        return (lambda amps, op=op: A.apply_matrix_rows(
+            amps, n, cplx.pack(op.operand), op.targets,
+            op.controls, op.cstates))
+    else:
+        xla_fn = lambda a, it=it: _apply_op(a, n, False, it.op)
+    return (lambda amps, f=xla_fn:
+            f(amps.reshape(2, -1)).reshape(amps.shape))
+
+
+def _bucketed_wrapper(inner, bucket: int, api: str):
+    """The bucketing calling convention, in ONE place (docs/BATCHING.md):
+    wrap a bucket-shaped program so callers may pass ANY leading batch
+    b <= bucket — zero-pad to the bucket (every engine op is a linear
+    map, so pad states stay zero), run the one compiled program, slice
+    back — and reject b > bucket loudly, naming the `api` to re-request.
+    Shared by compiled_batched and compiled_sharded_batched so the
+    contract cannot drift between engines."""
+    def wrapper(amps_b):
+        b = amps_b.shape[0]
+        if b > bucket:
+            raise ValueError(
+                f"batch {b} exceeds this program's bucket {bucket}; "
+                f"request {api}({b}) instead")
+        shape = amps_b.shape
+        flat_b = amps_b.reshape(b, 2, -1)
+        if b < bucket:
+            pad = jnp.zeros((bucket - b,) + flat_b.shape[1:],
+                            flat_b.dtype)
+            out = inner(jnp.concatenate([flat_b, pad], axis=0))
+            return out[:b].reshape(shape)
+        return inner(flat_b).reshape(shape)
+
+    wrapper.bucket = bucket
+    wrapper.inner = inner
+    return wrapper
+
+
 def _human_bytes(b: int) -> str:
     if b >= 2**29:
         return f"{b / 2**30:.2f} GiB"
@@ -417,7 +479,8 @@ class Circuit:
 
     # -- builders (chainable) ------------------------------------------------
 
-    def _add(self, kind, targets, operand, controls=(), cstates=None):
+    def _add(self, kind, targets, operand, controls=(), cstates=None,
+             meta=None):
         targets = tuple(int(t) for t in targets)
         controls = tuple(int(c) for c in controls)
         cstates = tuple(cstates) if cstates is not None else (1,) * len(controls)
@@ -430,7 +493,8 @@ class Circuit:
             raise ValueError("control qubits must be unique")
         if set(targets) & set(controls):
             raise ValueError("control and target qubits must be disjoint")
-        self.ops.append(GateOp(kind, targets, controls, cstates, operand))
+        self.ops.append(GateOp(kind, targets, controls, cstates, operand,
+                               meta))
         self._compiled.clear()
         return self
 
@@ -597,7 +661,14 @@ class Circuit:
         t = (targets,) if np.isscalar(targets) else tuple(targets)
         k = len(t)
         val.validate_kraus_ops(ops, k, max_ops=1 << (2 * k))
-        return self._add("superop", t, M.kraus_superoperator(ops))
+        # keep the raw (validated) Kraus decomposition next to the
+        # composed superoperator: the density engines execute the
+        # superop; the trajectory unraveling (trajectories.run_batched)
+        # needs the branches — recovering them from the superoperator
+        # would cost a Choi decomposition per channel
+        raw = tuple(np.asarray(K, dtype=np.complex128) for K in ops)
+        return self._add("superop", t, M.kraus_superoperator(ops),
+                         meta=("kraus", raw))
 
     def damping(self, target, prob):
         from quest_tpu import validation as val
@@ -1048,32 +1119,13 @@ class Circuit:
 
         def make_applier(part):
             # segment appliers work on (2, rows, 128); XLA passthroughs
-            # flatten and restore around their op
+            # flatten and restore around their op (_xla_part_applier)
             if part[0] == "segment":
                 _, stages, arrays = part
                 seg = PB.compile_segment_cached(seg_cache, stages, n,
                                                 interpret=interpret)
                 return lambda amps, seg=seg, arrays=arrays: seg(amps, arrays)
-            it = part[1]
-            if isinstance(it, F.BandOp):
-                xla_fn = (lambda a, it=it: A.apply_band(
-                    a, n, (it.gre, it.gim), it.ql, it.w, it.preds))
-            elif isinstance(it, F.DiagItem):
-                xla_fn = lambda a, it=it: _apply_one(a, n, it.op)
-            elif it.op.kind == "matrix":
-                # matrix passthroughs (cross-band multi-target ops,
-                # channel superops) stay in the (2, rows, 128) kernel
-                # layout — a flat round-trip at this size costs a
-                # full-state layout copy (the 8 GiB copy that OOMed the
-                # 30q density bench; see apply_matrix_rows)
-                op = it.op
-                return (lambda amps, op=op: A.apply_matrix_rows(
-                    amps, n, cplx.pack(op.operand), op.targets,
-                    op.controls, op.cstates))
-            else:
-                xla_fn = lambda a, it=it: _apply_op(a, n, False, it.op)
-            return (lambda amps, f=xla_fn:
-                    f(amps.reshape(2, -1)).reshape(amps.shape))
+            return _xla_part_applier(part, n)
 
         scan_min = 3 if (scan_flag and not interpret) else 0
         appliers = []
@@ -1115,7 +1167,95 @@ class Circuit:
                                  interpret)
         return q.replace_amps(fn(q.amps))
 
-    def plan_stats(self, density: bool = False) -> dict:
+    def compiled_batched(self, batch: int, density: bool = False,
+                         donate: bool = True, interpret: bool = False):
+        """BATCHED fused engine: ONE compiled program applying this
+        circuit to a whole batch of states — (B, 2, 2^n) planes in, same
+        out. Each kernel sweep carries a leading batch grid dimension
+        and streams the bucket's states through HBM back-to-back with
+        the same stage list (quest_tpu/ops/pallas_band.py), so the
+        LAUNCH COUNT of a B-shot workload does not scale with B — the
+        throughput shape trajectories, multi-shot sampling and parameter
+        sweeps want (docs/BATCHING.md; Q-GEAR's batched-circuit win,
+        arXiv:2504.03967). f64 registers and registers below the kernel
+        tier ride a vmapped banded-XLA program instead (full precision /
+        no Pallas), still one compiled dispatch for the whole batch.
+
+        Batch-size BUCKETING: the compiled size is
+        env.batch_bucket(batch) — B rounds up to the next power of two
+        under QUEST_BATCH_BUCKET=pow2 (default) — and the returned
+        wrapper accepts ANY leading batch b <= bucket, zero-padding to
+        the bucket and slicing back (every engine op is a linear map, so
+        padding states stay zero and cost only their share of the
+        launch). Calls whose batches share a bucket return the SAME
+        wrapper object: serving mixed batch sizes hits one persistent
+        compile-cache entry instead of retracing per size
+        (tests/test_batched.py pins this with the CompileAuditor)."""
+        self._reject_measure("compiled_batched")
+        from quest_tpu.env import batch_bucket
+        n = self.num_qubits * 2 if density else self.num_qubits
+        bucket = batch_bucket(batch)
+        key = ("batched", n, density, donate, interpret, bucket,
+               _engine_mode_key())
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+
+        from quest_tpu.ops import fusion as F
+        from quest_tpu.ops import pallas_band as PB
+
+        flat = self._planned_flat(n, density)
+        use_kernels = PB.usable(n)
+        if use_kernels:
+            items = F.plan(flat, n, bands=PB.plan_bands(n))
+            parts = PB.maybe_sweep(PB.segment_plan(items, n), n)
+        else:
+            items = F.plan(flat, n)
+            parts = None
+        seg_cache = {}
+
+        def make_appliers():
+            appliers = []
+            for part in parts:
+                if part[0] == "segment":
+                    seg = PB.compile_segment_cached(
+                        seg_cache, tuple(part[1]), n,
+                        interpret=interpret, batch=bucket)
+                    appliers.append(
+                        lambda a, seg=seg, arrays=part[2]: seg(a, arrays))
+                else:
+                    appliers.append(jax.vmap(_xla_part_applier(part, n)))
+            return appliers
+
+        appliers = make_appliers() if use_kernels else None
+
+        def run(amps_b):
+            flat_b = amps_b.reshape(bucket, 2, -1)
+            if appliers is None or amps_b.dtype != jnp.float32:
+                # vmapped banded program: f64 keeps the limb-scheme
+                # precision; sub-kernel-tier registers skip Pallas
+                return jax.vmap(
+                    lambda a: _apply_banded_items(a, n, items))(flat_b)
+            a = flat_b.reshape(bucket, 2, -1, PB.LANES)
+            for f in appliers:
+                a = f(a)
+            return a.reshape(bucket, 2, -1)
+
+        inner = jax.jit(run, donate_argnums=(0,) if donate else ())
+        wrapper = _bucketed_wrapper(inner, bucket, "compiled_batched")
+        self._compiled[key] = wrapper
+        return wrapper
+
+    def apply_batched(self, amps_b, density: bool = False,
+                      donate: bool = False, interpret: bool = False):
+        """Apply this circuit to a (B, 2, 2^n) batch of raw amplitude
+        planes through the batched fused engine (compiled_batched)."""
+        fn = self.compiled_batched(int(amps_b.shape[0]), density=density,
+                                   donate=donate, interpret=interpret)
+        return fn(amps_b)
+
+    def plan_stats(self, density: bool = False,
+                   batch: int = None) -> dict:
         """Hardware-independent plan statistics — the pass-count metric
         the commutation-aware scheduler is judged by, assertable on CPU
         (no compile, no chip): 'banded' is fusion.plan_stats's model
@@ -1125,7 +1265,12 @@ class Circuit:
         passthroughs (each one HBM pass per application), plus the
         scheduler's own counters. Computed under the CURRENT
         QUEST_SCHEDULE setting; toggle the knob and diff to see what
-        scheduling buys (docs/SCHEDULER.md, tests/test_scheduler.py)."""
+        scheduling buys (docs/SCHEDULER.md, tests/test_scheduler.py).
+        `batch` adds a 'batched' record (batch, bucket,
+        states_per_sweep, hbm_sweeps) describing what compiled_batched
+        would execute for that many states — its hbm_sweeps equals the
+        unbatched fused plan's by construction: launches do not scale
+        with B (docs/BATCHING.md; scripts/check_batch_golden.py)."""
         self._reject_measure("plan_stats")
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
@@ -1165,9 +1310,27 @@ class Circuit:
                 "hbm_sweeps": sw["hbm_sweeps"],
                 "sweep_stages": sw["sweep_stages"],
             }
+            if batch is not None:
+                from quest_tpu.env import batch_bucket
+                rec["batched"] = PB.batched_stats(
+                    swept, int(batch), batch_bucket(batch))
+        elif batch is not None:
+            # below the kernel tier compiled_batched rides the vmapped
+            # banded program: still one dispatch per banded pass for
+            # the whole bucket (trajectories.plan_stats's fallback
+            # record, so the documented `batch=` parameter never
+            # KeyErrors on small registers)
+            from quest_tpu.env import batch_bucket
+            bucket = batch_bucket(batch)
+            rec["batched"] = {
+                "batch": int(batch), "bucket": bucket,
+                "states_per_sweep": bucket,
+                "hbm_sweeps": rec["banded"]["full_state_passes"],
+                "kernel_sweeps": 0, "batched_stages": 0,
+            }
         return rec
 
-    def explain(self, density: bool = False) -> str:
+    def explain(self, density: bool = False, batch: int = None) -> str:
         """Human-readable fused-engine schedule: what compiled_fused will
         actually execute, WITHOUT paying a compile — one line per part
         (kernel segment with its stage mix, or XLA passthrough), then
@@ -1274,6 +1437,14 @@ class Circuit:
             f"({_human_bytes(moved)} moved per application at {n}q), "
             f"{sum(1 for p in parts if p[0] == 'segment')} segments, "
             f"{len(kernels)} distinct kernels")
+        if batch is not None:
+            from quest_tpu.env import batch_bucket
+            bucket = batch_bucket(batch)
+            lines.append(
+                f"  batched: B={batch} -> bucket {bucket} states per "
+                f"launch (QUEST_BATCH_BUCKET); {passes} launch(es) per "
+                f"application independent of B — "
+                f"{_human_bytes(moved * bucket)} moved for the bucket")
         # chip-keyed constants (_COST_MODELS): each generation's entry
         # NAMES its provenance — v5e measured, v5p projected from
         # datasheet x measured derate; an unrecognized chip falls back
@@ -1309,7 +1480,8 @@ class Circuit:
         return "\n".join(lines)
 
     def explain_sharded(self, mesh, density: bool = False,
-                        engine: str = "banded") -> str:
+                        engine: str = "banded",
+                        batch: int = None) -> str:
         """The distributed counterpart of explain(): lower (not compile)
         the sharded program for `mesh` and report the communication
         schedule XLA actually emitted — collective exchanges and their
@@ -1381,6 +1553,16 @@ class Circuit:
                     f"  local kernel sweeps: {rec['kernel_sweeps']} per "
                     f"device (from {rec['kernel_segments']} segment(s); "
                     f"QUEST_SWEEP_FUSION)")
+            if batch is not None and "hbm_sweeps" in rec:
+                from quest_tpu.env import AMP_AXIS, batch_bucket
+                bucket = batch_bucket(batch)
+                plan_lines.append(
+                    f"  batched: B={batch} -> bucket {bucket} states "
+                    f"ride each per-shard sweep; the batch axis stays "
+                    f"LOCAL to the amplitude mesh (sharding "
+                    f"P(None, None, {AMP_AXIS!r}) — no batch "
+                    f"collectives), {rec['hbm_sweeps']} per-shard "
+                    f"launch(es) independent of B")
         return "\n".join([
             f"sharded ({engine}) schedule for {len(self.ops)} ops on "
             f"{self.num_qubits} qubits over {rec['devices']} devices"
@@ -1447,6 +1629,35 @@ class Circuit:
                                                  donate, interpret)
             self._compiled[key] = fn
         return fn
+
+    def compiled_sharded_batched(self, batch: int, mesh,
+                                 density: bool = False,
+                                 donate: bool = True,
+                                 interpret: bool = False):
+        """BATCHED fused engine over the device mesh: one shard_map
+        program applying this circuit to (B, 2, 2^n) planes whose
+        AMPLITUDE axis is sharded and whose batch axis is kept LOCAL to
+        every device (parallel.sharded.compile_circuit_sharded_fused_
+        batched) — per-shard sweeps stream the whole bucket per launch,
+        collectives vmap over the batch. Buckets and pads exactly like
+        compiled_batched: calls sharing a bucket return the SAME
+        wrapper (one compiled program per bucket)."""
+        self._reject_measure("compiled_sharded_batched")
+        from quest_tpu.env import batch_bucket
+        from quest_tpu.parallel import sharded as S
+        n = self.num_qubits * 2 if density else self.num_qubits
+        bucket = batch_bucket(batch)
+        key = ("sharded-batched", n, density, mesh, donate, interpret,
+               bucket, _engine_mode_key())
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        inner = S.compile_circuit_sharded_fused_batched(
+            self.ops, n, density, mesh, bucket, donate, interpret)
+        wrapper = _bucketed_wrapper(inner, bucket,
+                                    "compiled_sharded_batched")
+        self._compiled[key] = wrapper
+        return wrapper
 
     def apply_sharded_fused(self, q: Qureg, mesh, donate: bool = False,
                             interpret: bool = False) -> Qureg:

@@ -186,6 +186,14 @@ _KNOB_LIST = (
              "kernel segments (incl. across unrolled iterations) into one "
              "HBM sweep per kernel launch: 1/0 (default: 1)",
          malformed="2", flips=("1", "0")),
+    Knob("QUEST_BATCH_BUCKET",
+         _parse_choice("QUEST_BATCH_BUCKET", ("pow2", "off")), "pow2",
+         scope="keyed", layer="planner",
+         doc="batch-size bucketing for the batched engines: pow2 rounds a "
+             "requested batch B up to the next power of two so mixed batch "
+             "sizes share one compiled program; off compiles exact sizes "
+             "(default: pow2)",
+         malformed="4", flips=("pow2", "off")),
     Knob("QUEST_COMPILE_CACHE_DIR", str, None,
          scope="runtime", layer="infra",
          doc="persistent XLA compile-cache directory for "
@@ -273,6 +281,24 @@ def knob_value(name: str):
     if raw is None:
         return k.default() if callable(k.default) else k.default
     return k.parse(raw)
+
+
+def batch_bucket(b: int) -> int:
+    """Effective COMPILED batch size for a requested batch of `b` states
+    (the batched engines' bucketing policy, docs/BATCHING.md): under
+    QUEST_BATCH_BUCKET=pow2 (default) `b` rounds UP to the next power of
+    two, so serving mixed batch sizes hits one compiled program per
+    bucket instead of retracing per size (B=5 and B=8 share the B=8
+    program; the caller pads and slices). 'off' compiles exact sizes —
+    every distinct B pays its own compile. The knob is keyed: it changes
+    which program a batched call resolves to, so engine_mode_key()
+    carries it (flip-audited in tests/test_lint.py)."""
+    b = int(b)
+    if b < 1:
+        raise ValueError(f"batch size must be >= 1, got {b}")
+    if knob_value("QUEST_BATCH_BUCKET") == "off":
+        return b
+    return 1 << (b - 1).bit_length()
 
 
 def knob_current(name: str):

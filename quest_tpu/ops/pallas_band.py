@@ -196,6 +196,50 @@ class MultiPhaseStage:
 
 
 @dataclasses.dataclass(frozen=True)
+class BatchSelStage:
+    """Per-STATE 2x2 operator on one GLOBAL qubit — the batched
+    trajectory engine's channel stage (docs/BATCHING.md). The operand is
+    a (batch, 8) f32 table of rows [g00re, g00im, g01re, g01im, g10re,
+    g10im, g11re, g11im]: each state's drawn Kraus branch (with the
+    1/sqrt(p) renormalization folded in) rides as its own row, and the
+    kernel selects row `batch index` — a per-state one-hot select inside
+    the sweep instead of a vmap of eager per-gate workers. The 2x2 stays
+    UNEMBEDDED whatever the qubit position (scattered bits butterfly on
+    per-state scalars; lane/sublane bits build their embedded operator
+    in-kernel from the 8 scalars via iota masks, _batchsel_embed), so
+    the operand is batch x 32 bytes for ANY qubit — a host-side
+    embedding would cost batch x 128 KiB of VMEM for lane qubits.
+
+    `index` is the channel ordinal in the program: plan-time operand
+    arrays are ZERO PLACEHOLDERS sized (batch, 8) — they thread the
+    batch through the sweep operand-byte budget — and the engines
+    substitute the traced per-state operand for slot `index` at call
+    time. `barrier` marks operands that depend on the PRE-channel state
+    (general Kraus: Born probabilities need the state), which pins the
+    stage to the FRONT of its launch: segment_plan flushes before it and
+    sweep_plan never merges its segment into an earlier one. Unitary
+    mixtures (state-independent probabilities) set barrier=False and
+    fuse anywhere."""
+    qubit: int
+    index: int
+    barrier: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ChannelItem:
+    """Plan-stream marker for a batched per-state channel on GLOBAL
+    qubit `qubit` (trajectories.run_batched interleaves these with the
+    fusion plan's items); segment_plan turns each into a BatchSelStage
+    with a (batch, 8) placeholder operand."""
+    qubit: int
+    index: int
+    barrier: bool = True
+
+    def qubits(self):
+        return (self.qubit,)
+
+
+@dataclasses.dataclass(frozen=True)
 class DiagVecStage:
     """General k-qubit diagonal: multiply each amplitude by the entry
     selected by its target-bit pattern (identity where controls unmet).
@@ -247,6 +291,12 @@ def stage_requirements(stages) -> Tuple[set, int]:
                 floor = max(floor, LANE_QUBITS)
             if st.sliced_kind == "sub":
                 floor = max(floor, st.sliced_bit + 1)
+        elif isinstance(st, BatchSelStage):
+            if st.qubit >= SUBLANE_TOP:
+                scat.add(st.qubit - LANE_QUBITS)
+            elif st.qubit >= LANE_QUBITS:
+                # sublane bit j contracts the lowest j+1 row bits
+                floor = max(floor, st.qubit - LANE_QUBITS + 1)
     return scat, floor
 
 
@@ -261,10 +311,15 @@ def max_block_row_bits() -> int:
             if _driver_override() == "pipelined" else MAX_BLOCK_ROW_BITS)
 
 
-def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
+def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
+                 batch: int = 1):
     """Split fusion-plan items into kernel segments and XLA passthroughs.
     Returns a list of ("segment", [stages], [op_arrays]) and
-    ("xla", item) entries, in program order."""
+    ("xla", item) entries, in program order. `batch` sizes the
+    (batch, 8) zero-placeholder operands of ChannelItem stages (batched
+    trajectory channels) — the one place the batch enters the plan's
+    operand-byte accounting; all other stage operands are shared across
+    the batch and stay batch-independent."""
     parts: List = []
     stages: List = []
     arrays: List = []
@@ -306,6 +361,38 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX):
     for it in items:
         if len(stages) >= MAX_SEGMENT_STAGES:
             flush()
+        if isinstance(it, ChannelItem):
+            # batched per-state channel: a barrier channel's operand is
+            # computed from the state BETWEEN launches, so the running
+            # segment flushes first and the stage opens a fresh one
+            # (following stages still fuse in after it); a mixture
+            # channel's operand depends only on the per-state keys and
+            # fuses like any stage
+            if it.barrier:
+                flush()
+            q = it.qubit
+
+            def reserve_channel():
+                if q >= SUBLANE_TOP:
+                    return reserve(bits=(q - LANE_QUBITS,))
+                if q >= LANE_QUBITS:
+                    return reserve(floor=q - LANE_QUBITS + 1)
+                return True
+            if not reserve_channel():
+                # only reachable under a caller-shrunk scatter budget;
+                # a single channel's bit/floor always fits a fresh
+                # segment, so a failed retry means the budget cannot
+                # hold ANY channel stage — refuse loudly (a real raise,
+                # not an assert: appending an unreserved stage would
+                # silently corrupt the block geometry under python -O)
+                flush()
+                if not reserve_channel():
+                    raise ValueError(
+                        f"channel qubit {q} does not fit an empty "
+                        f"segment under the caller's scatter budget")
+            stages.append(BatchSelStage(q, it.index, it.barrier))
+            arrays.append(np.zeros((batch, 8), dtype=np.float32))
+            continue
         if isinstance(it, F.BandOp):
             lane_p, row_p = _split_preds(it.preds)
             if it.ql == 0:
@@ -596,7 +683,14 @@ def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
         stages, arrays = list(part[1]), list(part[2])
         scat, floor = stage_requirements(stages)
         nbytes = sum(a.nbytes for a in arrays)
-        if out and out[-1][0] == "segment":
+        # a barrier BatchSelStage (general-Kraus channel) reads the
+        # state as it stands at ITS launch boundary — segment_plan put
+        # it first in its segment, and merging that segment into an
+        # earlier one would slide stages in front of it. Batched operand
+        # bytes (the (batch, 8) placeholders) already ride `nbytes`.
+        barrier = any(isinstance(st, BatchSelStage) and st.barrier
+                      for st in stages)
+        if out and out[-1][0] == "segment" and not barrier:
             u_scat = cur_scat | scat
             u_floor = max(cur_floor, floor)
             prev = out[-1]
@@ -642,6 +736,29 @@ def sweep_stats(parts) -> dict:
         "kernel_sweeps": len(segs),
         "xla_passthroughs": len(parts) - len(segs),
         "sweep_stages": [len(p[1]) for p in segs],
+    }
+
+
+def batched_stats(parts, batch: int, bucket: int = None) -> dict:
+    """CPU-assertable batched-plan statistics of a (swept) part list:
+    every state in the bucket rides every sweep of the SAME part list,
+    so `hbm_sweeps` (launches per application) does NOT scale with the
+    batch — the whole point of the batched engine: a B-shot workload
+    pays the unbatched plan's launch count once, with B states streamed
+    back-to-back per launch (`states_per_sweep`). Surfaced through
+    Circuit.plan_stats()["batched"] and trajectories.plan_stats; the
+    B-independence golden lives in scripts/check_batch_golden.py."""
+    sw = sweep_stats(parts)
+    bucket = int(batch) if bucket is None else int(bucket)
+    return {
+        "batch": int(batch),
+        "bucket": bucket,
+        "states_per_sweep": bucket,
+        "hbm_sweeps": sw["hbm_sweeps"],
+        "kernel_sweeps": sw["kernel_sweeps"],
+        "batched_stages": sum(
+            1 for p in parts if p[0] == "segment"
+            for st in p[1] if isinstance(st, BatchSelStage)),
     }
 
 
@@ -1050,6 +1167,96 @@ def _apply_diagvec_stage(re, im, st: DiagVecStage, gref, row_ids):
     return nre, nim
 
 
+def _batchsel_embed(v, bit, width, transpose=False):
+    """Embed one state's 2x2 (8 scalars [g00re, g00im, g01re, g01im,
+    g10re, g10im, g11re, g11im]) at `bit` of a 2^width space, built
+    IN-KERNEL from iota masks: emb[r, c] = G[r_bit, c_bit] where the
+    non-target bits of r and c agree, else 0. Keeps BatchSelStage
+    operands at (batch, 8) bytes for lane/sublane qubits — a host-side
+    embedding would ship batch x 128 KiB to VMEM at d=128.
+    transpose=True returns G^T (the X @ G^T frame of the dot paths)."""
+    d = 1 << width
+    ri = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
+    other = ((ri ^ ci) & jnp.int32((d - 1) & ~(1 << bit))) == 0
+    sel = other.astype(jnp.float32)
+    br = ((ri >> bit) & 1).astype(jnp.float32)
+    bc = ((ci >> bit) & 1).astype(jnp.float32)
+    if transpose:
+        br, bc = bc, br
+
+    def emb(v00, v01, v10, v11):
+        return sel * ((1.0 - br) * (1.0 - bc) * v00
+                      + (1.0 - br) * bc * v01
+                      + br * (1.0 - bc) * v10
+                      + br * bc * v11)
+    return (emb(v[0], v[2], v[4], v[6]), emb(v[1], v[3], v[5], v[7]))
+
+
+def _apply_batchsel_stage(re, im, st: BatchSelStage, gref,
+                          geo: _Geometry, row_ids, bsel):
+    """Apply the CURRENT state's row of a (batch, 8) per-state operand
+    table: the one-hot-selected (renormalized) Kraus branch of a batched
+    trajectory channel, applied inside the sweep. `bsel` is the i32
+    batch index (the leading grid dimension / the pipelined driver's
+    unraveled step quotient)."""
+    g = pl.load(gref, (pl.ds(bsel, 1), slice(None)))   # (1, 8)
+    v = [g[0, j] for j in range(8)]
+    q = st.qubit
+    rows = geo.rows_eff
+
+    if q >= SUBLANE_TOP:
+        # scattered axis: elementwise butterfly on per-state scalars
+        # (the 'sc' MatStage math with traced matrix entries)
+        a = geo.scat.index(q - LANE_QUBITS)
+        pre = 1 << a
+        post = (rows >> (a + 1)) * LANES
+
+        def halves(x):
+            t = x.reshape(pre, 2, post)
+            return t[:, 0, :], t[:, 1, :]
+
+        r0, r1 = halves(re)
+        i0, i1 = halves(im)
+
+        def cmul(cr, ci_, xr, xi):
+            return cr * xr - ci_ * xi, cr * xi + ci_ * xr
+
+        a0r, a0i = cmul(v[0], v[1], r0, i0)
+        b0r, b0i = cmul(v[2], v[3], r1, i1)
+        a1r, a1i = cmul(v[4], v[5], r0, i0)
+        b1r, b1i = cmul(v[6], v[7], r1, i1)
+        nre = jnp.stack([a0r + b0r, a1r + b1r], axis=1).reshape(rows, LANES)
+        nim = jnp.stack([a0i + b0i, a1i + b1i], axis=1).reshape(rows, LANES)
+        return nre, nim
+
+    if q >= LANE_QUBITS:
+        # sublane bit j: contract the lowest j+1 row bits in the b1
+        # large-M frame (X @ G^T; the embedded operator is built
+        # pre-transposed so the kernel pays no per-block transpose)
+        j = q - LANE_QUBITS
+        d = 1 << (j + 1)
+        gre, gim = _batchsel_embed(v, j, j + 1, transpose=True)
+        a = rows // d
+
+        def to_frame(x):
+            return (x.reshape(a, d, LANES).transpose(0, 2, 1)
+                    .reshape(a * LANES, d))
+
+        def from_frame(x):
+            return (x.reshape(a, LANES, d).transpose(0, 2, 1)
+                    .reshape(rows, LANES))
+        return _framed_cdot(to_frame, from_frame, re, im, gre, gim,
+                            False, right=True)
+
+    # lane bit: embedded 128x128, one b0-style dot X @ G^T
+    gre, gim = _batchsel_embed(v, q, LANE_QUBITS, transpose=True)
+
+    def contract(gg, x):
+        return _mxu_dot_general(x, gg, _DN_2D)
+    return _cdot(contract, re, im, gre, gim, False)
+
+
 def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
                       row_ids):
     g = gref[...]                 # (2, 4, D, D) block operators
@@ -1143,13 +1350,20 @@ def _apply_pair_stage(re, im, st: PairStage, gref, geo: _Geometry,
     return nre, nim
 
 
-def _apply_stages(re, im, stages, mat_refs, geo: _Geometry, row_ids):
-    """The stage chain shared by both kernel drivers."""
+def _apply_stages(re, im, stages, mat_refs, geo: _Geometry, row_ids,
+                  bsel=None):
+    """The stage chain shared by both kernel drivers. `bsel` is the i32
+    batch index under the batched grid (None: unbatched — BatchSelStage
+    operands then hold a single row)."""
     for st, ref in zip(stages, mat_refs):
         if isinstance(st, MatStage):
             re, im = _apply_mat_stage(re, im, st, ref, geo, row_ids)
         elif isinstance(st, PairStage):
             re, im = _apply_pair_stage(re, im, st, ref, geo, row_ids)
+        elif isinstance(st, BatchSelStage):
+            re, im = _apply_batchsel_stage(
+                re, im, st, ref, geo, row_ids,
+                jnp.int32(0) if bsel is None else bsel)
         elif isinstance(st, PhaseStage):
             re, im = _apply_phase_stage(re, im, st, ref, row_ids)
         elif isinstance(st, MultiPhaseStage):
@@ -1161,15 +1375,21 @@ def _apply_stages(re, im, stages, mat_refs, geo: _Geometry, row_ids):
     return re, im
 
 
-def _segment_kernel(in_ref, *rest, stages, geo: _Geometry):
+def _segment_kernel(in_ref, *rest, stages, geo: _Geometry,
+                    batched: bool = False):
     mat_refs = rest[:len(stages)]   # one operand ref per stage
     out_ref = rest[len(stages)]
-    pids = [pl.program_id(d) for d in range(len(geo.gaps))]
+    # the batch rides as the OUTERMOST grid dimension: program_id(0) is
+    # the i32 state index (dtype-pinned by Pallas itself), row grids
+    # shift up by one
+    off = 1 if batched else 0
+    bsel = pl.program_id(0) if batched else None
+    pids = [pl.program_id(off + d) for d in range(len(geo.gaps))]
     row_ids = _row_ids(geo, pids)
-    blk = in_ref[...]
-    re = blk[0].reshape(geo.rows_eff, LANES)
-    im = blk[1].reshape(geo.rows_eff, LANES)
-    re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids)
+    blk = in_ref[...].reshape(2, geo.rows_eff, LANES)
+    re = blk[0]
+    im = blk[1]
+    re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids, bsel)
     shape = out_ref.shape
     out_ref[...] = jnp.stack([re, im]).reshape(shape)
 
@@ -1198,7 +1418,7 @@ NBUF = _nbuf_override()
 
 
 def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
-                      block_shape, nbuf):
+                      block_shape, nbuf, nbatch=1, batched=None):
     """Manually pipelined segment driver: the state stays in HBM
     (memory_space=ANY); the kernel walks the same step space as the grid
     driver with `nbuf` in-place VMEM slot buffers — DMA step s+1 in and
@@ -1214,7 +1434,9 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
     the row-bit budget stays 13 on both drivers."""
     mat_refs = rest[:len(stages)]
     out_hbm = rest[len(stages)]
-    steps = int(np.prod(grid))
+    if batched is None:          # legacy callers key batched-ness on B
+        batched = nbatch > 1
+    steps = int(np.prod(grid)) * nbatch
     nbuf = min(nbuf, steps)
 
     def idx_of(step):
@@ -1223,29 +1445,38 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
         index_map (block entry 1 = a grid axis taking the unraveled
         step id, anything else rides whole) — one layout convention,
         not two. A size-1 inner axis also has block 1; the default 0
-        indexes it, mirroring index_map's zip-shortest behavior."""
+        indexes it, mirroring index_map's zip-shortest behavior.
+        Batched: the step space is (nbatch, *grid) with the batch
+        SLOWEST, so each state's blocks stream back-to-back — the
+        quotient left after dividing out the row grid is the i32 batch
+        index (the loop counter is pinned int32 below, so every
+        derived pid stays 32-bit)."""
         pids = []
         rem = step
         for g in reversed(grid):
             pids.append(rem % g)
             rem = rem // g
         pids = pids[::-1]
+        b = rem                              # batch index (0 unbatched)
         it = iter(pids)
-        idx = [slice(None)]                  # plane axis
+        idx = [pl.ds(b, 1)] if batched else []   # leading batch axis
+        idx.append(slice(None))              # plane axis
         for blk in block_shape[1:-1]:        # row-view axes
             idx.append(pl.ds(next(it, 0), 1) if blk == 1
                        else slice(None))
         idx.append(slice(None))              # lane axis
-        return tuple(idx), pids
+        return tuple(idx), pids, b
+
+    slot_shape = (1, *block_shape) if batched else block_shape
 
     def body(scratch, in_sems, out_sems):
         def get_in(step, slot):
-            idx, _ = idx_of(step)
+            idx, _, _ = idx_of(step)
             return pltpu.make_async_copy(
                 in_hbm.at[idx], scratch.at[slot], in_sems.at[slot])
 
         def get_out(step, slot):
-            idx, _ = idx_of(step)
+            idx, _, _ = idx_of(step)
             return pltpu.make_async_copy(
                 scratch.at[slot], out_hbm.at[idx], out_sems.at[slot])
 
@@ -1267,13 +1498,14 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
                 get_in(s + 1, nslot).start()
 
             get_in(s, slot).wait()
-            _, pids = idx_of(s)
+            _, pids, b = idx_of(s)
             row_ids = _row_ids(geo, pids)
-            blk = scratch[slot]
-            re = blk[0].reshape(geo.rows_eff, LANES)
-            im = blk[1].reshape(geo.rows_eff, LANES)
-            re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids)
-            scratch[slot] = jnp.stack([re, im]).reshape(block_shape)
+            blk = scratch[slot].reshape(2, geo.rows_eff, LANES)
+            re = blk[0]
+            im = blk[1]
+            re, im = _apply_stages(re, im, stages, mat_refs, geo, row_ids,
+                                   b if batched else None)
+            scratch[slot] = jnp.stack([re, im]).reshape(slot_shape)
             get_out(s, slot).start()
             return jnp.int32(0)
 
@@ -1290,7 +1522,7 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
 
     pl.run_scoped(
         body,
-        scratch=pltpu.VMEM((nbuf, *block_shape), jnp.float32),
+        scratch=pltpu.VMEM((nbuf, *slot_shape), jnp.float32),
         in_sems=pltpu.SemaphoreType.DMA((nbuf,)),
         out_sems=pltpu.SemaphoreType.DMA((nbuf,)),
     )
@@ -1355,10 +1587,18 @@ def _driver_override() -> str:
 
 def compile_segment(stages: Sequence, n: int,
                     rows_eff_bits: int | None = None,
-                    interpret: bool = False):
+                    interpret: bool = False, batch: int | None = None):
     """Build fn(amps, mat_arrays) -> amps applying `stages` in one kernel
     launch (the manually pipelined slot driver by default; the automatic
-    grid pipeline via QUEST_FUSED_DRIVER=grid)."""
+    grid pipeline via QUEST_FUSED_DRIVER=grid). batch=B (any B >= 1)
+    adds a leading batch grid dimension: the launch streams B states
+    through HBM back-to-back with the SAME stage list — one launch for
+    the whole bucket instead of one per state — and apply takes/returns
+    (B, 2, rows, 128) even at B=1 so callers keep one calling convention
+    per bucket (docs/BATCHING.md). batch=None compiles the unbatched
+    kernel over (2, rows, 128). Block geometry, VMEM residency and the
+    stage chain are per-state and unchanged; only BatchSelStage operands
+    carry a per-state axis."""
     global _ROWS_EFF_BITS_EFFECTIVE
     if rows_eff_bits is None:
         if _ROWS_EFF_BITS_EFFECTIVE is None:
@@ -1374,20 +1614,37 @@ def compile_segment(stages: Sequence, n: int,
     dims, blocks = geo.view_dims()
     grid = tuple(1 << w for (lo, w) in geo.gaps)
     grid_axes = [i for i, b in enumerate(blocks) if b == 1]
+    batched = batch is not None
+    nbatch = batch if batched else 1
 
     def index_map(*ids):
-        out = [0] * (len(dims) + 2)   # + plane axis, + lane axis
+        # batched: the leading grid id selects the state; row-axis
+        # offsets shift one slot right for the batch view axis
+        if batched:
+            b, ids = ids[0], ids[1:]
+            out = [b] + [0] * (len(dims) + 2)
+            off = 2
+        else:
+            out = [0] * (len(dims) + 2)   # + plane axis, + lane axis
+            off = 1
         for ax, i in zip(grid_axes, ids):
-            out[1 + ax] = i
+            out[off + ax] = i
         return tuple(out)
 
     block_shape = (2, *blocks, LANES)
     view_shape = (2, *dims, LANES)
+    if batched:
+        full_view = (nbatch, *view_shape)
+        full_block = (1, *block_shape)
+        full_grid = (nbatch, *grid)
+    else:
+        full_view, full_block, full_grid = view_shape, block_shape, grid
 
     if _driver_override() == "pipelined":
         kernel = functools.partial(
             _pipelined_kernel, stages=tuple(stages), geo=geo, grid=grid,
-            block_shape=block_shape, nbuf=NBUF)
+            block_shape=block_shape, nbuf=NBUF, nbatch=nbatch,
+            batched=batched)
         # the state stays in HBM; the kernel DMAs its own blocks through
         # the in-place slot buffers. Operands are whole-array VMEM.
         in_specs = [pl.BlockSpec(memory_space=_MEMSPACE.HBM)]
@@ -1398,7 +1655,7 @@ def compile_segment(stages: Sequence, n: int,
             kernel,
             in_specs=in_specs,
             out_specs=pl.BlockSpec(memory_space=_MEMSPACE.HBM),
-            out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
+            out_shape=jax.ShapeDtypeStruct(full_view, jnp.float32),
             input_output_aliases={0: 0},  # in-place on the state buffer
             compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=VMEM_LIMIT_BYTES),
@@ -1406,8 +1663,8 @@ def compile_segment(stages: Sequence, n: int,
         )
     else:
         kernel = functools.partial(_segment_kernel, stages=tuple(stages),
-                                   geo=geo)
-        in_specs = [pl.BlockSpec(block_shape, index_map)]
+                                   geo=geo, batched=batched)
+        in_specs = [pl.BlockSpec(full_block, index_map)]
         for st in stages:
             if isinstance(st, PairStage):
                 d = st.op_dim
@@ -1417,6 +1674,11 @@ def compile_segment(stages: Sequence, n: int,
                 d = st.dim
                 in_specs.append(
                     pl.BlockSpec((2, d, d), lambda *ids: (0, 0, 0)))
+            elif isinstance(st, BatchSelStage):
+                # the whole per-state table rides resident (batch x 32
+                # bytes); the kernel row-selects by the batch grid id
+                in_specs.append(
+                    pl.BlockSpec((nbatch, 8), lambda *ids: (0, 0)))
             elif isinstance(st, MultiPhaseStage):
                 in_specs.append(
                     pl.BlockSpec((len(st.forms), 8), lambda *ids: (0, 0)))
@@ -1429,10 +1691,10 @@ def compile_segment(stages: Sequence, n: int,
                 in_specs.append(pl.BlockSpec((1, 8), lambda *ids: (0, 0)))
         fn = pl.pallas_call(
             kernel,
-            grid=grid,
+            grid=full_grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(block_shape, index_map),
-            out_shape=jax.ShapeDtypeStruct(view_shape, jnp.float32),
+            out_specs=pl.BlockSpec(full_block, index_map),
+            out_shape=jax.ShapeDtypeStruct(full_view, jnp.float32),
             input_output_aliases={0: 0},  # in-place on the state buffer
             compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=VMEM_LIMIT_BYTES),
@@ -1452,25 +1714,29 @@ def compile_segment(stages: Sequence, n: int,
         # trace, and flipping x64 mid-trace is what breaks it (i32
         # carry vs i64 bound); there is no Mosaic pass to appease there.
         if interpret:
-            out = fn(amps.reshape(view_shape), *mat_arrays)
+            out = fn(amps.reshape(full_view), *mat_arrays)
         else:
             with compat.enable_x64(False):
-                out = fn(amps.reshape(view_shape), *mat_arrays)
+                out = fn(amps.reshape(full_view), *mat_arrays)
+        if batched:
+            return out.reshape(nbatch, 2, -1, LANES)
         return out.reshape(2, -1, LANES)
 
     return apply
 
 
 def compile_segment_cached(cache: dict, stages: Sequence, n: int,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           batch: int | None = None):
     """Kernel-sharing wrapper around compile_segment: stages are pure
     STRUCTURE (operand values ride as kernel inputs), so segments that
     differ only in values — e.g. RCS layers with different angles —
-    share one compiled kernel. The ONE place the cache key lives."""
-    key = (tuple(stages), n, interpret)
+    share one compiled kernel. The ONE place the cache key lives
+    (batch is part of it: a bucket's kernels are shaped for it)."""
+    key = (tuple(stages), n, interpret, batch)
     fn = cache.get(key)
     if fn is None:
-        fn = compile_segment(stages, n, interpret=interpret)
+        fn = compile_segment(stages, n, interpret=interpret, batch=batch)
         cache[key] = fn
     return fn
 

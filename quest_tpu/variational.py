@@ -31,6 +31,7 @@ computed in f32).
 
 from __future__ import annotations
 
+import weakref
 from typing import Callable, Sequence
 
 import jax
@@ -159,3 +160,57 @@ def expectation(ansatz: Callable, n: int, all_codes, coeffs,
         return total
 
     return energy
+
+
+# one jitted vmapped program per energy function (weak: a dropped fn
+# frees its trace cache with it)
+_SWEEP_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def sweep(fn: Callable, param_batch, chunk: int = None):
+    """Evaluate `fn` (an energy/ansatz function of one parameter set)
+    over a whole batch of parameter sets — the variational counterpart
+    of the batched execution engine (docs/BATCHING.md): ONE compiled
+    vmapped program per bucket, re-used across chunks, instead of a
+    Python loop of single evaluations. `chunk` bounds live memory
+    (each vmapped evaluation holds chunk x 2^n amplitudes); batch
+    sizes BUCKET like Circuit.compiled_batched (env.batch_bucket,
+    QUEST_BATCH_BUCKET) so mixed sweep sizes share one jit cache
+    entry — the pad evaluations re-run the first parameter set and are
+    sliced off. The jitted vmapped program is cached per `fn` (weakly,
+    so dropping the energy function frees it): repeated sweep() calls
+    in an optimizer loop reuse ONE trace instead of rebuilding
+    jax.jit(jax.vmap(fn)) — and with it the whole jit cache — each
+    call. Traced-parameter circuits cannot pre-compose into the
+    fixed-operand sweep kernels (their operands are data), so this is
+    the supported fast path for parameter sweeps; fixed circuits batch
+    through Circuit.compiled_batched instead."""
+    from quest_tpu.env import batch_bucket
+
+    params = jnp.asarray(param_batch)
+    total = params.shape[0]
+    per_call = total if chunk is None else max(1, min(int(chunk), total))
+    bucket = batch_bucket(per_call)
+    if chunk is None and bucket > total:
+        # mirror run_batched's implicit-bucket cap: 257 parameter sets
+        # sweep as one 256-chunk plus a padded remainder, not one
+        # 512-wide vmap doubling peak memory and wasting 255 evals
+        smaller = batch_bucket(max(1, bucket // 2))
+        if smaller < bucket:
+            bucket = smaller
+    batched = _SWEEP_CACHE.get(fn)
+    if batched is None:
+        batched = jax.jit(jax.vmap(fn))
+        _SWEEP_CACHE[fn] = batched
+    outs = []
+    for lo in range(0, total, bucket):
+        pb = params[lo:lo + bucket]
+        pad = bucket - pb.shape[0]
+        if pad:
+            pb = jnp.concatenate(
+                [pb, jnp.broadcast_to(pb[:1], (pad,) + pb.shape[1:])])
+        out = batched(pb)
+        outs.append(out[:-pad] if pad else out)
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.concatenate(outs, axis=0)

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""CI smoke gate for the batched execution engine (docs/BATCHING.md):
+asserts — CPU-side, through pure host planning (Circuit.plan_stats /
+trajectories.plan_stats, no compile, no chip) — that
+
+  * a B=256 trajectory workload at n=20 plans the SAME hbm_sweeps as
+    the unbatched (B=1) plan: launches do not scale with B;
+  * the compiled_batched plan of the headline bench circuit reports the
+    same hbm_sweeps as the unbatched fused plan;
+  * bucketing is live: B=5 and B=8 resolve to one bucket (8) under the
+    default QUEST_BATCH_BUCKET=pow2.
+
+The goldens mirror the tier-1 assertions in tests/test_batched.py; a
+planner change that moves either must update both, consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import bench
+    from quest_tpu import trajectories as T
+    from quest_tpu.env import batch_bucket
+
+    traj = bench._build_traj_circuit(20)
+    one = T.plan_stats(traj, 1)
+    many = T.plan_stats(traj, 256)
+
+    head = bench._build_circuit(24)
+    fused = head.plan_stats(batch=256)
+
+    rec = {
+        "traj20_hbm_sweeps_B1": one["hbm_sweeps"],
+        "traj20_hbm_sweeps_B256": many["hbm_sweeps"],
+        "traj20_channels": many["channels"],
+        "headline_hbm_sweeps": fused["fused"]["hbm_sweeps"],
+        "headline_batched_hbm_sweeps": fused["batched"]["hbm_sweeps"],
+        "bucket_of_5": batch_bucket(5),
+        "bucket_of_8": batch_bucket(8),
+    }
+    print(json.dumps(rec))
+    ok = True
+    if many["hbm_sweeps"] != one["hbm_sweeps"]:
+        print(f"REGRESSION: trajectory launches scale with B "
+              f"({one['hbm_sweeps']} at B=1 vs {many['hbm_sweeps']} at "
+              f"B=256)", file=sys.stderr)
+        ok = False
+    if fused["batched"]["hbm_sweeps"] != fused["fused"]["hbm_sweeps"]:
+        print("REGRESSION: compiled_batched plans a different launch "
+              "count than the unbatched fused plan", file=sys.stderr)
+        ok = False
+    if not (rec["bucket_of_5"] == rec["bucket_of_8"] == 8):
+        print("REGRESSION: batch bucketing no longer maps B=5 and B=8 "
+              "to one compiled bucket", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

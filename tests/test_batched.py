@@ -1,0 +1,485 @@
+"""Batched execution engine (ISSUE 4): B states through one sweep
+launch. Plan-level goldens (launch count independent of B — the
+acceptance metric, also gated in CI by scripts/check_batch_golden.py),
+bit-identical batched-vs-per-state execution through the interpret-mode
+kernels and the f64 banded fallback, bucketing cache discipline (one
+compiled program per bucket, CompileAuditor-pinned), the trajectory
+fast path against the eager per-shot workers AND the exact density
+engine, and the sharded engine's batch-local axis."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quest_tpu import trajectories as T
+from quest_tpu.circuit import Circuit
+from quest_tpu.ops import fusion as F
+from quest_tpu.ops import pallas_band as PB
+
+pytestmark = pytest.mark.dtype_agnostic
+
+N = 10
+EPS_F32 = 1e-4       # the sweep suite's documented f32 envelope
+EPS_F64 = 1e-11
+
+
+def _unitary_circuit(n: int = N) -> Circuit:
+    c = Circuit(n)
+    for q in range(7):
+        c.h(q)
+    c.cz(0, 8)
+    c.rz(9, 0.4)
+    c.cnot(2, 9)
+    c.ry(8, 0.3)
+    return c
+
+
+def _noisy_circuit(n: int) -> Circuit:
+    """Unitary stretches with a general-Kraus (damping: launch barrier)
+    and mixture channels on lane/sublane qubits."""
+    c = Circuit(n)
+    for q in range(7):
+        c.h(q)
+    c.cz(0, 8)
+    c.rz(9, 0.4)
+    c.damping(2, 0.3)          # lane qubit, state-dependent draw
+    c.ry(8, 0.3)
+    c.depolarising(8, 0.2)     # sublane qubit, mixture
+    c.ry(9, 0.2)
+    c.dephasing(0, 0.25)       # lane qubit, mixture
+    return c
+
+
+# ---------------------------------------------------------------------------
+# plan goldens: launches independent of B
+# ---------------------------------------------------------------------------
+
+
+def test_traj_plan_launches_independent_of_B():
+    """THE acceptance golden: a B=256 trajectory workload at n=20
+    reports the SAME hbm_sweeps as the unbatched (B=1) plan — the
+    launch count of a B-shot run does not scale with B."""
+    c = _noisy_circuit(20)
+    one = T.plan_stats(c, 1)
+    many = T.plan_stats(c, 256)
+    assert many["hbm_sweeps"] == one["hbm_sweeps"], (one, many)
+    assert many["states_per_sweep"] == 256
+    assert many["batch"] == 256
+    assert many["channels"] == 3
+    assert many["inline_channels"] == 3        # all 1q -> in-kernel
+    # every channel fused into a sweep: no XLA passthrough passes
+    assert many["hbm_sweeps"] == many["kernel_sweeps"], many
+
+
+def test_barrier_channel_bounds_sweep_merging():
+    """A general-Kraus channel (state-dependent Born draw) must LEAD its
+    launch; mixture channels fuse anywhere. The noisy circuit therefore
+    plans exactly 2 sweeps: [pre-damping stages] then [damping + rest],
+    and the barrier stage sits at position 0 of its sweep."""
+    c = _noisy_circuit(N)
+    stats = T.plan_stats(c, 8)
+    assert stats["hbm_sweeps"] == 2, stats
+    items, channels = T._traj_channels_and_items(c, N, True)
+    parts = PB.maybe_sweep(PB.segment_plan(items, N, batch=8), N)
+    for part in parts:
+        assert part[0] == "segment"
+        for j, st in enumerate(part[1]):
+            if isinstance(st, PB.BatchSelStage) and st.barrier:
+                assert j == 0, part[1]
+    # placeholder operands carry the batch through the byte budget
+    placeholders = [a for p in parts for st, a in zip(p[1], p[2])
+                    if isinstance(st, PB.BatchSelStage)]
+    assert placeholders and all(a.shape == (8, 8) for a in placeholders)
+
+
+def test_compiled_batched_plan_stats_and_explain():
+    c = _unitary_circuit()
+    rec = c.plan_stats(batch=5)
+    assert rec["batched"]["batch"] == 5
+    assert rec["batched"]["bucket"] == 8
+    assert rec["batched"]["states_per_sweep"] == 8
+    assert rec["batched"]["hbm_sweeps"] == rec["fused"]["hbm_sweeps"]
+    text = c.explain(batch=5)
+    assert "bucket 8" in text and "independent of B" in text
+
+
+# ---------------------------------------------------------------------------
+# bucketing: one compiled program per bucket
+# ---------------------------------------------------------------------------
+
+
+def test_bucketed_batch_sizes_share_one_cache_entry(compile_auditor):
+    """B=5 and B=8 both bucket to 8 and must resolve to the SAME
+    compiled program object; warm reruns (either size) trace NOTHING."""
+    c = _unitary_circuit()
+    fn5 = c.compiled_batched(5, interpret=True, donate=False)
+    fn8 = c.compiled_batched(8, interpret=True, donate=False)
+    assert fn5 is fn8
+    assert fn5.bucket == 8
+    rng = np.random.default_rng(0)
+    a5 = jnp.asarray(rng.standard_normal((5, 2, 1 << N)).astype(np.float32))
+    a8 = jnp.asarray(rng.standard_normal((8, 2, 1 << N)).astype(np.float32))
+    fn5(a5)
+    fn8(a8)                               # warm both call shapes
+    with compile_auditor as aud:
+        fn5(a5)
+        fn8(a8)
+    aud.assert_no_retrace("bucketed batched engine")
+
+
+def test_bucket_off_compiles_exact_sizes(monkeypatch):
+    monkeypatch.setenv("QUEST_BATCH_BUCKET", "off")
+    c = _unitary_circuit()
+    fn5 = c.compiled_batched(5, interpret=True, donate=False)
+    fn8 = c.compiled_batched(8, interpret=True, donate=False)
+    assert fn5 is not fn8
+    assert fn5.bucket == 5 and fn8.bucket == 8
+
+
+def test_oversized_batch_rejected():
+    c = _unitary_circuit()
+    fn = c.compiled_batched(4, interpret=True, donate=False)
+    with pytest.raises(ValueError, match="bucket"):
+        fn(jnp.zeros((5, 2, 1 << N), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# execution: batched == per-state, f32 kernels and f64 fallback
+# ---------------------------------------------------------------------------
+
+
+def test_batched_matches_per_state_f32():
+    c = _unitary_circuit()
+    rng = np.random.default_rng(1)
+    amps = rng.standard_normal((5, 2, 1 << N)).astype(np.float32)
+    got = np.asarray(c.compiled_batched(5, interpret=True,
+                                        donate=False)(jnp.asarray(amps)))
+    ref = c.compiled_fused(N, False, donate=False, interpret=True)
+    want = np.stack([
+        np.asarray(ref(jnp.asarray(amps[i]).reshape(2, -1, PB.LANES))
+                   ).reshape(2, -1) for i in range(5)])
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=EPS_F32 * scale, rtol=0)
+
+
+def test_batched_matches_per_state_f64_limb():
+    """f64 batches ride the vmapped banded program at full precision."""
+    c = _unitary_circuit()
+    rng = np.random.default_rng(2)
+    amps = rng.standard_normal((3, 2, 1 << N)).astype(np.float64)
+    got = np.asarray(c.compiled_batched(3, interpret=True,
+                                        donate=False)(jnp.asarray(amps)))
+    ref = c.compiled_banded(N, False, donate=False)
+    want = np.stack([np.asarray(ref(jnp.asarray(amps[i])))
+                     for i in range(3)])
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=EPS_F64 * scale, rtol=0)
+
+
+def test_batch_one_mixed_segment_xla_plan():
+    """REGRESSION: compiled_batched(1) on a plan that mixes kernel
+    segments with vmapped XLA passthroughs. compile_segment used to key
+    batched-ness on batch > 1, so the B=1 bucket got the UNBATCHED
+    kernel (3D output, leading batch axis dropped) and the vmapped
+    passthrough then mapped over the plane axis — a TypeError here, or
+    silently corrupt amplitudes for passthroughs whose reshape happens
+    to be size-compatible. batch=None now means unbatched; any integer
+    bucket (including 1) keeps the (B, 2, rows, 128) convention."""
+    c = Circuit(N)
+    for q in range(4):
+        c.h(q)
+    u = np.eye(8, dtype=np.complex64)
+    u[6, 6], u[6, 7], u[7, 6], u[7, 7] = 0, 1, 1, 0
+    c.gate(u, (0, 2, 9))       # 3-qubit cross-band: XLA passthrough
+    c.ry(8, 0.3)
+    parts = PB.maybe_sweep(PB.segment_plan(
+        F.plan(c._planned_flat(N, False), N, bands=PB.plan_bands(N)),
+        N), N)
+    assert [p[0] for p in parts] == ["segment", "xla", "segment"], parts
+    amps = np.zeros((1, 2, 1 << N), dtype=np.float32)
+    amps[0, 0, 0] = 1.0
+    got = np.asarray(c.compiled_batched(1, interpret=True,
+                                        donate=False)(jnp.asarray(amps)))
+    want = np.asarray(c.compiled(N, False, donate=False)(amps[0]))
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got[0], want, atol=EPS_F32 * scale,
+                               rtol=0)
+
+
+def test_zero_padding_is_exact():
+    """A padded bucket (B=3 -> 8) returns bit-identical results to the
+    full-bucket run's first 3 states: every engine op is a linear map,
+    so zero padding states cannot leak into real ones."""
+    c = _unitary_circuit()
+    rng = np.random.default_rng(3)
+    amps8 = rng.standard_normal((8, 2, 1 << N)).astype(np.float32)
+    fn = c.compiled_batched(8, interpret=True, donate=False)
+    full = np.asarray(fn(jnp.asarray(amps8)))
+    part = np.asarray(fn(jnp.asarray(amps8[:3])))
+    np.testing.assert_array_equal(part, full[:3])
+
+
+# ---------------------------------------------------------------------------
+# trajectories fast path
+# ---------------------------------------------------------------------------
+
+
+def test_run_batched_matches_eager_per_shot_banded():
+    """Batched trajectory shots reproduce the eager module functions
+    shot-for-shot on identical keys: same branch draws, same amplitudes
+    (the per-state unbatched reference)."""
+    import quest_tpu as qt
+    from quest_tpu.state import basis_planes
+
+    n = 4
+    c = Circuit(n)
+    c.h(0).cnot(0, 1).ry(2, 0.7)
+    c.damping(0, 0.3)
+    c.depolarising(1, 0.2)
+    c.h(3)
+    c.dephasing(2, 0.25)
+    key = jax.random.key(11)
+    planes, draws = T.run_batched(c, key, 8, engine="banded")
+    keys = jax.random.split(key, 8)
+
+    def eager_shot(k):
+        a = basis_planes(0, n=n, rdt=jnp.float32)
+        a = qt.variational.h(a, n, 0)
+        a = qt.variational.cnot(a, n, 0, 1)
+        a = qt.variational.ry(a, n, 2, 0.7)
+        a, k, d0 = T.damping(a, k, n, 0, 0.3)
+        a, k, d1 = T.depolarising(a, k, n, 1, 0.2)
+        a = qt.variational.h(a, n, 3)
+        a, k, d2 = T.dephasing(a, k, n, 2, 0.25)
+        return a, jnp.stack([d0, d1, d2])
+
+    want = [eager_shot(keys[i]) for i in range(8)]
+    want_planes = np.stack([np.asarray(w[0]) for w in want])
+    want_draws = np.stack([np.asarray(w[1]) for w in want])
+    np.testing.assert_array_equal(np.asarray(draws), want_draws)
+    np.testing.assert_allclose(np.asarray(planes), want_planes,
+                               atol=EPS_F32, rtol=0)
+
+
+def test_run_batched_fused_matches_banded():
+    """The batched KERNEL path (BatchSelStage channels on lane and
+    sublane qubits, interpret mode) draws identically to and matches
+    the vmapped banded path within the f32 envelope."""
+    c = _noisy_circuit(N)
+    key = jax.random.key(7)
+    pb, db = T.run_batched(c, key, 4, engine="banded")
+    pf, df = T.run_batched(c, key, 4, engine="fused", interpret=True)
+    np.testing.assert_array_equal(np.asarray(db), np.asarray(df))
+    np.testing.assert_allclose(np.asarray(pf), np.asarray(pb),
+                               atol=EPS_F32, rtol=0)
+
+
+def test_run_batched_host_matches_banded():
+    """The native HOST engine (the off-chip default: C++ blocked
+    kernels + native channel butterflies, jax draws) takes the same
+    branches and matches the banded engine's amplitudes."""
+    from quest_tpu import host as H
+    if not H.available():
+        pytest.skip("native host library unavailable")
+    c = _noisy_circuit(N)
+    key = jax.random.key(7)
+    pb, db = T.run_batched(c, key, 8, engine="banded")
+    ph, dh = T.run_batched(c, key, 8, engine="host")
+    np.testing.assert_array_equal(np.asarray(db), np.asarray(dh))
+    np.testing.assert_allclose(np.asarray(ph), np.asarray(pb),
+                               atol=EPS_F32, rtol=0)
+
+
+def test_run_batched_scattered_qubit_channel():
+    """BatchSelStage's third geometry: a channel on a SCATTERED qubit
+    (>= 14) butterflies on per-state scalars inside the kernel."""
+    n = 15
+    c = Circuit(n)
+    c.h(14).ry(14, 0.4)
+    c.depolarising(14, 0.3)
+    c.rz(14, 0.2)
+    key = jax.random.key(3)
+    pb, db = T.run_batched(c, key, 4, engine="banded")
+    pf, df = T.run_batched(c, key, 4, engine="fused", interpret=True)
+    np.testing.assert_array_equal(np.asarray(db), np.asarray(df))
+    np.testing.assert_allclose(np.asarray(pf), np.asarray(pb),
+                               atol=EPS_F32, rtol=0)
+
+
+def test_run_batched_chunking_and_bucket_reuse():
+    """Chunked runs slice the SAME compiled program across chunks and
+    concatenate to the unchunked result (identical keys per shot)."""
+    c = _noisy_circuit(N)
+    key = jax.random.key(5)
+    p1, d1 = T.run_batched(c, key, 6, engine="banded")
+    p2, d2 = T.run_batched(c, key, 6, engine="banded", chunk=4)
+    # chunked draws match shot-for-shot (same per-shot keys)...
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    # ...and amplitudes agree within the f32 envelope (bucket size may
+    # legally reassociate XLA reductions)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(p1),
+                               atol=EPS_F32, rtol=0)
+    assert p2.shape == (6, 2, 1 << N)
+
+
+def test_run_batched_observable_reduction():
+    """`observable=` reduces each chunk before the next one runs (no
+    shots x 2^n materialization) and matches reducing the full planes."""
+    c = _noisy_circuit(N)
+    key = jax.random.key(9)
+
+    def z_top(planes):
+        v = (planes[:, 0] ** 2 + planes[:, 1] ** 2).reshape(
+            planes.shape[0], 2, -1)
+        return jnp.sum(v[:, 0] - v[:, 1], axis=1)
+
+    planes, d1 = T.run_batched(c, key, 6, engine="banded", chunk=4)
+    vals, d2 = T.run_batched(c, key, 6, engine="banded", chunk=4,
+                             observable=z_top)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_allclose(np.asarray(vals),
+                               np.asarray(z_top(planes)),
+                               atol=1e-6, rtol=0)
+    assert vals.shape == (6,)
+
+
+def test_trajectory_estimator_matches_density_engine():
+    """The batched estimator converges to the exact density engine —
+    the same pin tests/test_trajectories.py holds for the eager path,
+    here through run_batched."""
+    from quest_tpu.ops import channels as ch
+    from quest_tpu.state import to_dense
+    import quest_tpu as qt
+
+    n = 3
+    c = Circuit(n)
+    c.h(0).cnot(0, 1).ry(2, 0.7)
+    c.damping(0, 0.3)
+    c.depolarising(1, 0.2)
+    planes, _ = T.run_batched(c, jax.random.key(11), 4096,
+                              engine="banded")
+    got = np.asarray(T.average_density(planes))
+
+    q = qt.create_density_qureg(n, dtype=np.complex128)
+    from quest_tpu.ops import gates as G
+    q = G.hadamard(q, 0)
+    q = G.controlled_not(q, 0, 1)
+    q = G.rotate_y(q, 2, 0.7)
+    q = ch.mix_damping(q, 0, 0.3)
+    q = ch.mix_depolarising(q, 1, 0.2)
+    want = to_dense(q)
+    assert np.max(np.abs(got - want)) < 0.05
+
+
+def test_kraus_validation_runs_once_for_batched_shots(monkeypatch):
+    """The hoist regression (ISSUE 4 satellite): B=64 shots of a kraus
+    channel validate the CPTP condition EXACTLY once — at plan time —
+    not once per shot/trace."""
+    from quest_tpu import validation as val
+
+    calls = {"n": 0}
+    real = val.validate_kraus_ops
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(val, "validate_kraus_ops", counting)
+    T._VALIDATED_KRAUS.clear()
+    n = 4
+    c = Circuit(n)
+    c.h(0)
+    # a channel shape the memo has not seen (unique probability)
+    c.damping(1, 0.3141592)
+    calls["n"] = 0                 # drop the build-time validation
+    T._VALIDATED_KRAUS.clear()
+    planes, draws = T.run_batched(c, jax.random.key(0), 64,
+                                  engine="banded")
+    assert planes.shape[0] == 64
+    assert calls["n"] == 1, calls
+
+
+def test_eager_kraus_validation_memoized(monkeypatch):
+    """The eager path's per-shot Python loop also validates once per
+    distinct channel (the memo), not once per call."""
+    from quest_tpu import validation as val
+    from quest_tpu.ops import matrices as M
+    from quest_tpu.state import basis_planes
+
+    calls = {"n": 0}
+    real = val.validate_kraus_ops
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(val, "validate_kraus_ops", counting)
+    T._VALIDATED_KRAUS.clear()
+    n = 3
+    key = jax.random.key(1)
+    ops = M.damping_kraus(0.2718281)
+    amps = basis_planes(1, n=n, rdt=jnp.float32)
+    for _ in range(8):
+        _, key, _ = T.kraus(amps, key, n, 0, ops)
+    assert calls["n"] == 1, calls
+
+
+# ---------------------------------------------------------------------------
+# sharded: batch axis local to the amplitude mesh
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_batched_matches_per_state():
+    from quest_tpu.parallel.mesh import make_amp_mesh
+
+    n = 11                      # local_n = 10: kernel tier per shard
+    mesh = make_amp_mesh(2)
+    c = Circuit(n)
+    for q in range(7):
+        c.h(q)
+    c.cz(0, 9)
+    c.rz(10, 0.4)
+    c.cnot(2, 10)               # global-qubit work: vmapped ppermute
+    rng = np.random.default_rng(3)
+    amps = rng.standard_normal((3, 2, 1 << n)).astype(np.float32)
+    fn3 = c.compiled_sharded_batched(3, mesh, donate=False,
+                                     interpret=True)
+    fn4 = c.compiled_sharded_batched(4, mesh, donate=False,
+                                     interpret=True)
+    assert fn3 is fn4           # same bucket, one compiled program
+    got = np.asarray(fn3(jnp.asarray(amps)))
+    ref = c.compiled_sharded_fused(n, False, mesh=mesh, donate=False,
+                                   interpret=True)
+    want = np.stack([np.asarray(ref(jnp.asarray(amps[i]))).reshape(2, -1)
+                     for i in range(3)])
+    scale = max(1.0, float(np.max(np.abs(want))))
+    np.testing.assert_allclose(got, want, atol=EPS_F32 * scale, rtol=0)
+    text = c.explain_sharded(mesh, engine="fused", batch=3)
+    assert "LOCAL to the amplitude mesh" in text
+
+
+# ---------------------------------------------------------------------------
+# variational sweep helper
+# ---------------------------------------------------------------------------
+
+
+def test_variational_sweep_matches_loop():
+    from quest_tpu import variational as V
+
+    n = 3
+
+    def ansatz(amps, params):
+        amps = V.ry(amps, n, 0, params[0])
+        amps = V.cnot(amps, n, 0, 1)
+        amps = V.rz(amps, n, 1, params[1])
+        return amps
+
+    codes = [[3, 3, 0]]
+    energy = V.expectation(ansatz, n, codes, [1.0])
+    rng = np.random.default_rng(4)
+    batch = rng.uniform(0, 2 * np.pi, size=(5, 2)).astype(np.float32)
+    got = np.asarray(V.sweep(energy, batch, chunk=4))
+    want = np.asarray([energy(b) for b in batch])
+    np.testing.assert_allclose(got, want, atol=1e-6, rtol=0)

@@ -206,6 +206,111 @@ def test_ql002_clean_sweep_driver_passes(tmp_path):
     assert not [v for v in vs if v.rule == "QL002"], vs
 
 
+def test_ql002_fires_on_unpinned_batch_grid_arithmetic(tmp_path):
+    """The BATCHED sweep-driver shape (ISSUE 4): the leading batch grid
+    dimension's index arithmetic — unraveling the fori_loop step into
+    (batch, *grid) program ids with lax.div/rem — must pin i32 operands
+    like every other slot computation; a bare Python-int divisor traces
+    as i64 under x64 and the mixed-dtype div fails Mosaic
+    legalization."""
+    vs = _lint_fixture(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _batched_kernel(in_hbm, out_hbm, *, steps, nbuf, nbatch):
+            def body(scratch, sems):
+                def step_body(s, _):
+                    bsel = jax.lax.div(s, 64)
+                    slot = jax.lax.rem(s, jnp.int32(nbuf))
+                    return jnp.int32(0)
+                jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps),
+                                  step_body, jnp.int32(0))
+            pl.run_scoped(body, scratch=pltpu.VMEM((2, 8, 128),
+                                                   jnp.float32),
+                          sems=pltpu.SemaphoreType.DMA((2,)))
+
+        def compile_batched(steps, nbatch):
+            kernel = functools.partial(_batched_kernel, steps=steps,
+                                       nbuf=3, nbatch=nbatch)
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32))
+    """, name="badbatch.py")
+    lines = sorted(v.line for v in vs if v.rule == "QL002")
+    assert lines == [11], vs              # the bare-int lax.div only
+
+
+def test_ql002_clean_batch_grid_driver_passes(tmp_path):
+    """The shipped batched-driver idiom (batch quotient via pinned i32
+    div, slot via pinned rem) stays clean."""
+    vs = _lint_fixture(tmp_path, """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _batched_kernel(in_hbm, out_hbm, *, steps, nbuf, nbatch):
+            def body(scratch, sems):
+                def step_body(s, _):
+                    bsel = jax.lax.div(s, jnp.int32(steps // nbatch))
+                    slot = jax.lax.rem(s, jnp.int32(nbuf))
+                    return jnp.int32(0)
+                jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps),
+                                  step_body, jnp.int32(0))
+            pl.run_scoped(body, scratch=pltpu.VMEM((2, 8, 128),
+                                                   jnp.float32),
+                          sems=pltpu.SemaphoreType.DMA((2,)))
+
+        def compile_batched(steps, nbatch):
+            kernel = functools.partial(_batched_kernel, steps=steps,
+                                       nbuf=3, nbatch=nbatch)
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32))
+    """, name="goodbatch.py")
+    assert not [v for v in vs if v.rule == "QL002"], vs
+
+
+def test_batch_bucket_knob_registry_coverage(tmp_path):
+    """QUEST_BATCH_BUCKET coverage of the registry rules: a registry
+    read (knob_value) on a jit-reachable path passes QL001 because the
+    knob is registered KEYED; a direct os.environ read of the same knob
+    fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_BATCH_BUCKET") == "pow2":
+                return amps
+            return amps * 2
+
+        def configure():
+            return os.environ.get("QUEST_BATCH_BUCKET")
+    """, name="bucketknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and "bypasses" in q4[0].message, vs
+
+
+def test_batch_bucket_knob_is_keyed_with_flips():
+    """The bucketing knob must stay keyed (it selects which compiled
+    program a batched call resolves to) and flip-auditable — the
+    knob-flip audit sweeps every keyed knob automatically, so this pin
+    keeps QUEST_BATCH_BUCKET in that sweep."""
+    from quest_tpu.env import KNOBS, batch_bucket
+    k = KNOBS["QUEST_BATCH_BUCKET"]
+    assert k.scope == "keyed" and k.layer == "planner"
+    assert k.flips == ("pow2", "off")
+    assert batch_bucket(5) in (5, 8)      # honors the active knob
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax
