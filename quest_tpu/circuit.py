@@ -1246,6 +1246,27 @@ class Circuit:
         self._compiled[key] = wrapper
         return wrapper
 
+    def program_key(self, density: bool = False, interpret: bool = False,
+                    dtype=np.float32) -> Tuple:
+        """Hashable PROGRAM IDENTITY of the batched-engine program
+        family this circuit resolves to — the serving layer's
+        batch-compatibility rule (quest_tpu.serve, docs/SERVING.md):
+        two requests may share one `compiled_batched` launch iff their
+        program keys are EQUAL. The key carries the circuit object
+        itself (op lists are compared by identity, not value — holding
+        the object also pins its id, so a GC'd-then-reused id can never
+        alias two circuits, the id(mesh) bug class of VERDICT r3), the
+        op count (a circuit mutated after submit forms a new family),
+        the register kind/size, the plane dtype (f32 rides the kernels,
+        f64 the banded fallback — different programs), the interpret
+        flag, and `engine_mode_key()` (a keyed-knob flip changes which
+        program a batched call resolves to). Bucket size is NOT part of
+        the identity: all buckets of one family share the planner and
+        the per-bucket wrapper cache (docs/BATCHING.md)."""
+        n = self.num_qubits * 2 if density else self.num_qubits
+        return ("batched", self, len(self.ops), n, density, interpret,
+                np.dtype(dtype).str, _engine_mode_key())
+
     def apply_batched(self, amps_b, density: bool = False,
                       donate: bool = False, interpret: bool = False):
         """Apply this circuit to a (B, 2, 2^n) batch of raw amplitude

@@ -61,7 +61,7 @@ class Knob:
     default: Any                    # value when unset (callable = dynamic)
     scope: str                      # "keyed" | "import_once" | "runtime"
     layer: str                      # subsystem: apply|planner|host|kernel|
-                                    #            infra|bench|test|build
+                                    #            infra|bench|test|build|serve
     doc: str                        # one-liner (docs/CONFIG.md parity)
     malformed: Optional[str] = None     # sample raw value parse() must
                                         # reject (None: every string parses)
@@ -259,6 +259,28 @@ _KNOB_LIST = (
          doc="build the native host engine under AddressSanitizer "
              "(native/Makefile, CI job; shell-only)",
          malformed="on"),
+    Knob("QUEST_SERVE_MAX_WAIT_MS",
+         _int_range("QUEST_SERVE_MAX_WAIT_MS", 0), 5,
+         scope="runtime", layer="serve",
+         doc="max milliseconds a serve request may wait for its bucket "
+             "to fill before the partial batch launches (default: 5); "
+             "0 = no coalescing, every request launches alone (the "
+             "bench baseline mode)",
+         malformed="-1"),
+    Knob("QUEST_SERVE_MAX_QUEUE",
+         _int_range("QUEST_SERVE_MAX_QUEUE", 1), 1024,
+         scope="runtime", layer="serve",
+         doc="bounded pending-request depth of ServeEngine; the "
+             "overflowing submit raises RejectedError — loud "
+             "backpressure, never a silent drop (default: 1024)",
+         malformed="0"),
+    Knob("QUEST_SERVE_MAX_BATCH",
+         _int_range("QUEST_SERVE_MAX_BATCH", 1), 64,
+         scope="runtime", layer="serve",
+         doc="max states coalesced into one serve launch; a queue "
+             "reaching this many pending states dispatches immediately "
+             "(default: 64)",
+         malformed="0"),
     Knob("_QUEST_DRYRUN_BOOTSTRAPPED", _parse_choice(
          "_QUEST_DRYRUN_BOOTSTRAPPED", ("1",)), None,
          scope="runtime", layer="infra",

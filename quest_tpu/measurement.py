@@ -189,6 +189,10 @@ def _sample_traced(amps, key, *, n, density, num_shots):
     return jnp.searchsorted(cdf, u, side="right").astype(jnp.int32)
 
 
+# jitted shard_map sampling wrappers, keyed (mesh, n, density, drawn, D)
+_SHARDED_SAMPLE_RUNS: dict = {}
+
+
 def _sample_sharded_body(amps, key, *, n, density, num_shots, D):
     """Per-shard inverse-CDF sampling: local CDFs + a D-scalar all_gather
     carry (the only cross-shard traffic). Every device draws the SAME
@@ -234,13 +238,26 @@ def sample(q: Qureg, num_shots: int, key=None) -> jax.Array:
     re-prepare the state per shot); batched sampling is the TPU-native
     replacement. Sharded registers sample in place: per-shard CDFs with a
     scalar carry, no state gather. Returns an int array of basis-state
-    indices."""
+    indices.
+
+    The COMPILED shot count is bucketed: `num_shots` pads up to
+    `env.batch_bucket(num_shots)` (pow2 under the default
+    QUEST_BATCH_BUCKET=pow2) inside the traced draw and the surplus
+    slices off after, so a serving workload sweeping shot counts —
+    shots=100, 120, 128 — shares ONE compiled program per bucket
+    instead of retracing per distinct count (the same bucketing
+    discipline as compiled_batched, docs/BATCHING.md; pinned
+    zero-retrace in tests/test_serve.py). Each returned shot is still
+    an independent inverse-CDF draw; only how many uniforms the traced
+    program draws is padded."""
     if num_shots < 1:
         raise val.QuESTError("Invalid number of shots: must be positive.")
     if key is None:
         # derive from the seeded host stream, so seedQuEST makes the whole
         # program — including sampling — reproducible like the reference
         key = jax.random.PRNGKey(int(rng.uniform() * (1 << 31)))
+    from quest_tpu.env import batch_bucket
+    drawn = batch_bucket(num_shots)
     sh = getattr(q.amps, "sharding", None)
     mesh = getattr(sh, "mesh", None)
     if mesh is not None and mesh.devices.size > 1:
@@ -249,12 +266,22 @@ def sample(q: Qureg, num_shots: int, key=None) -> jax.Array:
         from quest_tpu.env import AMP_AXIS
 
         if AMP_AXIS in mesh.axis_names:
-            body = partial(_sample_sharded_body, n=q.num_state_qubits,
-                           density=q.is_density, num_shots=num_shots,
-                           D=int(mesh.devices.size))
-            from quest_tpu import compat
-            run = jax.jit(compat.shard_map(
-                body, mesh, (P(None, AMP_AXIS), P()), P()))
-            return run(q.amps, key)
+            # cache the jitted shard_map per (mesh, register, bucket):
+            # rebuilding the wrapper every call would retrace every
+            # sample — the bucketing above only pays off if the wrapper
+            # survives between calls. Holding the mesh OBJECT in the key
+            # (not id(mesh)) pins it so a reused id can never alias.
+            ck = (mesh, q.num_state_qubits, q.is_density, drawn,
+                  int(mesh.devices.size))
+            run = _SHARDED_SAMPLE_RUNS.get(ck)
+            if run is None:
+                body = partial(_sample_sharded_body, n=q.num_state_qubits,
+                               density=q.is_density, num_shots=drawn,
+                               D=int(mesh.devices.size))
+                from quest_tpu import compat
+                run = _SHARDED_SAMPLE_RUNS[ck] = jax.jit(compat.shard_map(
+                    body, mesh, (P(None, AMP_AXIS), P()), P()))
+            return run(q.amps, key)[:num_shots]
     return _sample_traced(q.amps, key, n=q.num_state_qubits,
-                          density=q.is_density, num_shots=num_shots)
+                          density=q.is_density, num_shots=drawn
+                          )[:num_shots]

@@ -78,44 +78,60 @@ def matmul_precision():
     return _matmul_precision
 
 
-_CACHE_STATS = {"hits": 0, "misses": 0, "dir": None}
+_CACHE_STATS = {"dir": None}
 _cache_listener_installed = False
 
 
+def _cache_counters():
+    """The structured persistent-cache tallies: counters
+    `compile_cache_hits` / `compile_cache_misses` in the serving metrics
+    registry (quest_tpu.serve.metrics.REGISTRY — stdlib-only, safe to
+    import from here). What used to be a stderr-scrape-only summary is
+    now programmatically readable: `serve.metrics.snapshot()` carries
+    the tallies, and the stderr lines below are DERIVED from these
+    counters rather than a private dict."""
+    from quest_tpu.serve import metrics as M
+    return (M.REGISTRY.counter("compile_cache_hits"),
+            M.REGISTRY.counter("compile_cache_misses"))
+
+
 def _install_cache_listener() -> None:
-    """Register a jax monitoring listener that logs persistent-cache
-    hits/misses on stderr: every MISS is announced as it happens (a
-    miss is when you pay the compile — the f64-26q warmup is ~297 s on
-    chip), hits are counted and summarized at exit so repeat bench runs
-    show what the cache saved without per-dispatch spam. Left installed
-    for the process lifetime (jax 0.4.x has no public unregister), like
+    """Register a jax monitoring listener that tallies persistent-cache
+    hits/misses into serve.metrics counters and logs them on stderr:
+    every MISS is announced as it happens (a miss is when you pay the
+    compile — the f64-26q warmup is ~297 s on chip), hits are counted
+    and summarized at exit so repeat bench runs show what the cache
+    saved without per-dispatch spam. Left installed for the process
+    lifetime (jax 0.4.x has no public unregister), like
     analysis.audit.CompileAuditor's listener."""
     global _cache_listener_installed
     if _cache_listener_installed:
         return
     import atexit
     import sys
+    hits, misses = _cache_counters()
+
     from jax._src import monitoring
 
     def on_event(event: str, **kw) -> None:
         if event.endswith("/cache_hits"):
-            _CACHE_STATS["hits"] += 1
-            if _CACHE_STATS["hits"] == 1:
+            hits.inc()
+            if hits.value == 1:
                 print(f"[quest_tpu] compile cache HIT "
                       f"({_CACHE_STATS['dir']})", file=sys.stderr,
                       flush=True)
         elif event.endswith("/cache_misses"):
-            _CACHE_STATS["misses"] += 1
+            misses.inc()
             print(f"[quest_tpu] compile cache MISS "
-                  f"#{_CACHE_STATS['misses']} (compiling; cached for "
+                  f"#{misses.value} (compiling; cached for "
                   f"the next run)", file=sys.stderr, flush=True)
 
     monitoring.register_event_listener(on_event)
 
     def summary() -> None:
-        if _CACHE_STATS["hits"] or _CACHE_STATS["misses"]:
-            print(f"[quest_tpu] compile cache: {_CACHE_STATS['hits']} "
-                  f"hit(s), {_CACHE_STATS['misses']} miss(es) "
+        if hits.value or misses.value:
+            print(f"[quest_tpu] compile cache: {hits.value} "
+                  f"hit(s), {misses.value} miss(es) "
                   f"({_CACHE_STATS['dir']})", file=sys.stderr, flush=True)
 
     atexit.register(summary)
@@ -129,8 +145,11 @@ def enable_compile_cache(path: str = None,
     programs are compile-dominated on first run). The default location
     is `.jax_cache` under the repo so the cache survives /tmp cleanups
     and rides along with checkouts; override with `path` or the
-    QUEST_COMPILE_CACHE_DIR knob (docs/CONFIG.md). Hits/misses are
-    logged on stderr (_install_cache_listener)."""
+    QUEST_COMPILE_CACHE_DIR knob (docs/CONFIG.md). Hits/misses tally
+    into the `compile_cache_hits`/`compile_cache_misses` counters of
+    `quest_tpu.serve.metrics` (programmatically readable via
+    `metrics.snapshot()`) and are logged on stderr, derived from those
+    counters (_install_cache_listener)."""
     import os
 
     import jax

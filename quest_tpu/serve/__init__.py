@@ -1,0 +1,48 @@
+"""quest_tpu.serve — continuous-batching execution service.
+
+The request-serving runtime in front of the batched engines
+(docs/SERVING.md): `ServeEngine` coalesces compatible requests from
+many clients into full `env.batch_bucket` buckets and dispatches ONE
+batched launch per bucket; `serve.admission` supplies bounded-queue
+rejection, deadlines and cancellation; `serve.metrics` the zero-dep
+counters/histograms; `serve.warmup` pre-compiles a declared workload's
+bucket grid.
+
+`quest_tpu.serve.metrics` imports only the standard library — the
+compile-cache listener (precision.py) and scripts/serve_stats.py rely
+on that. Everything else loads lazily through this namespace so
+importing the metrics module never drags jax in.
+"""
+
+from quest_tpu.serve import metrics  # noqa: F401  (zero-dep, eager)
+# `warmup` the FUNCTION shares its name with the submodule, and a bare
+# `import quest_tpu.serve.warmup` anywhere binds the MODULE over the
+# package attribute, permanently shadowing a lazy export (the module
+# attribute is only set on the parent at first load, so importing the
+# submodule HERE and rebinding the name right after is ordering-proof).
+# warmup.py is stdlib-only at import time, so this stays jax-free.
+from quest_tpu.serve.warmup import default_buckets, warmup  # noqa: F401,E402
+
+_LAZY = {
+    "ServeEngine": ("quest_tpu.serve.engine", "ServeEngine"),
+    "RejectedError": ("quest_tpu.serve.admission", "RejectedError"),
+    "DeadlineExceeded": ("quest_tpu.serve.admission", "DeadlineExceeded"),
+    "AdmissionController": ("quest_tpu.serve.admission",
+                            "AdmissionController"),
+}
+
+__all__ = ["metrics", "default_buckets", "warmup"] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module 'quest_tpu.serve' has no "
+                             f"attribute {name!r}") from None
+    import importlib
+    mod = importlib.import_module(mod_name)
+    for k, (m, a) in _LAZY.items():
+        if m == mod_name:
+            globals()[k] = getattr(mod, a)
+    return globals()[name]

@@ -1,0 +1,96 @@
+"""Admission control for the serving engine: loud overflow, deadlines,
+cancellation — the robustness half of `quest_tpu.serve` (docs/SERVING.md).
+
+Contracts (tests/test_serve.py pins each):
+
+  * bounded queue — at most `QUEST_SERVE_MAX_QUEUE` requests may be
+    pending across the engine's queues; the overflowing submit raises
+    `RejectedError` IMMEDIATELY in the caller (loud backpressure, never
+    a silent drop or an unbounded queue hiding an overload).
+  * deadlines — a request whose relative `deadline_s` elapses while it
+    is still queued fails with `DeadlineExceeded` BEFORE dispatch: an
+    expired request never occupies a slot in a launch (its caller has
+    already given up; spending bucket occupancy on it would tax the
+    live requests). A request that was already dispatched when its
+    deadline passed completes normally — launches are never aborted.
+  * cancellation — `Future.cancel()` succeeds exactly while the request
+    is queued (not yet dispatched); the sweep drops cancelled requests
+    without charging a launch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from quest_tpu.validation import QuESTError
+
+
+class RejectedError(QuESTError):
+    """The serving queue is full: the request was REJECTED at submit
+    time (bounded queue depth, QUEST_SERVE_MAX_QUEUE). Callers should
+    back off and resubmit; the engine never drops silently."""
+
+
+class DeadlineExceeded(QuESTError):
+    """The request's deadline elapsed before dispatch; it was failed
+    without occupying a slot in any launch."""
+
+
+class AdmissionController:
+    """Queue-depth accounting and the pre-dispatch expiry/cancel sweep.
+
+    The engine holds one controller; `admit()` runs under the engine
+    lock on every submit, `sweep()` under the lock at every worker
+    wake. The controller only DECIDES — completing the failed futures
+    happens outside the lock (engine code), so user callbacks can never
+    deadlock against submit."""
+
+    def __init__(self, max_queue: int):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+
+    def admit(self, pending: int) -> None:
+        """Raise RejectedError when accepting one more request would
+        exceed the bounded queue depth."""
+        if pending + 1 > self.max_queue:
+            raise RejectedError(
+                f"Invalid operation: serve queue is full "
+                f"({pending} pending >= QUEST_SERVE_MAX_QUEUE="
+                f"{self.max_queue}); the request was rejected — back "
+                f"off and resubmit (docs/SERVING.md).")
+
+    @staticmethod
+    def expiry_of(deadline_s: Optional[float],
+                  now: Optional[float] = None) -> Optional[float]:
+        """Absolute monotonic expiry for a relative deadline (None =
+        no deadline). deadline_s <= 0 expires immediately — still
+        through the normal sweep, so metrics count it as expired."""
+        if deadline_s is None:
+            return None
+        if now is None:
+            now = time.monotonic()
+        return now + float(deadline_s)
+
+    @staticmethod
+    def sweep(requests, now: Optional[float] = None
+              ) -> Tuple[List, List, List]:
+        """Partition queued requests into (live, expired, cancelled).
+
+        `requests` is any iterable of objects with `.expiry` (absolute
+        monotonic or None) and `.future`. Cancelled futures are
+        detected via Future.cancel()'s state; expiry wins over
+        cancellation only in the sense that an expired-and-cancelled
+        request counts as cancelled (the caller already walked away)."""
+        if now is None:
+            now = time.monotonic()
+        live, expired, cancelled = [], [], []
+        for r in requests:
+            if r.future.cancelled():
+                cancelled.append(r)
+            elif r.expiry is not None and now >= r.expiry:
+                expired.append(r)
+            else:
+                live.append(r)
+        return live, expired, cancelled

@@ -1,0 +1,613 @@
+"""Continuous micro-batching execution service over the batched engines.
+
+PR 4 built bucketed batched kernels — B states riding ONE sweep-fusion
+launch (`Circuit.compiled_batched`, `trajectories.run_batched`). This
+module is the aggregation runtime in front of them, the shape inference
+stacks (and distributed simulators: mpiQulacs arXiv:2203.16044, Q-GEAR
+arXiv:2504.03967) converge on: requests from many independent clients
+coalesce into full buckets so the hardware never runs a B=1 launch when
+B=64 worth of work is queued.
+
+    engine = ServeEngine()                      # knobs: QUEST_SERVE_*
+    fut = engine.submit(circuit, state=planes)  # returns immediately
+    out = fut.result()                          # the state after circuit
+
+Model (docs/SERVING.md):
+
+  * one daemon WORKER THREAD owns all tracing/dispatch; client threads
+    only enqueue numpy payloads and wait on futures (jax tracing stays
+    single-threaded by construction).
+  * requests queue per PROGRAM IDENTITY — `Circuit.program_key()` /
+    `trajectories.program_key()`: same circuit object, register kind,
+    dtype and `engine_mode_key()`. Two requests are batch-compatible
+    iff their keys are equal; compatible requests stacked and padded to
+    the `env.batch_bucket` grid resolve to ONE compiled program per
+    bucket (the PR-4 wrapper identity — a mixed stream compiles each
+    bucket once, CompileAuditor-pinned in tests/test_serve.py).
+  * a queue dispatches when its oldest request has waited
+    `QUEST_SERVE_MAX_WAIT_MS`, when `QUEST_SERVE_MAX_BATCH` states are
+    pending, or when the engine drains. max_wait_ms=0 is the documented
+    NO-COALESCING mode: every request launches alone (the bench's
+    baseline column).
+  * admission control (serve/admission.py): bounded queue depth with
+    loud `RejectedError`, per-request deadlines failing with
+    `DeadlineExceeded` strictly BEFORE dispatch, cancellation of
+    not-yet-dispatched futures, graceful `drain()`/`close()` flushing
+    partial buckets.
+  * every hop records into `serve.metrics` (queue-wait, end-to-end
+    latency, batch occupancy, counters) — `metrics.snapshot()` is the
+    dashboard feed, `scripts/serve_stats.py` the pretty-printer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from quest_tpu.serve import metrics as M
+from quest_tpu.serve.admission import (AdmissionController,
+                                       DeadlineExceeded)
+
+
+class _Request:
+    __slots__ = ("future", "kind", "state", "shots", "key", "observable",
+                 "expiry", "submit_t", "states")
+
+    def __init__(self, kind, state, shots, key, observable, expiry,
+                 submit_t, states):
+        self.future: Future = Future()
+        self.kind = kind                  # 'apply' | 'traj'
+        self.state = state                # numpy planes (apply)
+        self.shots = shots                # int (traj)
+        self.key = key                    # jax PRNG key (traj)
+        self.observable = observable
+        self.expiry = expiry              # absolute monotonic or None
+        self.submit_t = submit_t
+        self.states = states              # slots this request occupies
+
+
+def traj_dispatch_bucket(total: int, max_batch: int) -> int:
+    """The bucket `_dispatch_traj` resolves for a batch of `total` shot
+    slots under a `max_batch` bound: `env.batch_bucket` of the bound
+    total, capped down to the largest bucket that fits (run_batched's
+    chunk=None rule — don't round a partial total up to a 2x launch).
+    `warmup` maps declared buckets through THIS function for trajectory
+    programs so the warmed grid is exactly the dispatched grid."""
+    from quest_tpu.env import batch_bucket
+    total = int(total)
+    bucket = batch_bucket(min(total, int(max_batch)))
+    if bucket > total:
+        smaller = batch_bucket(max(1, bucket // 2))
+        if smaller < bucket:
+            bucket = smaller
+    return bucket
+
+
+class _Queue:
+    __slots__ = ("circuit", "kind", "density", "engine", "requests",
+                 "pending_states")
+
+    def __init__(self, circuit, kind, density, engine):
+        self.circuit = circuit
+        self.kind = kind
+        self.density = density
+        self.engine = engine              # traj engine name or None
+        self.requests: Deque[_Request] = deque()
+        # sum(r.states) maintained incrementally: the due check runs
+        # once per popped batch under the engine lock, and a deep
+        # backlog (bench saturation queues thousands of requests)
+        # re-summing there turns the pop sweep O(n^2)
+        self.pending_states = 0
+
+
+class ServeEngine:
+    """Continuous micro-batcher over `compiled_batched` /
+    `trajectories._compiled_traj`. Thread-safe `submit()`; one worker
+    thread coalesces, launches, and demuxes. Use as a context manager
+    or call `close()` — the worker is a daemon thread, but close()
+    flushes partial buckets deterministically.
+
+    Construction keywords override the QUEST_SERVE_* knobs for THIS
+    engine (the knobs are runtime-scope: read once here, never inside
+    a compiled path): `max_wait_ms`, `max_queue`, `max_batch`.
+    `interpret=True` runs Pallas kernels in interpreter mode (CPU
+    testing); `traj_engine` pins the trajectory engine
+    ('fused'|'banded'|'host', default: resolve by backend);
+    `registry` redirects metrics (default: the process-wide one)."""
+
+    def __init__(self, *, max_wait_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 interpret: bool = False,
+                 traj_engine: Optional[str] = None,
+                 registry: Optional[M.Registry] = None):
+        from quest_tpu.env import knob_value
+        if max_wait_ms is None:
+            max_wait_ms = knob_value("QUEST_SERVE_MAX_WAIT_MS")
+        if max_queue is None:
+            max_queue = knob_value("QUEST_SERVE_MAX_QUEUE")
+        if max_batch is None:
+            max_batch = knob_value("QUEST_SERVE_MAX_BATCH")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_batch = int(max_batch)
+        self.interpret = bool(interpret)
+        self.traj_engine = traj_engine
+        self.registry = registry if registry is not None else M.REGISTRY
+        self._admission = AdmissionController(max_queue)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: Dict[tuple, _Queue] = {}
+        self._pending = 0
+        self._inflight = 0
+        self._drainers = 0                # concurrent drain() calls
+        self._closed = False
+        self._stop = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="quest-serve-worker",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- client API --------------------------------------------------------
+
+    def submit(self, circuit, state=None, shots: Optional[int] = None, *,
+               key=None, deadline_s: Optional[float] = None,
+               observable: Optional[Callable] = None,
+               density: bool = False) -> Future:
+        """Enqueue one request; returns a `concurrent.futures.Future`.
+
+        Exactly one of `state` / `shots`:
+          * `state` — (2, 2^n) amplitude planes ((2, 4^nq) for
+            `density=True`): the circuit applies through the batched
+            fused engine; the future resolves to the output planes.
+            With `observable=`, the callable reduces the bucket-shaped
+            (B, 2, 2^n) planes ON DEVICE (same convention as
+            trajectory observables) and the future resolves to this
+            request's row of its output.
+          * `shots` — that many stochastic trajectories of the
+            circuit (`trajectories.run_batched` semantics, including
+            the per-shot key chain: `key` defaults to jax.random.key(0)
+            and shot i always runs split(key, shots)[i], coalesced or
+            not — an uncoalesced request with shots <= max_batch runs
+            the IDENTICAL program and chunk sequence as the standalone
+            run_batched call; larger or coalesced batches ride a
+            different bucket program, whose per-state math is pinned
+            batch-size-invariant per engine in the tests). The future
+            resolves to (planes, draws) — or (observable(planes),
+            draws).
+
+        `deadline_s` is relative: a request still queued when it
+        elapses fails with DeadlineExceeded before any launch. Raises
+        `RejectedError` when the bounded queue is full and
+        RuntimeError after close()."""
+        if (state is None) == (shots is None):
+            raise ValueError(
+                "submit() takes exactly one of state= (apply request) "
+                "or shots= (trajectory request)")
+        now = time.monotonic()
+        if state is not None:
+            kind = "apply"
+            n = circuit.num_qubits * 2 if density else circuit.num_qubits
+            state = np.asarray(state)
+            if state.shape != (2, 1 << n):
+                raise ValueError(
+                    f"state must be (2, {1 << n}) amplitude planes for "
+                    f"this circuit, got {state.shape}")
+            qkey = circuit.program_key(density=density,
+                                       interpret=self.interpret,
+                                       dtype=state.dtype)
+            req = _Request(kind, state, None, None, observable,
+                           self._admission.expiry_of(deadline_s, now),
+                           now, 1)
+            engine_name = None
+        else:
+            from quest_tpu import trajectories as T
+            if density:
+                raise ValueError("trajectory requests are statevector "
+                                 "unravelings; density=True is invalid")
+            shots = int(shots)
+            if shots < 1:
+                raise ValueError(f"shots must be >= 1, got {shots}")
+            kind = "traj"
+            import jax
+            import jax.numpy as jnp
+            if key is None:
+                key = jax.random.key(0)
+            engine_name, qkey = T.program_key(circuit,
+                                              engine=self.traj_engine,
+                                              interpret=self.interpret)
+            # the PRNG key STYLE rides the queue key, not the program
+            # identity: a typed key (jax.random.key, impl-tagged) and a
+            # raw uint32 PRNGKey are different traced inputs, and the
+            # dispatch stacks every queued request's key data into one
+            # array — coalescing across styles would either fail the
+            # concatenate or silently re-wrap one request's key data
+            # under the other's impl (different draws than its
+            # standalone run_batched).
+            if jnp.issubdtype(getattr(key, "dtype", np.uint32),
+                              jax.dtypes.prng_key):
+                style = ("typed", str(jax.random.key_impl(key)))
+            else:
+                raw = np.asarray(key)
+                style = ("raw", raw.dtype.str, raw.shape)
+            qkey = qkey + (style,)
+            req = _Request(kind, None, shots, key, observable,
+                           self._admission.expiry_of(deadline_s, now),
+                           now, shots)
+
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("submit() after ServeEngine.close()")
+            try:
+                self._admission.admit(self._pending)
+            except Exception:
+                self.registry.counter("serve_requests_rejected").inc()
+                raise
+            q = self._queues.get(qkey)
+            if q is None:
+                q = self._queues[qkey] = _Queue(circuit, kind, density,
+                                                engine_name)
+            q.requests.append(req)
+            q.pending_states += req.states
+            self._pending += 1
+            self._cond.notify_all()
+        self.registry.counter("serve_requests_submitted").inc()
+        return req.future
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Flush every queued request NOW (partial buckets included)
+        and block until all launches complete. New submits arriving
+        mid-drain are flushed too."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._cond:
+            # a COUNT, not a bool: concurrent drains each hold the
+            # flush mode open until their own predicate turns true — a
+            # bool would let the first drain to finish (or time out)
+            # strand another drainer's mid-drain submits in the wait
+            # window
+            self._drainers += 1
+            self._cond.notify_all()
+            try:
+                while self._pending or self._inflight:
+                    t = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+                    if t == 0.0:
+                        raise TimeoutError(
+                            f"drain() timed out with {self._pending} "
+                            f"pending and {self._inflight} in-flight "
+                            f"batch(es)")
+                    self._cond.wait(t)
+            finally:
+                self._drainers -= 1
+
+    def close(self, timeout_s: Optional[float] = None) -> None:
+        """Reject new submits, drain queued work, stop the worker.
+        Idempotent."""
+        with self._cond:
+            if self._closed and not self._worker.is_alive():
+                return
+            self._closed = True
+        self.drain(timeout_s)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout_s)
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batches: List[Tuple[_Queue, List[_Request]]] = []
+            failed: List[Tuple[_Request, BaseException]] = []
+            cancelled: List[_Request] = []
+            with self._cond:
+                while True:
+                    if self._stop:
+                        return
+                    batches, failed, cancelled = self._pop_ready_locked()
+                    if batches or failed or cancelled:
+                        self._inflight += len(batches)
+                        break
+                    self._cond.wait(self._next_due_locked())
+            # complete failures/cancellations OUTSIDE the lock (user
+            # callbacks must not be able to deadlock against submit)
+            for r in cancelled:
+                self.registry.counter("serve_requests_cancelled").inc()
+            for r, exc in failed:
+                self.registry.counter("serve_requests_expired").inc()
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(exc)
+            if failed or cancelled:
+                # wake drain()/close() only AFTER the failed futures
+                # are actually completed: a notify from inside the pop
+                # (where _pending already reads 0) would let drain()
+                # return with a future the caller sees as not-yet-done
+                with self._cond:
+                    self._cond.notify_all()
+            for q, reqs in batches:
+                try:
+                    self._dispatch(q, reqs)
+                except BaseException as e:   # noqa: BLE001 - demuxed
+                    for r in reqs:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                finally:
+                    with self._cond:
+                        self._inflight -= 1
+                        self._cond.notify_all()
+
+    def _pop_ready_locked(self):
+        """Sweep expiries/cancellations, then pop every queue that is
+        due (oldest request older than max_wait, max_batch states
+        pending, draining/closing, or max_wait == 0). Returns
+        (batches, failed, cancelled); updates pending counts."""
+        now = time.monotonic()
+        batches, failed, cancelled = [], [], []
+        for qkey in list(self._queues):
+            q = self._queues[qkey]
+            live, expired, cancd = AdmissionController.sweep(q.requests,
+                                                             now)
+            if expired or cancd:
+                q.requests = deque(live)
+                q.pending_states = sum(r.states for r in live)
+            self._pending -= len(expired) + len(cancd)
+            cancelled.extend(cancd)
+            failed.extend((r, DeadlineExceeded(
+                "Invalid operation: the request's deadline "
+                f"({r.expiry - r.submit_t:.3f}s) elapsed before "
+                "dispatch; it was failed without occupying a launch "
+                "(docs/SERVING.md).")) for r in expired)
+            while q.requests:
+                due = (self._drainers or self._closed
+                       or self.max_wait_s == 0.0
+                       or now - q.requests[0].submit_t >= self.max_wait_s
+                       or q.pending_states >= self.max_batch)
+                if not due:
+                    break
+                if self.max_wait_s == 0.0 and not (self._drainers
+                                                   or self._closed):
+                    # documented no-coalescing mode: one request per
+                    # launch — the bench's honest baseline column
+                    take = [q.requests.popleft()]
+                    filled = take[0].states
+                else:
+                    take, filled = [], 0
+                    while q.requests and (
+                            not take
+                            or filled + q.requests[0].states
+                            <= self.max_batch):
+                        r = q.requests.popleft()
+                        take.append(r)
+                        filled += r.states
+                q.pending_states -= filled
+                self._pending -= len(take)
+                batches.append((q, take))
+            if not q.requests:
+                del self._queues[qkey]
+        # no notify here even when this sweep emptied the engine: the
+        # expired/cancelled futures are completed OUTSIDE the lock, so
+        # waking drain() now could let it return while a future the
+        # caller holds still reads not-done — _run notifies after the
+        # completions (and dispatch after every batch)
+        return batches, failed, cancelled
+
+    def _next_due_locked(self) -> Optional[float]:
+        """Seconds until the next queue becomes due or a deadline
+        expires (None: sleep until notified)."""
+        now = time.monotonic()
+        due = None
+        for q in self._queues.values():
+            for r in q.requests:
+                t = r.submit_t + self.max_wait_s - now
+                if r.expiry is not None:
+                    t = min(t, r.expiry - now)
+                due = t if due is None else min(due, t)
+        if due is None:
+            return None
+        return max(due, 0.0)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _start(self, reqs: List[_Request]) -> List[_Request]:
+        """Transition futures to RUNNING; drops late cancellations."""
+        started = []
+        for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                started.append(r)
+            else:
+                self.registry.counter("serve_requests_cancelled").inc()
+        return started
+
+    def _record_batch(self, reqs, occupancy: float, t_pop: float) -> None:
+        self.registry.counter("serve_batches_dispatched").inc()
+        self.registry.histogram("serve_batch_occupancy").observe(occupancy)
+        qw = self.registry.histogram("serve_queue_wait_s")
+        for r in reqs:
+            qw.observe(t_pop - r.submit_t)
+
+    def _finish(self, reqs_results) -> None:
+        done_t = time.monotonic()
+        served = self.registry.counter("serve_requests_served")
+        e2e = self.registry.histogram("serve_e2e_latency_s")
+        for r, result in reqs_results:
+            r.future.set_result(result)
+            served.inc()
+            e2e.observe(done_t - r.submit_t)
+
+    def _dispatch(self, q: _Queue, reqs: List[_Request]) -> None:
+        reqs = self._start(reqs)
+        if not reqs:
+            return
+        if q.kind == "apply":
+            self._dispatch_apply(q, reqs)
+        else:
+            self._dispatch_traj(q, reqs)
+
+    def _dispatch_apply(self, q: _Queue, reqs: List[_Request]) -> None:
+        import jax
+
+        t_pop = time.monotonic()
+        n = (q.circuit.num_qubits * 2 if q.density
+             else q.circuit.num_qubits)
+        batch = np.stack([r.state for r in reqs])
+        fn = q.circuit.compiled_batched(len(reqs), density=q.density,
+                                        donate=False,
+                                        interpret=self.interpret)
+        if len(reqs) < fn.bucket:
+            # pad to the bucket HOST-SIDE: handing the wrapper a partial
+            # batch would run its traced zero-pad, and that concatenate
+            # is a fresh XLA compile per distinct (b, bucket) pair —
+            # measured ~300 ms stalls mid-stream. numpy zeros keep the
+            # one-program-per-bucket property literal: the compiled
+            # program only ever sees bucket-shaped input.
+            batch = np.concatenate(
+                [batch, np.zeros((fn.bucket - len(reqs),) + batch.shape[1:],
+                                 batch.dtype)])
+        out_dev = jax.block_until_ready(fn(batch))
+        # AT MOST one device->host materialization for the whole batch:
+        # slicing the jax array per request would dispatch an XLA
+        # gather per future (measured 0.75 ms/request — it dominated
+        # the launch), and observable requests skip the full-planes
+        # transfer entirely — like the trajectory path, the observable
+        # reduces the CONSTANT bucket-shaped planes ON DEVICE (one
+        # compiled reduction per distinct observable per launch) and
+        # each request takes its row of the reduced values, so an
+        # observable-only batch at 24q ships per-state scalars to the
+        # host instead of bucket x 2 x 2^24 planes
+        raw_needed = any(r.observable is None for r in reqs)
+        out = np.asarray(out_dev) if raw_needed else None
+        self._record_batch(reqs, len(reqs) / fn.bucket, t_pop)
+        obs_vals: Dict[int, np.ndarray] = {}
+        results = []
+        for i, r in enumerate(reqs):
+            if r.observable is not None:
+                vals = obs_vals.get(id(r.observable))
+                if vals is None:
+                    planes_b = out_dev.reshape(fn.bucket, 2, 1 << n)
+                    vals = np.asarray(jax.block_until_ready(
+                        r.observable(planes_b)))
+                    obs_vals[id(r.observable)] = vals
+                results.append((r, vals[i]))
+            else:
+                results.append((r, out[i].reshape(2, 1 << n)))
+        self._finish(results)
+
+    def _dispatch_traj(self, q: _Queue, reqs: List[_Request]) -> None:
+        import jax
+        import jax.numpy as jnp
+        from quest_tpu import trajectories as T
+
+        t_pop = time.monotonic()
+        n = q.circuit.num_qubits
+        total = sum(r.shots for r in reqs)
+        # the per-request key chains match run_batched exactly: shot i
+        # of a request with key k runs jax.random.split(k, shots)[i],
+        # so a coalesced request reproduces its standalone run. The
+        # split stays a jax op (bit-exact parity); concatenation,
+        # chunking and padding happen on the raw key DATA in numpy —
+        # jnp.concatenate/broadcast_to here would be a fresh XLA
+        # compile per distinct (shots..., pad) shape combination, a
+        # latency stall on every new mix (same hazard as the apply
+        # path's traced zero-pad).
+        rows = [jax.random.split(r.key, r.shots) for r in reqs]
+        if jnp.issubdtype(rows[0].dtype, jax.dtypes.prng_key):
+            impl = jax.random.key_impl(rows[0])
+            data = np.concatenate([np.asarray(jax.random.key_data(k))
+                                   for k in rows])
+
+            def make_keys(d):
+                return jax.random.wrap_key_data(jnp.asarray(d), impl=impl)
+        else:
+            data = np.concatenate([np.asarray(k) for k in rows])
+            make_keys = jnp.asarray
+        # run_batched's chunk=None bucket rule (shared helper): beyond
+        # the memory rationale, this makes an UNCOALESCED request with
+        # shots <= max_batch run the IDENTICAL program + chunk sequence
+        # as its standalone run_batched call — bit-identical by
+        # construction there, not by cross-program luck. Bigger or
+        # coalesced batches necessarily ride a different bucket program
+        # (max_batch bounds the launch); their parity rests on the
+        # per-state math being batch-size-invariant, pinned per engine
+        # in tests/test_batched.py and tests/test_serve.py.
+        bucket = traj_dispatch_bucket(total, self.max_batch)
+        fn = T._compiled_traj(q.circuit, n, bucket, q.engine,
+                              self.interpret)
+        spans, lo = [], 0
+        for r in reqs:
+            spans.append((r, lo, lo + r.shots))
+            lo += r.shots
+        pieces = [([], []) for _ in reqs]   # (planes|values, draws) chunks
+        launches = 0
+        for clo in range(0, total, bucket):
+            kb = data[clo:clo + bucket]
+            pad = bucket - kb.shape[0]
+            if pad:
+                kb = np.concatenate(
+                    [kb, np.broadcast_to(kb[:1], (pad,) + kb.shape[1:])])
+            planes, draws = fn(make_keys(kb))
+            chi = min(clo + bucket, total)
+            draws_np = np.asarray(draws)
+            # demux the chunk per request: observable requests reduce
+            # ON DEVICE, chunk by chunk, mirroring run_batched's memory
+            # contract (no chunk's full planes outlive its reduction —
+            # 256 shots at 24q would otherwise materialize 32 GiB on
+            # the host) — and like run_batched the observable sees the
+            # CONSTANT bucket-shaped chunk, values sliced per request
+            # after: reducing a per-request slice would hand XLA a
+            # fresh shape per distinct span length, a fresh compile per
+            # shot-count mix mid-stream (the same stall hazard as the
+            # apply path's traced zero-pad). Requests WITHOUT an
+            # observable need their raw planes anyway, so the chunk is
+            # materialized ONCE for all of them and sliced in numpy —
+            # a device slice per request would dispatch an XLA gather +
+            # host transfer per future (the 0.75 ms/request cost the
+            # apply path avoids the same way). Pad rows sit past every
+            # request's span and are never touched.
+            overlaps = []
+            raw_needed = False
+            for i, (r, rlo, rhi) in enumerate(spans):
+                s0, s1 = max(rlo, clo) - clo, min(rhi, chi) - clo
+                if s0 >= s1:
+                    continue
+                overlaps.append((i, r, s0, s1))
+                raw_needed = raw_needed or r.observable is None
+            planes_np = (np.asarray(jax.block_until_ready(planes))
+                         if raw_needed else None)
+            obs_vals: Dict[int, np.ndarray] = {}
+            for i, r, s0, s1 in overlaps:
+                if r.observable is not None:
+                    vals = obs_vals.get(id(r.observable))
+                    if vals is None:
+                        vals = np.asarray(jax.block_until_ready(
+                            r.observable(planes)))
+                        obs_vals[id(r.observable)] = vals
+                    seg = vals[s0:s1]
+                else:
+                    seg = planes_np[s0:s1]
+                pieces[i][0].append(seg)
+                pieces[i][1].append(draws_np[s0:s1])
+            launches += 1
+        self.registry.counter("serve_batches_dispatched").inc(
+            launches - 1)                 # _record_batch adds the 1st
+        self._record_batch(reqs, total / (launches * bucket), t_pop)
+        results = []
+        for (r, _, _), (pp, dd) in zip(spans, pieces):
+            p = pp[0] if len(pp) == 1 else np.concatenate(pp, axis=0)
+            d = dd[0] if len(dd) == 1 else np.concatenate(dd, axis=0)
+            results.append((r, (p, d)))
+        self._finish(results)

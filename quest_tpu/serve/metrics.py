@@ -1,0 +1,139 @@
+"""Serving observability: counters + latency histograms, zero hot-path deps.
+
+This module deliberately imports NOTHING heavier than the standard
+library (no jax, no numpy): the hot path of the serving engine touches
+a metric on every submit/dispatch/complete, and observability must
+never be the reason a request waits. The process-wide `REGISTRY` is
+what `ServeEngine` records into by default and what
+`enable_compile_cache`'s hit/miss listener feeds (quest_tpu/precision.py
+— the stderr summary lines are DERIVED from these counters, so the
+tallies are programmatically readable instead of log-scrape-only).
+
+`snapshot()` returns one JSON-serializable dict — the schema
+tests/test_serve.py pins and scripts/serve_stats.py pretty-prints:
+
+    {"counters": {name: int, ...},
+     "histograms": {name: {"count": int, "mean": float,
+                           "p50": float, "p95": float, "p99": float},
+                    ...}}
+
+Histograms keep a bounded reservoir (the most recent `RESERVOIR`
+observations) plus exact count/sum: percentiles are over the recent
+window — the figure a serving dashboard wants — while count/mean stay
+exact for the whole process lifetime.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+RESERVOIR = 4096   # recent observations kept per histogram
+
+
+class Counter:
+    """A monotonically increasing integer metric (thread-safe)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Histogram:
+    """Observation stream with recent-window percentiles (thread-safe).
+
+    count/sum are exact over the process lifetime; p50/p95/p99 are over
+    the last `RESERVOIR` observations (sorted on demand at snapshot
+    time, never on the record path)."""
+
+    __slots__ = ("name", "_recent", "_count", "_sum", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._recent: deque = deque(maxlen=RESERVOIR)
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        with self._lock:
+            self._recent.append(x)
+            self._count += 1
+            self._sum += x
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            data = sorted(self._recent)
+            count, total = self._count, self._sum
+        if not data:
+            return {"count": count, "mean": 0.0,
+                    "p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+        def pct(q: float) -> float:
+            return data[min(len(data) - 1,
+                            max(0, int(round(q * (len(data) - 1)))))]
+
+        return {"count": count, "mean": total / max(count, 1),
+                "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+
+
+class Registry:
+    """A named set of counters and histograms. Metric creation is
+    get-or-create by name, so call sites never coordinate; `snapshot()`
+    is the one read API (stable schema, JSON-serializable)."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "histograms": {n: h.summary()
+                           for n, h in sorted(histograms.items())},
+        }
+
+
+# the process-wide default registry: ServeEngine records here unless
+# given its own; the compile-cache listener (precision.py) always does
+REGISTRY = Registry()
+
+
+def snapshot(registry: Optional[Registry] = None) -> dict:
+    """Snapshot of `registry` (default: the process-wide REGISTRY)."""
+    return (registry or REGISTRY).snapshot()

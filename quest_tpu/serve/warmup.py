@@ -1,0 +1,140 @@
+"""Cold-start control for the serving engine: pre-compile the bucket grid.
+
+A cold `ServeEngine` pays each bucket's trace+compile on the first
+request that lands in it — seconds to minutes of first-request latency
+on chip (the f64-26q warmup measured ~297 s). `warmup()` walks a
+declared workload's (circuit, bucket) grid up front, so the first real
+request is a cache hit. It composes with the persistent compile cache
+(`enable_compile_cache`, `.jax_cache`): a warmed program whose XLA
+binary is already on disk re-traces in milliseconds, and the returned
+per-program `compile_s` shows exactly which entries the disk cache
+saved (tests/test_serve.py pins that a warmed mixed stream retraces
+NOTHING — the CompileAuditor zero-retrace acceptance gate).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+
+def default_buckets(max_batch: int) -> tuple:
+    """The pow2 bucket grid up to `max_batch` — every bucket a mixed
+    stream of <= max_batch coalesced states can resolve to under
+    QUEST_BATCH_BUCKET=pow2 (env.batch_bucket)."""
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(max_batch)
+    return tuple(dict.fromkeys(buckets))
+
+
+def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
+           density: bool = False, dtype=None, key=None,
+           kind: Optional[str] = None) -> Dict:
+    """Pre-compile every (circuit, bucket) program the engine can
+    dispatch for a declared workload.
+
+    `circuits`: the Circuit objects (the SAME objects later submitted —
+    compiled programs cache on the instance). `kind` declares which
+    program family the workload will request: 'apply' (state= submits),
+    'traj' (shots= submits — always the statevector unraveling,
+    whatever `density` says: submit() rejects density trajectory
+    requests), or None (default) to infer per circuit — noisy circuits
+    (noise channels present) warm the trajectory program, unitary ones
+    the batched apply program. The inference is only a heuristic:
+    shots= submits are VALID for a unitary circuit (zero channels), so
+    a workload serving one that way must pass kind='traj' or the first
+    real request still cold-compiles. `buckets` defaults to the pow2
+    grid up to the engine's max_batch; each entry is a declared BATCH
+    SIZE (a request's shot count, a coalesced total) mapped through
+    the same bucket rule the dispatch side uses — round up to the
+    `env.batch_bucket` grid for apply programs, cap down to the
+    largest bucket that fits (`engine.traj_dispatch_bucket`,
+    run_batched's rule) for trajectory ones.
+    `dtype` must match the planes the workload will submit
+    (default f32): the plane dtype is part of `Circuit.program_key`
+    (f64 rides the banded fallback — a DIFFERENT traced program), so
+    an f64 workload warmed at f32 would still cold-compile on its
+    first real request. `key` must match the PRNG key STYLE trajectory
+    requests will submit (default `jax.random.key(0)`, the same default
+    as submit()): a typed key and a raw uint32 `jax.random.PRNGKey` are
+    different traced inputs — the style rides the engine's queue key —
+    so a raw-key workload warmed with typed keys would still
+    cold-compile its first real request.
+
+    Returns {"programs": {label: compile_s}, "total_s": float} where
+    label is "c{i}:b{bucket}" in grid order — per-program compile+warm
+    wall seconds, so operators can see what the persistent .jax_cache
+    saved (a disk hit re-traces in milliseconds)."""
+    import jax
+    import numpy as np
+
+    from quest_tpu import trajectories as T
+    from quest_tpu.env import batch_bucket
+
+    if buckets is None:
+        buckets = default_buckets(engine.max_batch)
+    buckets = tuple(dict.fromkeys(int(b) for b in buckets))
+    dtype = np.dtype(np.float32 if dtype is None else dtype)
+    if key is None:
+        key = jax.random.key(0)
+    if kind not in (None, "apply", "traj"):
+        raise ValueError(
+            f"kind must be 'apply', 'traj' or None (infer per "
+            f"circuit), got {kind!r}")
+    report: Dict[str, float] = {}
+    t_all = time.perf_counter()
+    for i, c in enumerate(circuits):
+        if kind is None:
+            noisy = any(op.kind == "superop" for op in c.ops)
+            c_kind = "traj" if noisy else "apply"
+        else:
+            c_kind = kind
+        n = c.num_qubits * 2 if density else c.num_qubits
+        warmed = set()
+        for b in buckets:
+            # map each declared batch size through the SAME bucket rule
+            # the dispatch side uses: apply requests round up to the
+            # batch_bucket grid, trajectory dispatch additionally caps
+            # down to the largest bucket that fits (engine.
+            # traj_dispatch_bucket) — warming batch_bucket(3)=4 for a
+            # shots=3 workload would leave the dispatched bucket-2
+            # program cold, the exact first-request stall warmup exists
+            # to prevent
+            if c_kind == "traj":
+                from quest_tpu.serve.engine import traj_dispatch_bucket
+                b = traj_dispatch_bucket(b, engine.max_batch)
+            else:
+                b = batch_bucket(b)
+            if b in warmed:
+                continue
+            warmed.add(b)
+            t0 = time.perf_counter()
+            if c_kind == "traj":
+                fn = T._compiled_traj(c, c.num_qubits, b,
+                                      q_engine_name(engine, c),
+                                      engine.interpret)
+                # split preserves the key style, so the traced input
+                # (typed key array vs raw uint32 (B, 2)) matches what
+                # _dispatch_traj will feed this program
+                keys = jax.random.split(key, b)
+                planes, draws = fn(keys)
+                jax.block_until_ready(planes)
+            else:
+                fn = c.compiled_batched(b, density=density, donate=False,
+                                        interpret=engine.interpret)
+                zeros = np.zeros((b, 2, 1 << n), dtype=dtype)
+                jax.block_until_ready(fn(zeros))
+            report[f"c{i}:b{b}"] = time.perf_counter() - t0
+    return {"programs": report,
+            "total_s": time.perf_counter() - t_all}
+
+
+def q_engine_name(engine, circuit) -> str:
+    """The trajectory engine name this ServeEngine would dispatch
+    `circuit` with (the same resolution submit() performs)."""
+    from quest_tpu import trajectories as T
+    return T._resolve_engine(engine.traj_engine, circuit.num_qubits,
+                             engine.interpret)

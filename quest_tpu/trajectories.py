@@ -767,6 +767,25 @@ def _compiled_traj(circuit, n: int, bucket: int, engine: str,
     return fn
 
 
+def program_key(circuit, engine: str = None, interpret: bool = False):
+    """(resolved engine name, hashable PROGRAM IDENTITY) of the batched
+    trajectory program family `run_batched` would execute for this
+    circuit — the serving layer's batch-compatibility rule for
+    trajectory requests (quest_tpu.serve, docs/SERVING.md): two shot
+    requests may coalesce into one launch iff their identities are
+    EQUAL. Mirrors Circuit.program_key: the circuit OBJECT (identity,
+    kept alive by the key), op count, register size, the resolved
+    engine, the interpret flag and engine_mode_key(). Bucket size is
+    not part of the identity (all buckets share the plan; the compiled
+    per-bucket programs cache on the circuit)."""
+    from quest_tpu.circuit import _engine_mode_key
+
+    n = circuit.num_qubits
+    engine = _resolve_engine(engine, n, interpret)
+    return engine, ("traj-batched", circuit, len(circuit.ops), n, engine,
+                    interpret, _engine_mode_key())
+
+
 def run_batched(circuit, key, shots: int, *, engine: str = None,
                 interpret: bool = False, chunk: int = None,
                 observable=None):

@@ -1,0 +1,98 @@
+"""Pretty-print a serving metrics snapshot (quest_tpu.serve.metrics).
+
+Reads one `metrics.snapshot()` dict — the stable JSON schema
+{"counters": {...}, "histograms": {name: {count, mean, p50, p95, p99}}}
+— and renders it as two aligned tables. Sources, in order:
+
+    python scripts/serve_stats.py snapshot.json    # a dumped snapshot
+    some-producer | python scripts/serve_stats.py -  # JSON on stdin
+    python scripts/serve_stats.py --demo           # run a tiny in-process
+                                                   # serve workload and
+                                                   # print ITS snapshot
+
+The demo is the zero-to-aha path (no TPU needed: interpret-mode
+kernels): it spins a ServeEngine, pushes a few dozen coalescing
+requests through, and prints what a serving dashboard would scrape —
+see docs/SERVING.md for the metric meanings.
+
+Latency histograms (`*_s` suffix) render in milliseconds; occupancy
+and other unitless histograms render as-is.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fmt(name: str, v: float) -> str:
+    if name.endswith("_s"):
+        return f"{v * 1e3:10.3f}"
+    return f"{v:10.4f}"
+
+
+def render(snap: dict, out=sys.stdout) -> None:
+    counters = snap.get("counters", {})
+    histograms = snap.get("histograms", {})
+    if counters:
+        w = max(len(n) for n in counters)
+        print("counters", file=out)
+        for n in sorted(counters):
+            print(f"  {n:<{w}}  {counters[n]}", file=out)
+    if histograms:
+        w = max(len(n) for n in histograms)
+        unit = "ms for *_s"
+        print(f"histograms (count / mean / p50 / p95 / p99; {unit})",
+              file=out)
+        print(f"  {'':<{w}}  {'count':>8} {'mean':>10} {'p50':>10} "
+              f"{'p95':>10} {'p99':>10}", file=out)
+        for n in sorted(histograms):
+            h = histograms[n]
+            row = " ".join(_fmt(n, h[k]) for k in ("mean", "p50",
+                                                   "p95", "p99"))
+            print(f"  {n:<{w}}  {h['count']:>8} {row}", file=out)
+    if not counters and not histograms:
+        print("(empty snapshot)", file=out)
+
+
+def _demo_snapshot() -> dict:
+    import numpy as np
+
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.serve import ServeEngine, metrics, warmup
+
+    n = 6
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    c.cnot(0, 1).rz(2, 0.25)
+    rng = np.random.default_rng(0)
+    states = rng.standard_normal((32, 2, 1 << n)).astype(np.float32)
+    states /= np.sqrt((states ** 2).sum(axis=(1, 2), keepdims=True))
+    reg = metrics.Registry()
+    with ServeEngine(max_wait_ms=5, max_batch=8, registry=reg) as eng:
+        warmup(eng, [c], buckets=[8])
+        for f in [eng.submit(c, state=s) for s in states]:
+            f.result(timeout=300)
+    return reg.snapshot()
+
+
+def main(argv) -> int:
+    if argv and argv[0] == "--demo":
+        render(_demo_snapshot())
+        return 0
+    if not argv or argv[0] == "-":
+        snap = json.load(sys.stdin)
+    else:
+        with open(argv[0]) as f:
+            snap = json.load(f)
+    render(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -70,11 +70,18 @@ def test_five_target_density_dual(rng):
     np.testing.assert_allclose(out, u @ rho @ u.conj().T, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_laneblock_path_matches_oracle():
     """apply_matrix routes big-register gates touching lane qubits through
     the lane-block formulation (minor dim stays 128 on TPU — tiny-axis
     views padded 64x and OOMed 24-state-qubit channels). Fuzz it against
-    the oracle at n=14, where the routing threshold is crossed."""
+    the oracle at n=14, where the routing threshold is crossed.
+
+    slow-marked (the ~105 s worst case of the whole suite: 16 fuzz
+    iterations, each a fresh multi-qubit compile + dense oracle) so
+    tier-1 fits its 870 s budget — the same discipline as the
+    test_distributed suite; CI's unfiltered `pytest tests/` and
+    `-m slow` runs keep it covered."""
     import jax.numpy as jnp
     from quest_tpu.ops import apply as A
     from . import oracle

@@ -311,6 +311,40 @@ def test_batch_bucket_knob_is_keyed_with_flips():
     assert batch_bucket(5) in (5, 8)      # honors the active knob
 
 
+def test_serve_knob_registry_coverage(tmp_path):
+    """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
+    serve knobs are RUNTIME scope — read once at ServeEngine
+    construction, never inside a compiled path — so a registry read
+    (knob_value) on a plain construction path is clean, the same read
+    on a jit-reachable path fires QL001 (a runtime knob is in no
+    compiled cache key), and a direct os.environ read fires QL004's
+    bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        def configure_engine():
+            return knob_value("QUEST_SERVE_MAX_WAIT_MS")
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_SERVE_MAX_BATCH") > 8:
+                return amps * 2
+            return amps
+
+        def bypass():
+            return os.environ.get("QUEST_SERVE_MAX_QUEUE")
+    """, name="serveknobs.py")
+    assert not [v for v in vs if v.line == 7], vs    # runtime read off-jit
+    q1 = [v for v in vs if v.rule == "QL001"]
+    assert len(q1) == 1 and q1[0].line == 11, vs
+    assert "scope='runtime'" in q1[0].message, q1
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and q4[0].line == 16, vs
+    assert "bypasses" in q4[0].message, q4
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax

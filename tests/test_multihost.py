@@ -19,7 +19,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_two_process_global_mesh():
+    # slow-marked (~23 s: spawns two subprocesses each paying a full
+    # jax import — the same multihost discipline as the slow-marked
+    # test_distributed suite) so tier-1 fits its 870 s budget; CI's
+    # unfiltered `pytest tests/` and `-m slow` runs keep it covered
     # bounded by the communicate(timeout=240) below — no plugin needed
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
