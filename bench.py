@@ -711,6 +711,16 @@ def _measure_serve(max_batch: int = 64, wait_ms: float = 5.0):
         "serve_max_batch": max_batch,
         "serve_wait_ms": wait_ms,
         "serve_loads": loads,
+        # resilience health of the bench run itself (docs/RESILIENCE.md):
+        # a nonzero restart/split/degrade count means the measured
+        # throughput rode a recovery path, not the steady state — the
+        # bench should be rerun and the cause investigated
+        "serve_worker_restarts": sat_snap["counters"].get(
+            "serve_worker_restarts", 0),
+        "serve_batches_split": sat_snap["counters"].get(
+            "serve_batches_split", 0),
+        "serve_degraded_dispatches": sat_snap["counters"].get(
+            "serve_degraded_dispatches", 0),
     }
 
 

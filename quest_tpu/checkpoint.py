@@ -34,15 +34,72 @@ from quest_tpu.state import Qureg, create_density_qureg, create_qureg
 _META_NAME = "qureg_meta.json"
 _AMPS_NAME = "amps.npz"
 _ORBAX_DIR = "orbax"
+# magic + version written since format 2: load() can tell "not a quest
+# checkpoint at all" from "a quest checkpoint from the future" from "a
+# quest checkpoint that's merely corrupt" — three different clear
+# errors instead of one leaked KeyError/BadZipFile. Version-1
+# checkpoints predate the field and load tolerantly.
+_MAGIC = "quest-checkpoint"
+_FORMAT_VERSION = 2
+
+
+class CheckpointError(validation.QuESTError):
+    """A checkpoint could not be read: missing/corrupt/truncated files
+    or metadata that does not match the register being restored. The
+    message always names the offending file and the mismatch — numpy /
+    orbax internals never leak to the caller (docs/RESILIENCE.md)."""
 
 
 def _meta(qureg: Qureg) -> dict:
     return {
+        "magic": _MAGIC,
         "num_qubits": qureg.num_qubits,
         "is_density": qureg.is_density,
         "real_dtype": str(np.dtype(qureg.real_dtype)),
-        "format_version": 1,
+        "format_version": _FORMAT_VERSION,
     }
+
+
+def _read_meta(directory: str) -> dict:
+    """Read + validate the checkpoint metadata, raising ONE clear
+    CheckpointError (naming the file and the problem) for every way the
+    file can be missing, truncated, non-JSON, not-a-checkpoint, from a
+    future format, or incomplete. Pre-magic (format 1) checkpoints load
+    tolerantly."""
+    path = os.path.join(directory, _META_NAME)
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata file {path!r} is missing — "
+            f"{directory!r} is not a checkpoint directory") from None
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata file {path!r} is corrupt or "
+            f"truncated (not parseable JSON: {e})") from e
+    if not isinstance(meta, dict):
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata file {path!r} does not hold "
+            f"a JSON object (got {type(meta).__name__})")
+    magic = meta.get("magic")
+    if magic is not None and magic != _MAGIC:
+        raise CheckpointError(
+            f"Invalid checkpoint: {path!r} carries magic {magic!r}, "
+            f"expected {_MAGIC!r} — not a quest_tpu checkpoint")
+    version = meta.get("format_version", 1)
+    if not isinstance(version, int) or version > _FORMAT_VERSION:
+        raise CheckpointError(
+            f"Invalid checkpoint: {path!r} is format_version "
+            f"{version!r}, newer than this build supports "
+            f"(<= {_FORMAT_VERSION}) — upgrade quest_tpu to load it")
+    missing = [k for k in ("num_qubits", "is_density", "real_dtype")
+               if k not in meta]
+    if missing:
+        raise CheckpointError(
+            f"Invalid checkpoint: {path!r} is missing required "
+            f"field(s) {missing}")
+    return meta
 
 
 def save(qureg: Qureg, directory: str) -> None:
@@ -55,19 +112,46 @@ def save(qureg: Qureg, directory: str) -> None:
 
 
 def load(directory: str, env=None, dtype=None) -> Qureg:
-    """Recreate a register from a checkpoint written by `save`."""
-    with open(os.path.join(directory, _META_NAME)) as f:
-        meta = json.load(f)
-    with np.load(os.path.join(directory, _AMPS_NAME)) as data:
-        planes = data["planes"]
-    rdt = np.dtype(meta["real_dtype"])
+    """Recreate a register from a checkpoint written by `save`. Every
+    failure mode — missing/corrupt/truncated files, metadata that does
+    not match the stored planes — raises CheckpointError naming the
+    file and the mismatch (never a leaked numpy/zipfile internal)."""
+    meta = _read_meta(directory)
+    amps_path = os.path.join(directory, _AMPS_NAME)
+    try:
+        with np.load(amps_path) as data:
+            if "planes" not in data:
+                raise CheckpointError(
+                    f"Invalid checkpoint: {amps_path!r} holds no "
+                    f"'planes' array (found {sorted(data.files)})")
+            planes = data["planes"]
+    except CheckpointError:
+        raise
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"Invalid checkpoint: amplitude file {amps_path!r} is "
+            f"missing") from None
+    except Exception as e:
+        # np.load surfaces truncation/corruption as BadZipFile, OSError,
+        # ValueError or EOFError depending on WHERE the bytes stop —
+        # collapse them into the one documented error
+        raise CheckpointError(
+            f"Invalid checkpoint: amplitude file {amps_path!r} is "
+            f"corrupt or truncated ({type(e).__name__}: {e})") from e
+    try:
+        rdt = np.dtype(meta["real_dtype"])
+    except TypeError as e:
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata in {directory!r} names "
+            f"unknown real_dtype {meta['real_dtype']!r}") from e
     cdt = dtype if dtype is not None else precision.complex_dtype_of(rdt)
     make = create_density_qureg if meta["is_density"] else create_qureg
     q = make(meta["num_qubits"], env=env, dtype=cdt)
     if planes.shape != q.amps.shape:
-        raise validation.QuESTError(
-            f"Invalid checkpoint: planes shape {planes.shape} does not match "
-            f"a {meta['num_qubits']}-qubit register "
+        raise CheckpointError(
+            f"Invalid checkpoint: {amps_path!r} holds planes of shape "
+            f"{tuple(planes.shape)}, which does not match the "
+            f"{meta['num_qubits']}-qubit register its metadata declares "
             f"(expected {tuple(q.amps.shape)})")
     amps = jax.device_put(jax.numpy.asarray(planes.astype(q.real_dtype)),
                           q.amps.sharding)
@@ -133,15 +217,30 @@ def load_sharded(directory: str, env=None, dtype=None) -> Qureg:
     (each device reads only its slice)."""
     ocp = _orbax()
     directory = os.path.abspath(directory)
-    with open(os.path.join(directory, _META_NAME)) as f:
-        meta = json.load(f)
-    rdt = np.dtype(meta["real_dtype"])
+    meta = _read_meta(directory)
+    try:
+        rdt = np.dtype(meta["real_dtype"])
+    except TypeError as e:
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata in {directory!r} names "
+            f"unknown real_dtype {meta['real_dtype']!r}") from e
     cdt = dtype if dtype is not None else precision.complex_dtype_of(rdt)
     make = create_density_qureg if meta["is_density"] else create_qureg
     q = make(meta["num_qubits"], env=env, dtype=cdt)
     target = jax.ShapeDtypeStruct(q.amps.shape, q.amps.dtype,
                                   sharding=q.amps.sharding)
+    orbax_dir = os.path.join(directory, _ORBAX_DIR)
     ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(os.path.join(directory, _ORBAX_DIR),
-                             {"amps": target})
+    try:
+        restored = ckptr.restore(orbax_dir, {"amps": target})
+    except Exception as e:
+        # orbax/tensorstore failures (missing dir, corrupt OCDBT shards,
+        # shape/dtype mismatch vs the target) surface as a zoo of
+        # library-internal types — collapse to the one documented error,
+        # keeping the cause chained for debugging
+        raise CheckpointError(
+            f"Invalid checkpoint: sharded payload under {orbax_dir!r} "
+            f"is missing, corrupt, or does not match the "
+            f"{meta['num_qubits']}-qubit register its metadata declares "
+            f"({type(e).__name__}: {str(e)[:300]})") from e
     return q.replace_amps(restored["amps"])

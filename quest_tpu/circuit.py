@@ -1168,7 +1168,8 @@ class Circuit:
         return q.replace_amps(fn(q.amps))
 
     def compiled_batched(self, batch: int, density: bool = False,
-                         donate: bool = True, interpret: bool = False):
+                         donate: bool = True, interpret: bool = False,
+                         engine: str = None):
         """BATCHED fused engine: ONE compiled program applying this
         circuit to a whole batch of states — (B, 2, 2^n) planes in, same
         out. Each kernel sweep carries a leading batch grid dimension
@@ -1190,12 +1191,23 @@ class Circuit:
         launch). Calls whose batches share a bucket return the SAME
         wrapper object: serving mixed batch sizes hits one persistent
         compile-cache entry instead of retracing per size
-        (tests/test_batched.py pins this with the CompileAuditor)."""
+        (tests/test_batched.py pins this with the CompileAuditor).
+
+        `engine` pins the program family instead of auto-resolving:
+        None (default) rides the Pallas kernels when the register
+        reaches the kernel tier, 'banded' FORCES the vmapped banded-XLA
+        program (the serve degradation ladder's fallback rung — it
+        must stay dispatchable when the fused compile is the thing
+        that's broken, docs/RESILIENCE.md), 'fused' demands the kernel
+        path and raises below the kernel tier."""
         self._reject_measure("compiled_batched")
+        if engine not in (None, "fused", "banded"):
+            raise ValueError(
+                f"engine must be None, 'fused' or 'banded', got {engine!r}")
         from quest_tpu.env import batch_bucket
         n = self.num_qubits * 2 if density else self.num_qubits
         bucket = batch_bucket(batch)
-        key = ("batched", n, density, donate, interpret, bucket,
+        key = ("batched", n, density, donate, interpret, bucket, engine,
                _engine_mode_key())
         fn = self._compiled.get(key)
         if fn is not None:
@@ -1204,8 +1216,13 @@ class Circuit:
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
 
+        if engine == "fused" and not PB.usable(n):
+            raise ValueError(
+                f"engine='fused' requires the kernel tier; a {n}-qubit "
+                f"register rides the banded program (engine='banded' or "
+                f"None)")
         flat = self._planned_flat(n, density)
-        use_kernels = PB.usable(n)
+        use_kernels = engine != "banded" and PB.usable(n)
         if use_kernels:
             items = F.plan(flat, n, bands=PB.plan_bands(n))
             parts = PB.maybe_sweep(PB.segment_plan(items, n), n)

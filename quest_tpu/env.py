@@ -138,6 +138,13 @@ def _parse_engine_ladder(raw: str) -> Tuple[str, ...]:
     return ladder
 
 
+def _parse_fault_plan(raw: str):
+    # the resilience package is stdlib-only at import time, so the lazy
+    # import cannot cycle back into env.py's module load
+    from quest_tpu.resilience import faults
+    return faults.parse_plan(raw)
+
+
 def _default_f64_mxu() -> bool:
     # on for TPU backends (native f64 dots are software-emulated there —
     # the measured 9 gates/s @ 26q wall, VERDICT r4), off elsewhere
@@ -281,6 +288,30 @@ _KNOB_LIST = (
              "reaching this many pending states dispatches immediately "
              "(default: 64)",
          malformed="0"),
+    Knob("QUEST_SERVE_RESTART_MAX",
+         _int_range("QUEST_SERVE_RESTART_MAX", 0), 3,
+         scope="runtime", layer="serve",
+         doc="consecutive worker-crash restarts ServeEngine's "
+             "supervisor allows (exponential backoff + jitter) before "
+             "the engine transitions to FAILED and rejects submits "
+             "(default: 3; docs/RESILIENCE.md)",
+         malformed="-1"),
+    Knob("QUEST_SERVE_BREAKER_THRESHOLD",
+         _int_range("QUEST_SERVE_BREAKER_THRESHOLD", 1), 3,
+         scope="runtime", layer="serve",
+         doc="consecutive primary-engine failures of one program before "
+             "its circuit breaker opens and requests step down the "
+             "fused->banded->host degradation ladder (default: 3; "
+             "docs/RESILIENCE.md)",
+         malformed="0"),
+    Knob("QUEST_FAULT_PLAN", _parse_fault_plan, None,
+         scope="runtime", layer="serve",
+         doc="deterministic fault-injection plan armed at engine "
+             "construction for soak runs: 'site[:key=value]...[;...]' "
+             "over the docs/RESILIENCE.md site catalog (keys: error, "
+             "after, every, times, p, seed); unset = no injection, "
+             "zero hot-path cost",
+         malformed="serve.not_a_site"),
     Knob("_QUEST_DRYRUN_BOOTSTRAPPED", _parse_choice(
          "_QUEST_DRYRUN_BOOTSTRAPPED", ("1",)), None,
          scope="runtime", layer="infra",
@@ -447,9 +478,7 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
     make late calls harmless, not useful. Current call sites honoring the
     contract: bench.py:main (first call), __graft_entry__.entry/
     dryrun_multichip (before any mesh/array work), scripts/*."""
-    import subprocess
     import sys
-    import time as _time
     from jax._src import xla_bridge as _xb
     try:
         already = bool(_xb._backends)
@@ -502,28 +531,50 @@ def ensure_live_backend(timeout_s: int = 240) -> str:
                   f"probe timeout shortened to {timeout_s}s",
                   file=sys.stderr, flush=True)
 
-    code = "import jax; print(jax.devices()[0].platform)"
-    last_err = ""
-    attempts = 3
-    for attempt in range(attempts):
-        try:
-            out = subprocess.run([sys.executable, "-c", code],
-                                 timeout=timeout_s, capture_output=True,
-                                 text=True)
-        except subprocess.TimeoutExpired:
-            last_err = f"probe timed out after {timeout_s}s (tunnel down?)"
-            break   # a hung init rarely clears quickly; don't triple the wait
-        if out.returncode == 0 and out.stdout.strip():
-            return out.stdout.strip().splitlines()[-1]
-        # fast nonzero exit: often another process holds the device's
-        # exclusive lock — that can clear, so retry before downgrading
-        last_err = (out.stderr or "").strip()[-500:]
-        if attempt < attempts - 1:
-            _time.sleep(20)
+    platform, last_err = _probe_subprocess(
+        "import jax; print(jax.devices()[0].platform)", timeout_s)
+    if platform is not None:
+        return platform
     print(f"[quest_tpu] default backend unavailable, falling back to host "
           f"CPU. Last probe error: {last_err}", file=sys.stderr, flush=True)
     jax.config.update("jax_platforms", "cpu")
     return "cpu"
+
+
+def _probe_subprocess(code: str, timeout_s: float, attempts: int = 3,
+                      retry_sleep_s: float = 20.0, *, _run=None,
+                      _sleep=None):
+    """Run the backend-probe `code` in a subprocess with bounded
+    retries; returns (platform | None, last_err). A FAST nonzero exit is
+    often another process holding the device's exclusive lock — that can
+    clear, so it retries (sleeping `retry_sleep_s`) before downgrading;
+    a TIMEOUT means a hung init that rarely clears quickly, so it breaks
+    immediately instead of tripling the wait. `_run`/`_sleep` are
+    injectable so tests/test_resilience.py can pin the contention path
+    without spawning processes (the retry-before-downgrade contract)."""
+    import subprocess
+    import sys
+    import time as _time
+    if _run is None:
+        _run = subprocess.run
+    if _sleep is None:
+        _sleep = _time.sleep
+    last_err = ""
+    for attempt in range(attempts):
+        try:
+            out = _run([sys.executable, "-c", code],
+                       timeout=timeout_s, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"probe timed out after {timeout_s}s (tunnel down?)"
+            break   # a hung init rarely clears quickly; don't triple the wait
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1], ""
+        # fast nonzero exit: often another process holds the device's
+        # exclusive lock — that can clear, so retry before downgrading
+        last_err = (out.stderr or "").strip()[-500:]
+        if attempt < attempts - 1:
+            _sleep(retry_sleep_s)
+    return None, last_err
 
 
 def _tcp_port_open(host: str, port: int, timeout_s: float = 3.0) -> bool:

@@ -30,6 +30,25 @@ _LIB_PATH = (_knob_value("QUEST_NATIVE_LIB")
 _lib = None
 _lib_tried = False
 _lock = threading.Lock()
+_degrade_warned = False
+
+
+def _warn_degrade(reason: str) -> None:
+    """One warning per process when the native library is unavailable:
+    callers silently fall back to the Python implementations (same
+    results, slower), and a silent fallback hid a dead toolchain for a
+    whole bench run once — loud ONCE, then quiet (every native.py entry
+    point re-checks `_load()` on each call, so repeating it would spam
+    a warning per RNG draw)."""
+    global _degrade_warned
+    if _degrade_warned:
+        return
+    _degrade_warned = True
+    import sys
+    print(f"[quest_tpu.native] native host library unavailable "
+          f"({reason}); degrading to the pure-Python fallbacks — same "
+          f"results, slower (build native/ or set QUEST_NATIVE_LIB)",
+          file=sys.stderr, flush=True)
 
 
 def _build() -> bool:
@@ -90,10 +109,13 @@ def _load() -> Optional[ctypes.CDLL]:
             # already-mapped old library stays valid) and a fresh dlopen
             # really sees the rebuilt code
             if not _build():
+                _warn_degrade("no library and the in-tree build failed")
                 return None
             lib = _try_open()
             if lib is None or not _isa_ok(lib):
-                return None     # degrade to the Python fallbacks
+                # degrade to the Python fallbacks
+                _warn_degrade("rebuilt library failed to load")
+                return None
         _lib = lib
         return _lib
 

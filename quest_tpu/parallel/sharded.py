@@ -1134,6 +1134,14 @@ def apply_circuit_sharded(q: Qureg, ops: Sequence, mesh: Mesh,
                           donate: bool = True) -> Qureg:
     """One-shot convenience wrapper around compile_circuit_sharded."""
     from quest_tpu.parallel.mesh import amp_sharding
+    from quest_tpu.resilience import faults as _F
+    # named fault site (docs/RESILIENCE.md): the mesh dispatch is the
+    # sharded analogue of the serve engine's launch — soak runs inject
+    # here to prove callers surface (not swallow) multi-device failures.
+    # One module-flag read when no plan is armed.
+    if _F.ACTIVE:
+        _F.check("sharded.dispatch", num_qubits=q.num_qubits,
+                 num_ops=len(ops))
     fn = compile_circuit_sharded(ops, q.num_state_qubits, q.is_density, mesh,
                                  donate)
     amps = jax.device_put(q.amps, amp_sharding(mesh))

@@ -1,0 +1,21 @@
+"""quest_tpu.resilience — fault injection, supervision, degradation.
+
+The robustness layer under the serving runtime (docs/RESILIENCE.md):
+
+  * `faults` — deterministic fault injection at named hot-path sites
+    (`FaultPlan`, the `QUEST_FAULT_PLAN` knob); zero-cost when empty.
+  * `supervisor` — bounded-restart backoff policy for the serve worker.
+  * `breaker` — per-program circuit breaker driving the fused -> banded
+    -> host degradation ladder.
+
+Everything here is standard-library-only at import time: these modules
+sit UNDER the serving engine and inside env.py's knob parser, so they
+must never drag jax in.
+"""
+
+from quest_tpu.resilience import faults  # noqa: F401
+from quest_tpu.resilience.breaker import Breaker  # noqa: F401
+from quest_tpu.resilience.faults import FaultPlan, InjectedFault  # noqa: F401
+from quest_tpu.resilience.supervisor import Supervisor  # noqa: F401
+
+__all__ = ["faults", "FaultPlan", "InjectedFault", "Breaker", "Supervisor"]

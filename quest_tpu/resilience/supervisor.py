@@ -1,0 +1,65 @@
+"""Worker supervision policy: bounded restarts with backoff + jitter.
+
+The ServeEngine owns ONE worker thread; before this layer a crash
+escaping its loop silently stranded every queued future (the process
+kept running, the futures never resolved — the worst failure mode a
+serving system has). `Supervisor` is the policy half of the fix: it
+decides, per crash, whether the worker restarts (and after how long) or
+the engine gives up and transitions to its loud FAILED state
+(docs/RESILIENCE.md). The mechanism half — requeueing undispatched
+in-flight requests, failing dispatched ones, completing every future on
+give-up — lives in the engine (`ServeEngine._worker_main`).
+
+Exponential backoff with deterministic jitter: restart k sleeps
+`base * 2^(k-1)` capped at `cap`, plus a seeded-uniform jitter slice so
+a crash-looping worker neither hot-spins nor thunders in lockstep with
+anything else. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+class Supervisor:
+    """Restart budget + backoff schedule for one supervised worker.
+
+    `next_backoff()` is called once per crash: it returns the seconds to
+    sleep before the restart, or None when the budget
+    (`QUEST_SERVE_RESTART_MAX`) is exhausted and the owner must fail
+    loudly instead of restarting. `record_success()` (called after a
+    healthy stretch, e.g. a completed dispatch) refills the budget —
+    restarts are a CRASH-LOOP bound, not a lifetime quota."""
+
+    def __init__(self, max_restarts: int, base_s: float = 0.05,
+                 cap_s: float = 2.0, jitter_frac: float = 0.25,
+                 seed: int = 0):
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}")
+        self.max_restarts = int(max_restarts)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter_frac = float(jitter_frac)
+        self.restarts = 0           # consecutive crashes since success
+        self.total_restarts = 0
+        self._rng = random.Random(seed)
+
+    def next_backoff(self) -> Optional[float]:
+        """Seconds to sleep before the next restart, or None when the
+        consecutive-crash budget is exhausted."""
+        if self.restarts >= self.max_restarts:
+            return None
+        self.restarts += 1
+        self.total_restarts += 1
+        delay = min(self.cap_s, self.base_s * (2 ** (self.restarts - 1)))
+        if delay <= 0.0:
+            return 0.0
+        return delay + self._rng.uniform(0.0, self.jitter_frac * delay)
+
+    def record_success(self) -> None:
+        """A healthy work cycle completed: reset the consecutive-crash
+        count so one crash per hour never exhausts a budget meant to
+        stop crash LOOPS."""
+        self.restarts = 0

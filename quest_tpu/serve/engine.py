@@ -37,6 +37,33 @@ Model (docs/SERVING.md):
   * every hop records into `serve.metrics` (queue-wait, end-to-end
     latency, batch occupancy, counters) — `metrics.snapshot()` is the
     dashboard feed, `scripts/serve_stats.py` the pretty-printer.
+
+Resilience (docs/RESILIENCE.md — the reference's `validate ->
+exitWithError` is untenable when one launch carries many clients):
+
+  * SUPERVISION — the worker thread restarts on crash (exponential
+    backoff + jitter, `QUEST_SERVE_RESTART_MAX` budget). Queued futures
+    survive the restart untouched; popped-but-undispatched requests are
+    requeued in order; requests whose launch had already started fail
+    with the crash (their outcome is unknown — retrying could
+    double-serve). Budget exhausted => the engine goes loudly FAILED:
+    every pending future resolves with a typed RejectedError and
+    submit() rejects with the cause.
+  * POISONED-BATCH ISOLATION — a failing coalesced launch binary-splits
+    and retries the halves (bounded depth, per-request retry cap), so
+    one bad request gets its own exception while its riders still get
+    results; a per-request demux error (bad observable) never touches
+    batch-mates at all.
+  * DEGRADATION LADDER — a per-program-key circuit breaker: after
+    `QUEST_SERVE_BREAKER_THRESHOLD` consecutive primary compile
+    failures the program's requests step down fused -> banded -> host
+    and keep completing; after a cooldown one half-open probe restores
+    the fused path.
+  * FAULT INJECTION — every recovery path above is provable end-to-end
+    through the named fault sites (`quest_tpu.resilience.faults`,
+    `QUEST_FAULT_PLAN`) threaded through this file; all checks are
+    host-side and guarded by one module flag, so an empty plan costs
+    nothing and retraces nothing.
 """
 
 from __future__ import annotations
@@ -49,14 +76,25 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from quest_tpu.resilience import faults as _F
+from quest_tpu.resilience.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                          Breaker)
+from quest_tpu.resilience.supervisor import Supervisor
 from quest_tpu.serve import metrics as M
 from quest_tpu.serve.admission import (AdmissionController,
-                                       DeadlineExceeded)
+                                       DeadlineExceeded, RejectedError)
+
+# the full degradation ladder, most capable first (the same engine
+# names bench.py's fallback ladder uses): 'fused' is whatever the
+# batched compiler resolves as primary, 'banded' the forced vmapped
+# banded-XLA program, 'host' the native C++ blocked kernels.
+DEFAULT_LADDER = ("fused", "banded", "host")
 
 
 class _Request:
     __slots__ = ("future", "kind", "state", "shots", "key", "observable",
-                 "expiry", "submit_t", "states")
+                 "expiry", "submit_t", "states", "started", "dispatched",
+                 "retries")
 
     def __init__(self, kind, state, shots, key, observable, expiry,
                  submit_t, states):
@@ -69,6 +107,9 @@ class _Request:
         self.expiry = expiry              # absolute monotonic or None
         self.submit_t = submit_t
         self.states = states              # slots this request occupies
+        self.started = False              # future transitioned RUNNING
+        self.dispatched = False           # a launch containing it began
+        self.retries = 0                  # failed launch attempts ridden
 
 
 def traj_dispatch_bucket(total: int, max_batch: int) -> int:
@@ -89,10 +130,11 @@ def traj_dispatch_bucket(total: int, max_batch: int) -> int:
 
 
 class _Queue:
-    __slots__ = ("circuit", "kind", "density", "engine", "requests",
+    __slots__ = ("key", "circuit", "kind", "density", "engine", "requests",
                  "pending_states")
 
-    def __init__(self, circuit, kind, density, engine):
+    def __init__(self, key, circuit, kind, density, engine):
+        self.key = key                    # this queue's program key
         self.circuit = circuit
         self.kind = kind
         self.density = density
@@ -114,18 +156,27 @@ class ServeEngine:
 
     Construction keywords override the QUEST_SERVE_* knobs for THIS
     engine (the knobs are runtime-scope: read once here, never inside
-    a compiled path): `max_wait_ms`, `max_queue`, `max_batch`.
+    a compiled path): `max_wait_ms`, `max_queue`, `max_batch`,
+    `restart_max` (supervisor budget), `breaker_threshold`.
     `interpret=True` runs Pallas kernels in interpreter mode (CPU
     testing); `traj_engine` pins the trajectory engine
     ('fused'|'banded'|'host', default: resolve by backend);
-    `registry` redirects metrics (default: the process-wide one)."""
+    `registry` redirects metrics (default: the process-wide one);
+    `backoff_base_s`/`breaker_cooldown_s` tune the recovery timings
+    (tests zero/shrink them); `ladder` overrides the degradation
+    ladder (docs/RESILIENCE.md)."""
 
     def __init__(self, *, max_wait_ms: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  max_batch: Optional[int] = None,
                  interpret: bool = False,
                  traj_engine: Optional[str] = None,
-                 registry: Optional[M.Registry] = None):
+                 registry: Optional[M.Registry] = None,
+                 restart_max: Optional[int] = None,
+                 backoff_base_s: float = 0.05,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_cooldown_s: float = 0.5,
+                 ladder: Optional[Tuple[str, ...]] = None):
         from quest_tpu.env import knob_value
         if max_wait_ms is None:
             max_wait_ms = knob_value("QUEST_SERVE_MAX_WAIT_MS")
@@ -133,16 +184,42 @@ class ServeEngine:
             max_queue = knob_value("QUEST_SERVE_MAX_QUEUE")
         if max_batch is None:
             max_batch = knob_value("QUEST_SERVE_MAX_BATCH")
+        if restart_max is None:
+            restart_max = knob_value("QUEST_SERVE_RESTART_MAX")
+        if breaker_threshold is None:
+            breaker_threshold = knob_value("QUEST_SERVE_BREAKER_THRESHOLD")
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if ladder is None:
+            ladder = DEFAULT_LADDER
+        bad = [e for e in ladder if e not in DEFAULT_LADDER]
+        if bad:
+            raise ValueError(f"unknown ladder engine(s) {bad}; the rungs "
+                             f"are {list(DEFAULT_LADDER)}")
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_batch = int(max_batch)
         self.interpret = bool(interpret)
         self.traj_engine = traj_engine
         self.registry = registry if registry is not None else M.REGISTRY
+        # hot-path metric handles hoisted ONCE: _finish_one runs per
+        # RIDER in the demux loop, and a registry lookup there is a
+        # locked dict hit per future, contending with client-thread
+        # submits (the path the per-request XLA gather was already
+        # evicted from)
+        self._m_served = self.registry.counter("serve_requests_served")
+        self._m_e2e = self.registry.histogram("serve_e2e_latency_s")
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.ladder = tuple(ladder)
+        # a split deeper than log2(max_batch) cannot shrink a batch
+        # further; +1 headroom for the singleton level
+        self._split_depth_cap = max(1, self.max_batch.bit_length() + 1)
+        self._retry_cap = self._split_depth_cap + 1
         self._admission = AdmissionController(max_queue)
+        self._supervisor = Supervisor(restart_max, base_s=backoff_base_s)
+        self._breakers: Dict[tuple, Breaker] = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[tuple, _Queue] = {}
@@ -151,12 +228,27 @@ class ServeEngine:
         self._drainers = 0                # concurrent drain() calls
         self._closed = False
         self._stop = False
-        self._worker = threading.Thread(target=self._run,
+        self._failure_cause: Optional[BaseException] = None
+        self._state = "running"
+        # crash-recovery ledger: what the worker holds outside the
+        # queues right now (popped batches + popped-expired requests),
+        # so supervision can requeue/fail instead of stranding futures
+        self._active: List[Tuple[_Queue, List[_Request]]] = []
+        self._active_failed: List[Tuple[_Request, BaseException]] = []
+        _F.install_from_env()             # QUEST_FAULT_PLAN soak arming
+        self._worker = threading.Thread(target=self._worker_main,
                                         name="quest-serve-worker",
                                         daemon=True)
         self._worker.start()
 
     # -- client API --------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        """'running' | 'failed' (restart budget exhausted) | 'closed'."""
+        if self._closed:
+            return "closed"
+        return self._state
 
     def submit(self, circuit, state=None, shots: Optional[int] = None, *,
                key=None, deadline_s: Optional[float] = None,
@@ -186,8 +278,9 @@ class ServeEngine:
 
         `deadline_s` is relative: a request still queued when it
         elapses fails with DeadlineExceeded before any launch. Raises
-        `RejectedError` when the bounded queue is full and
-        RuntimeError after close()."""
+        `RejectedError` when the bounded queue is full, after `close()`
+        ("engine closed"), and when the engine is FAILED (the worker
+        exhausted its restart budget; the error chains the cause)."""
         if (state is None) == (shots is None):
             raise ValueError(
                 "submit() takes exactly one of state= (apply request) "
@@ -245,7 +338,20 @@ class ServeEngine:
 
         with self._cond:
             if self._closed:
-                raise RuntimeError("submit() after ServeEngine.close()")
+                self.registry.counter("serve_requests_rejected").inc()
+                raise RejectedError(
+                    "Invalid operation: engine closed — submit() after "
+                    "ServeEngine.close(); create a new engine "
+                    "(docs/RESILIENCE.md).")
+            if self._state == "failed":
+                self.registry.counter("serve_requests_rejected").inc()
+                raise RejectedError(
+                    f"Invalid operation: ServeEngine is FAILED — its "
+                    f"worker exhausted the restart budget "
+                    f"(QUEST_SERVE_RESTART_MAX="
+                    f"{self._supervisor.max_restarts}); last cause: "
+                    f"{self._failure_cause!r}. Create a new engine "
+                    f"(docs/RESILIENCE.md).") from self._failure_cause
             try:
                 self._admission.admit(self._pending)
             except Exception:
@@ -253,8 +359,8 @@ class ServeEngine:
                 raise
             q = self._queues.get(qkey)
             if q is None:
-                q = self._queues[qkey] = _Queue(circuit, kind, density,
-                                                engine_name)
+                q = self._queues[qkey] = _Queue(qkey, circuit, kind,
+                                                density, engine_name)
             q.requests.append(req)
             q.pending_states += req.states
             self._pending += 1
@@ -265,10 +371,21 @@ class ServeEngine:
     def drain(self, timeout_s: Optional[float] = None) -> None:
         """Flush every queued request NOW (partial buckets included)
         and block until all launches complete. New submits arriving
-        mid-drain are flushed too."""
+        mid-drain are flushed too. After `close()` has stopped the
+        worker, drain raises RejectedError deterministically (there is
+        no worker left to race); on a FAILED engine it returns
+        immediately (failure already resolved every future)."""
+        self._drain(timeout_s, _internal=False)
+
+    def _drain(self, timeout_s: Optional[float],
+               _internal: bool) -> None:
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
         with self._cond:
+            if self._stop and not _internal:
+                raise RejectedError(
+                    "Invalid operation: engine closed — drain() after "
+                    "ServeEngine.close() (docs/RESILIENCE.md).")
             # a COUNT, not a bool: concurrent drains each hold the
             # flush mode open until their own predicate turns true — a
             # bool would let the first drain to finish (or time out)
@@ -278,6 +395,11 @@ class ServeEngine:
             self._cond.notify_all()
             try:
                 while self._pending or self._inflight:
+                    if self._state == "failed":
+                        # the failure transition resolved every future;
+                        # nothing further can complete — returning is
+                        # the deterministic flush
+                        return
                     t = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
                     if t == 0.0:
@@ -296,7 +418,7 @@ class ServeEngine:
             if self._closed and not self._worker.is_alive():
                 return
             self._closed = True
-        self.drain(timeout_s)
+        self._drain(timeout_s, _internal=True)
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -308,10 +430,162 @@ class ServeEngine:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- resilience plumbing -----------------------------------------------
+
+    def _fault(self, site: str, **ctx) -> None:
+        """Hot-path fault hook: call sites guard with `if _F.ACTIVE:` so
+        an empty plan costs one module-attribute read. A firing site is
+        tallied before the error propagates into whichever recovery
+        path owns that site."""
+        try:
+            _F.check(site, **ctx)
+        except BaseException:
+            self.registry.counter("serve_faults_injected").inc()
+            raise
+
+    def _breaker_for(self, q: _Queue) -> Breaker:
+        br = self._breakers.get(q.key)
+        if br is None:
+            opens = self.registry.counter("serve_breaker_opens")
+            closes = self.registry.counter("serve_breaker_closes")
+            probes = self.registry.counter("serve_breaker_probes")
+            gauge = self.registry.gauge("serve_breakers_open")
+
+            def on_transition(old: str, new: str) -> None:
+                if new == OPEN and old != OPEN:
+                    opens.inc()
+                    if old == CLOSED:
+                        gauge.inc()
+                elif old == OPEN and new == HALF_OPEN:
+                    probes.inc()
+                elif new == CLOSED:
+                    closes.inc()
+                    gauge.dec()
+
+            br = self._breakers[q.key] = Breaker(
+                self.breaker_threshold, self.breaker_cooldown_s,
+                on_transition=on_transition)
+        return br
+
+    def _fail_request(self, r: _Request, exc: BaseException,
+                      counter: Optional[str] = "serve_requests_failed"
+                      ) -> None:
+        """Resolve one future with a typed error, tolerating requests
+        that were already started (requeued survivors) or cancelled."""
+        if r.future.done():
+            return
+        if not r.started:
+            if not r.future.set_running_or_notify_cancel():
+                self.registry.counter("serve_requests_cancelled").inc()
+                return
+            r.started = True
+        r.future.set_exception(exc)
+        if counter:
+            self.registry.counter(counter).inc()
+
+    def _requeue_locked(self, q: _Queue, reqs: List[_Request]) -> None:
+        """Put popped-but-undispatched requests back at the FRONT of
+        their queue, in order (supervised-restart recovery)."""
+        live = self._queues.get(q.key)
+        if live is None:
+            live = self._queues[q.key] = q
+            q.requests = deque()
+            q.pending_states = 0
+        live.requests.extendleft(reversed(reqs))
+        live.pending_states += sum(r.states for r in reqs)
+        self._pending += len(reqs)
+
+    def _recover_locked(self, exc: BaseException
+                        ) -> List[Tuple[_Request, BaseException]]:
+        """Crash recovery under the lock: requeue every in-flight
+        request that never reached dispatch (it will be retried
+        bit-identically), collect the rest for typed failure outside
+        the lock (their launch outcome is unknown — retrying could
+        double-serve). Resets the in-flight accounting."""
+        doomed: List[Tuple[_Request, BaseException]] = []
+        for q, reqs in self._active:
+            retry = []
+            for r in reqs:
+                if r.future.done():
+                    continue
+                if r.dispatched:
+                    doomed.append((r, exc))
+                else:
+                    retry.append(r)
+            if retry:
+                self._requeue_locked(q, retry)
+        doomed.extend(self._active_failed)
+        self._active = []
+        self._active_failed = []
+        self._inflight = 0
+        return doomed
+
+    def _evacuate_locked(self) -> List[_Request]:
+        """FAILED transition: pull every queued request out so their
+        futures can be resolved (typed) outside the lock — a FAILED
+        engine never leaves a future hanging."""
+        doomed: List[_Request] = []
+        for q in self._queues.values():
+            doomed.extend(q.requests)
+            q.requests.clear()
+            q.pending_states = 0
+        self._queues.clear()
+        self._pending = 0
+        return doomed
+
     # -- worker ------------------------------------------------------------
+
+    def _worker_main(self) -> None:
+        """Supervised outer loop: `_run` only returns on a clean stop;
+        anything escaping it is a worker crash, restarted with backoff
+        until the budget (`QUEST_SERVE_RESTART_MAX`) is exhausted —
+        then the engine transitions to FAILED, resolving EVERY pending
+        future with a typed error (docs/RESILIENCE.md)."""
+        while True:
+            try:
+                self._run()
+                return
+            except BaseException as e:    # noqa: BLE001 - supervised
+                delay = self._supervisor.next_backoff()
+                with self._cond:
+                    doomed = self._recover_locked(e)
+                    evacuated = ([] if delay is not None
+                                 else self._evacuate_locked())
+                    if delay is None:
+                        self._failure_cause = e
+                        self._state = "failed"
+                # futures complete OUTSIDE the lock (user callbacks
+                # must not be able to deadlock against submit). Popped
+                # expiries recovered here keep their normal tally —
+                # only requests the crash itself doomed count as failed
+                for r, exc in doomed:
+                    self._fail_request(
+                        r, exc,
+                        counter=("serve_requests_expired"
+                                 if isinstance(exc, DeadlineExceeded)
+                                 else "serve_requests_failed"))
+                if delay is None:
+                    fail = RejectedError(
+                        f"Invalid operation: ServeEngine FAILED — its "
+                        f"worker crashed "
+                        f"{self._supervisor.total_restarts + 1} time(s) "
+                        f"and the restart budget is exhausted; last "
+                        f"cause: {e!r} (docs/RESILIENCE.md).")
+                    fail.__cause__ = e
+                    for r in evacuated:
+                        self._fail_request(r, fail)
+                with self._cond:
+                    self._cond.notify_all()
+                if delay is None:
+                    return
+                self.registry.counter("serve_worker_restarts").inc()
+                if delay:
+                    time.sleep(delay)
 
     def _run(self) -> None:
         while True:
+            if _F.ACTIVE:
+                self._fault("serve.worker_loop", phase="idle")
             batches: List[Tuple[_Queue, List[_Request]]] = []
             failed: List[Tuple[_Request, BaseException]] = []
             cancelled: List[_Request] = []
@@ -322,34 +596,39 @@ class ServeEngine:
                     batches, failed, cancelled = self._pop_ready_locked()
                     if batches or failed or cancelled:
                         self._inflight += len(batches)
+                        # ledger for crash recovery: everything the
+                        # worker now holds outside the queues
+                        self._active = list(batches)
+                        self._active_failed = list(failed)
                         break
                     self._cond.wait(self._next_due_locked())
-            # complete failures/cancellations OUTSIDE the lock (user
-            # callbacks must not be able to deadlock against submit)
-            for r in cancelled:
-                self.registry.counter("serve_requests_cancelled").inc()
+            if _F.ACTIVE and batches:
+                self._fault("serve.worker_loop", phase="popped")
+            # complete failures OUTSIDE the lock (user callbacks must
+            # not be able to deadlock against submit)
             for r, exc in failed:
                 self.registry.counter("serve_requests_expired").inc()
-                if r.future.set_running_or_notify_cancel():
-                    r.future.set_exception(exc)
+                self._fail_request(r, exc, counter=None)
             if failed or cancelled:
                 # wake drain()/close() only AFTER the failed futures
                 # are actually completed: a notify from inside the pop
                 # (where _pending already reads 0) would let drain()
                 # return with a future the caller sees as not-yet-done
                 with self._cond:
+                    self._active_failed = []
                     self._cond.notify_all()
             for q, reqs in batches:
-                try:
-                    self._dispatch(q, reqs)
-                except BaseException as e:   # noqa: BLE001 - demuxed
-                    for r in reqs:
-                        if not r.future.done():
-                            r.future.set_exception(e)
-                finally:
-                    with self._cond:
-                        self._inflight -= 1
-                        self._cond.notify_all()
+                self._dispatch(q, reqs)   # never raises: failures are
+                #                           split/isolated/typed inside
+                with self._cond:
+                    self._inflight -= 1
+                    self._active.remove((q, reqs))
+                    self._cond.notify_all()
+            if batches:
+                # a fully processed pop cycle is the health signal that
+                # refills the restart budget (crash-LOOP bound, not a
+                # lifetime quota)
+                self._supervisor.record_success()
 
     def _pop_ready_locked(self):
         """Sweep expiries/cancellations, then pop every queue that is
@@ -366,6 +645,12 @@ class ServeEngine:
                 q.requests = deque(live)
                 q.pending_states = sum(r.states for r in live)
             self._pending -= len(expired) + len(cancd)
+            if cancd:
+                # tallied HERE (their futures are already cancelled —
+                # nothing completes outside the lock), so a crash in
+                # the pop-to-completion window can't lose the count
+                self.registry.counter("serve_requests_cancelled").inc(
+                    len(cancd))
             cancelled.extend(cancd)
             failed.extend((r, DeadlineExceeded(
                 "Invalid operation: the request's deadline "
@@ -424,10 +709,15 @@ class ServeEngine:
     # -- dispatch ----------------------------------------------------------
 
     def _start(self, reqs: List[_Request]) -> List[_Request]:
-        """Transition futures to RUNNING; drops late cancellations."""
+        """Transition futures to RUNNING; drops late cancellations.
+        Requests surviving a supervised restart are already RUNNING and
+        pass straight through."""
         started = []
         for r in reqs:
-            if r.future.set_running_or_notify_cancel():
+            if r.started:
+                started.append(r)
+            elif r.future.set_running_or_notify_cancel():
+                r.started = True
                 started.append(r)
             else:
                 self.registry.counter("serve_requests_cancelled").inc()
@@ -440,23 +730,133 @@ class ServeEngine:
         for r in reqs:
             qw.observe(t_pop - r.submit_t)
 
-    def _finish(self, reqs_results) -> None:
-        done_t = time.monotonic()
-        served = self.registry.counter("serve_requests_served")
-        e2e = self.registry.histogram("serve_e2e_latency_s")
-        for r, result in reqs_results:
-            r.future.set_result(result)
-            served.inc()
-            e2e.observe(done_t - r.submit_t)
+    def _finish_one(self, r: _Request, result) -> None:
+        r.future.set_result(result)
+        self._m_served.inc()
+        self._m_e2e.observe(time.monotonic() - r.submit_t)
 
     def _dispatch(self, q: _Queue, reqs: List[_Request]) -> None:
         reqs = self._start(reqs)
         if not reqs:
             return
-        if q.kind == "apply":
-            self._dispatch_apply(q, reqs)
-        else:
-            self._dispatch_traj(q, reqs)
+        self._dispatch_split(q, reqs, depth=0)
+
+    def _dispatch_split(self, q: _Queue, reqs: List[_Request],
+                        depth: int) -> None:
+        """Poisoned-batch isolation (docs/RESILIENCE.md): a failing
+        coalesced launch binary-splits and retries the halves, so one
+        bad request ends up alone with its own typed exception while
+        its riders still get results. Bounded: split depth is capped
+        (log2(max_batch)+1 levels) and each request rides at most
+        `_retry_cap` failed attempts; a single poisoned rider among B
+        wastes at most ceil(log2(B))+1 failing launches (the node path
+        containing it) and its riders re-land in ceil(log2(B))
+        successful ones."""
+        try:
+            if q.kind == "apply":
+                self._dispatch_apply(q, reqs)
+            else:
+                self._dispatch_traj(q, reqs)
+            return
+        except BaseException as e:        # noqa: BLE001 - isolated below
+            self.registry.counter("serve_launch_failures").inc()
+            err = e
+        survivors = [r for r in reqs if not r.future.done()]
+        if not survivors:
+            return
+        if len(survivors) == 1 or depth + 1 >= self._split_depth_cap:
+            for r in survivors:
+                self._fail_request(r, err)
+            return
+        retryable = []
+        for r in survivors:
+            r.retries += 1
+            if r.retries >= self._retry_cap:
+                self._fail_request(r, err)
+            else:
+                retryable.append(r)
+        if not retryable:
+            return
+        self.registry.counter("serve_batches_split").inc()
+        mid = (len(retryable) + 1) // 2
+        self._dispatch_split(q, retryable[:mid], depth + 1)
+        if retryable[mid:]:
+            self._dispatch_split(q, retryable[mid:], depth + 1)
+
+    # -- program resolution: breaker + degradation ladder ------------------
+
+    def _degraded_rungs(self, primary: str) -> Tuple[str, ...]:
+        """Ladder rungs below `primary` in preference order."""
+        try:
+            i = self.ladder.index(primary)
+        except ValueError:
+            i = 0
+        return self.ladder[i + 1:]
+
+    def _apply_program(self, q: _Queue, b: int, rung: str):
+        """One ladder rung's batched apply program (callable with a
+        `.bucket`), uniform across rungs so the dispatch below stays
+        rung-agnostic."""
+        if rung == "fused":
+            return q.circuit.compiled_batched(b, density=q.density,
+                                              donate=False,
+                                              interpret=self.interpret)
+        if rung == "banded":
+            return q.circuit.compiled_batched(b, density=q.density,
+                                              donate=False,
+                                              interpret=self.interpret,
+                                              engine="banded")
+        # host: the native C++ blocked kernels, one state at a time —
+        # the floor of the ladder (no jax in the loop at all, so it
+        # stays serviceable when the XLA client itself is wedged)
+        from quest_tpu import host as H
+        n = (q.circuit.num_qubits * 2 if q.density
+             else q.circuit.num_qubits)
+        step = H.compile_circuit_host(tuple(q.circuit.ops), n, q.density)
+
+        def run(batch_np):
+            out = np.array(batch_np)
+            for i in range(out.shape[0]):
+                step(out[i])
+            return out
+
+        run.bucket = b
+        return run
+
+    def _traj_program(self, q: _Queue, n: int, bucket: int, rung: str):
+        from quest_tpu import trajectories as T
+        engine = q.engine if rung == "fused" else rung
+        return T._compiled_traj(q.circuit, n, bucket, engine,
+                                self.interpret)
+
+    def _resolve_program(self, q: _Queue, compile_primary,
+                         compile_rung) -> tuple:
+        """Breaker-guarded program resolution: try the primary engine
+        when this program's breaker allows it (a breaker coming off
+        cooldown makes this call the half-open probe); on compile
+        failure — or an open breaker — walk the degradation ladder.
+        Returns (fn, primary_used, breaker)."""
+        br = self._breaker_for(q)
+        primary_err: Optional[BaseException] = None
+        if br.allow_primary():
+            try:
+                if _F.ACTIVE:
+                    self._fault("serve.compile", program=q.key)
+                return compile_primary(), True, br
+            except BaseException as e:   # noqa: BLE001 - ladder below
+                br.record_failure()
+                primary_err = e
+        primary = q.engine if q.kind == "traj" else "fused"
+        for rung in self._degraded_rungs(primary or "fused"):
+            try:
+                fn = compile_rung(rung)
+            except BaseException as e:   # noqa: BLE001 - next rung
+                primary_err = primary_err or e
+                continue
+            self.registry.counter("serve_degraded_dispatches").inc()
+            return fn, False, br
+        raise primary_err if primary_err is not None else RuntimeError(
+            "no dispatchable engine rung")
 
     def _dispatch_apply(self, q: _Queue, reqs: List[_Request]) -> None:
         import jax
@@ -464,10 +864,12 @@ class ServeEngine:
         t_pop = time.monotonic()
         n = (q.circuit.num_qubits * 2 if q.density
              else q.circuit.num_qubits)
+        fn, primary, br = self._resolve_program(
+            q, lambda: self._apply_program(q, len(reqs), "fused"),
+            lambda rung: self._apply_program(q, len(reqs), rung))
+        if _F.ACTIVE:
+            self._fault("serve.device_put", reqs=reqs)
         batch = np.stack([r.state for r in reqs])
-        fn = q.circuit.compiled_batched(len(reqs), density=q.density,
-                                        donate=False,
-                                        interpret=self.interpret)
         if len(reqs) < fn.bucket:
             # pad to the bucket HOST-SIDE: handing the wrapper a partial
             # batch would run its traced zero-pad, and that concatenate
@@ -478,7 +880,13 @@ class ServeEngine:
             batch = np.concatenate(
                 [batch, np.zeros((fn.bucket - len(reqs),) + batch.shape[1:],
                                  batch.dtype)])
+        for r in reqs:
+            r.dispatched = True
+        if _F.ACTIVE:
+            self._fault("serve.dispatch", reqs=reqs)
         out_dev = jax.block_until_ready(fn(batch))
+        if primary:
+            br.record_success()
         # AT MOST one device->host materialization for the whole batch:
         # slicing the jax array per request would dispatch an XLA
         # gather per future (measured 0.75 ms/request — it dominated
@@ -493,24 +901,33 @@ class ServeEngine:
         out = np.asarray(out_dev) if raw_needed else None
         self._record_batch(reqs, len(reqs) / fn.bucket, t_pop)
         obs_vals: Dict[int, np.ndarray] = {}
-        results = []
         for i, r in enumerate(reqs):
-            if r.observable is not None:
-                vals = obs_vals.get(id(r.observable))
-                if vals is None:
-                    planes_b = out_dev.reshape(fn.bucket, 2, 1 << n)
-                    vals = np.asarray(jax.block_until_ready(
-                        r.observable(planes_b)))
-                    obs_vals[id(r.observable)] = vals
-                results.append((r, vals[i]))
-            else:
-                results.append((r, out[i].reshape(2, 1 << n)))
-        self._finish(results)
+            # demux is PER REQUEST from here on: one request's bad
+            # observable (wrong shape, a raise inside the callable)
+            # fails only its own future — its batch-mates already have
+            # correct planes in `out` and must not ride a batch-wide
+            # exception (the engine.py:345 whole-batch failure this
+            # replaces)
+            try:
+                if _F.ACTIVE:
+                    self._fault("serve.demux", req=r)
+                if r.observable is not None:
+                    vals = obs_vals.get(id(r.observable))
+                    if vals is None:
+                        planes_b = out_dev.reshape(fn.bucket, 2, 1 << n)
+                        vals = np.asarray(jax.block_until_ready(
+                            r.observable(planes_b)))
+                        obs_vals[id(r.observable)] = vals
+                    self._finish_one(r, vals[i])
+                else:
+                    self._finish_one(r, out[i].reshape(2, 1 << n))
+            except BaseException as e:   # noqa: BLE001 - per-request
+                self.registry.counter("serve_demux_failures").inc()
+                self._fail_request(r, e)
 
     def _dispatch_traj(self, q: _Queue, reqs: List[_Request]) -> None:
         import jax
         import jax.numpy as jnp
-        from quest_tpu import trajectories as T
 
         t_pop = time.monotonic()
         n = q.circuit.num_qubits
@@ -545,20 +962,28 @@ class ServeEngine:
         # per-state math being batch-size-invariant, pinned per engine
         # in tests/test_batched.py and tests/test_serve.py.
         bucket = traj_dispatch_bucket(total, self.max_batch)
-        fn = T._compiled_traj(q.circuit, n, bucket, q.engine,
-                              self.interpret)
+        fn, primary, br = self._resolve_program(
+            q, lambda: self._traj_program(q, n, bucket, "fused"),
+            lambda rung: self._traj_program(q, n, bucket, rung))
         spans, lo = [], 0
         for r in reqs:
             spans.append((r, lo, lo + r.shots))
             lo += r.shots
         pieces = [([], []) for _ in reqs]   # (planes|values, draws) chunks
+        dead = set()                        # request indices demux-failed
         launches = 0
+        if _F.ACTIVE:
+            self._fault("serve.device_put", reqs=reqs)
+        for r in reqs:
+            r.dispatched = True
         for clo in range(0, total, bucket):
             kb = data[clo:clo + bucket]
             pad = bucket - kb.shape[0]
             if pad:
                 kb = np.concatenate(
                     [kb, np.broadcast_to(kb[:1], (pad,) + kb.shape[1:])])
+            if _F.ACTIVE:
+                self._fault("serve.dispatch", reqs=reqs, chunk=launches)
             planes, draws = fn(make_keys(kb))
             chi = min(clo + bucket, total)
             draws_np = np.asarray(draws)
@@ -577,12 +1002,13 @@ class ServeEngine:
             # a device slice per request would dispatch an XLA gather +
             # host transfer per future (the 0.75 ms/request cost the
             # apply path avoids the same way). Pad rows sit past every
-            # request's span and are never touched.
+            # request's span and are never touched. A per-request demux
+            # error (bad observable) kills only that request's future.
             overlaps = []
             raw_needed = False
             for i, (r, rlo, rhi) in enumerate(spans):
                 s0, s1 = max(rlo, clo) - clo, min(rhi, chi) - clo
-                if s0 >= s1:
+                if s0 >= s1 or i in dead:
                     continue
                 overlaps.append((i, r, s0, s1))
                 raw_needed = raw_needed or r.observable is None
@@ -590,24 +1016,38 @@ class ServeEngine:
                          if raw_needed else None)
             obs_vals: Dict[int, np.ndarray] = {}
             for i, r, s0, s1 in overlaps:
-                if r.observable is not None:
-                    vals = obs_vals.get(id(r.observable))
-                    if vals is None:
-                        vals = np.asarray(jax.block_until_ready(
-                            r.observable(planes)))
-                        obs_vals[id(r.observable)] = vals
-                    seg = vals[s0:s1]
-                else:
-                    seg = planes_np[s0:s1]
-                pieces[i][0].append(seg)
-                pieces[i][1].append(draws_np[s0:s1])
+                try:
+                    if _F.ACTIVE:
+                        self._fault("serve.demux", req=r)
+                    if r.observable is not None:
+                        vals = obs_vals.get(id(r.observable))
+                        if vals is None:
+                            vals = np.asarray(jax.block_until_ready(
+                                r.observable(planes)))
+                            obs_vals[id(r.observable)] = vals
+                        seg = vals[s0:s1]
+                    else:
+                        seg = planes_np[s0:s1]
+                    pieces[i][0].append(seg)
+                    pieces[i][1].append(draws_np[s0:s1])
+                except BaseException as e:  # noqa: BLE001 - per-request
+                    self.registry.counter("serve_demux_failures").inc()
+                    dead.add(i)
+                    self._fail_request(r, e)
             launches += 1
+        if primary:
+            br.record_success()
         self.registry.counter("serve_batches_dispatched").inc(
             launches - 1)                 # _record_batch adds the 1st
         self._record_batch(reqs, total / (launches * bucket), t_pop)
-        results = []
-        for (r, _, _), (pp, dd) in zip(spans, pieces):
-            p = pp[0] if len(pp) == 1 else np.concatenate(pp, axis=0)
-            d = dd[0] if len(dd) == 1 else np.concatenate(dd, axis=0)
-            results.append((r, (p, d)))
-        self._finish(results)
+        for i, ((r, _, _), (pp, dd)) in enumerate(zip(spans, pieces)):
+            if i in dead:
+                continue
+            try:
+                p = pp[0] if len(pp) == 1 else np.concatenate(pp, axis=0)
+                d = dd[0] if len(dd) == 1 else np.concatenate(dd, axis=0)
+            except BaseException as e:   # noqa: BLE001 - per-request
+                self.registry.counter("serve_demux_failures").inc()
+                self._fail_request(r, e)
+                continue
+            self._finish_one(r, (p, d))

@@ -13,9 +13,14 @@ tallies are programmatically readable instead of log-scrape-only).
 tests/test_serve.py pins and scripts/serve_stats.py pretty-prints:
 
     {"counters": {name: int, ...},
+     "gauges": {name: float, ...},
      "histograms": {name: {"count": int, "mean": float,
                            "p50": float, "p95": float, "p99": float},
                     ...}}
+
+Gauges are the settable point-in-time values the resilience layer needs
+(`serve_breakers_open`: how many program breakers are open RIGHT NOW —
+a counter can only ever grow, docs/RESILIENCE.md).
 
 Histograms keep a bounded reservoir (the most recent `RESERVOIR`
 observations) plus exact count/sum: percentiles are over the recent
@@ -48,6 +53,34 @@ class Counter:
 
     @property
     def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """A settable point-in-time value (thread-safe): current breaker
+    count, queue depth — anything that goes DOWN as well as up."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
         return self._value
 
 
@@ -101,6 +134,7 @@ class Registry:
 
     def __init__(self):
         self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
@@ -110,6 +144,13 @@ class Registry:
             if c is None:
                 c = self._counters[name] = Counter(name)
             return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
 
     def histogram(self, name: str) -> Histogram:
         with self._lock:
@@ -121,9 +162,11 @@ class Registry:
     def snapshot(self) -> dict:
         with self._lock:
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
             histograms = dict(self._histograms)
         return {
             "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": {n: g.value for n, g in sorted(gauges.items())},
             "histograms": {n: h.summary()
                            for n, h in sorted(histograms.items())},
         }

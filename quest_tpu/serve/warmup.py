@@ -84,6 +84,14 @@ def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
         raise ValueError(
             f"kind must be 'apply', 'traj' or None (infer per "
             f"circuit), got {kind!r}")
+    state = getattr(engine, "state", "running")
+    if state in ("closed", "failed"):
+        # warming a dead engine would compile programs no worker will
+        # ever dispatch — reject loudly like submit() does
+        from quest_tpu.serve.admission import RejectedError
+        raise RejectedError(
+            f"Invalid operation: cannot warm a {state} ServeEngine "
+            f"(docs/RESILIENCE.md)")
     report: Dict[str, float] = {}
     t_all = time.perf_counter()
     for i, c in enumerate(circuits):

@@ -35,14 +35,38 @@ def _fmt(name: str, v: float) -> str:
     return f"{v:10.4f}"
 
 
+# the resilience counters/gauges (docs/RESILIENCE.md) get their own
+# section: on a healthy engine they are all zero and an operator wants
+# that fact visible at a glance, not buried alphabetically
+_RESILIENCE = ("serve_worker_restarts", "serve_faults_injected",
+               "serve_launch_failures", "serve_batches_split",
+               "serve_requests_failed", "serve_demux_failures",
+               "serve_degraded_dispatches", "serve_breaker_opens",
+               "serve_breaker_probes", "serve_breaker_closes",
+               "serve_breakers_open")
+
+
 def render(snap: dict, out=sys.stdout) -> None:
     counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
     histograms = snap.get("histograms", {})
     if counters:
         w = max(len(n) for n in counters)
         print("counters", file=out)
         for n in sorted(counters):
             print(f"  {n:<{w}}  {counters[n]}", file=out)
+    if gauges:
+        w = max(len(n) for n in gauges)
+        print("gauges", file=out)
+        for n in sorted(gauges):
+            print(f"  {n:<{w}}  {gauges[n]:g}", file=out)
+    if counters or gauges:
+        vals = {**counters, **gauges}
+        w = max(len(n) for n in _RESILIENCE)
+        print("resilience (docs/RESILIENCE.md; healthy = all zero)",
+              file=out)
+        for n in _RESILIENCE:
+            print(f"  {n:<{w}}  {vals.get(n, 0):g}", file=out)
     if histograms:
         w = max(len(n) for n in histograms)
         unit = "ms for *_s"

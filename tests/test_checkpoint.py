@@ -91,3 +91,151 @@ def test_async_sharded_checkpoint(tmp_path):
     restored = ck.load_sharded(str(tmp_path / "async"))
     np.testing.assert_allclose(to_dense(restored), snapshot,
                                atol=1e-6, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# robustness (ISSUE 7 satellite): corrupt/truncated/mismatched files
+# raise ONE clear CheckpointError naming the file and the mismatch —
+# never a leaked numpy/zipfile/orbax internal
+# ---------------------------------------------------------------------------
+
+
+def _saved(tmp_path, rng, n=3):
+    import os
+    v = oracle.random_statevector(n, rng)
+    q = init_state_from_amps(qt.create_qureg(n, dtype=np.complex128),
+                             v.real, v.imag)
+    d = str(tmp_path / "ck")
+    ckpt.save(q, d)
+    return d, os.path
+
+
+def test_checkpoint_save_stamps_magic_and_version(tmp_path, rng):
+    import json
+    import os
+    d, _ = _saved(tmp_path, rng)
+    with open(os.path.join(d, "qureg_meta.json")) as f:
+        meta = json.load(f)
+    assert meta["magic"] == "quest-checkpoint"
+    assert meta["format_version"] == 2
+
+
+def test_checkpoint_truncated_npz_raises_checkpoint_error(tmp_path, rng):
+    import os
+    d, _ = _saved(tmp_path, rng)
+    amps = os.path.join(d, "amps.npz")
+    raw = open(amps, "rb").read()
+    with open(amps, "wb") as f:
+        f.write(raw[:len(raw) // 2])        # truncate mid-payload
+    with pytest.raises(ckpt.CheckpointError, match="corrupt or truncated"):
+        ckpt.load(d)
+    with open(amps, "wb") as f:
+        f.write(b"not a zip archive at all")
+    with pytest.raises(ckpt.CheckpointError, match="amps.npz"):
+        ckpt.load(d)
+
+
+def test_checkpoint_missing_planes_key_raises(tmp_path, rng):
+    import os
+    d, _ = _saved(tmp_path, rng)
+    np.savez(os.path.join(d, "amps.npz"), wrong_name=np.zeros(4))
+    with pytest.raises(ckpt.CheckpointError, match="no 'planes' array"):
+        ckpt.load(d)
+
+
+def test_checkpoint_wrong_register_size_names_the_mismatch(tmp_path, rng):
+    import json
+    import os
+    d, _ = _saved(tmp_path, rng, n=3)
+    meta_path = os.path.join(d, "qureg_meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["num_qubits"] = 4                  # lies about the planes
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError) as ei:
+        ckpt.load(d)
+    msg = str(ei.value)
+    assert "amps.npz" in msg and "4-qubit" in msg
+    assert "(2, 8)" in msg and "(2, 16)" in msg
+
+
+def test_checkpoint_meta_corruption_modes(tmp_path, rng):
+    import json
+    import os
+    d, _ = _saved(tmp_path, rng)
+    meta_path = os.path.join(d, "qureg_meta.json")
+    good = open(meta_path).read()
+    # truncated JSON
+    with open(meta_path, "w") as f:
+        f.write(good[:10])
+    with pytest.raises(ckpt.CheckpointError, match="not parseable JSON"):
+        ckpt.load(d)
+    # wrong magic: not a quest checkpoint
+    meta = json.loads(good)
+    meta["magic"] = "somebody-else"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="magic"):
+        ckpt.load(d)
+    # future format version
+    meta = json.loads(good)
+    meta["format_version"] = 99
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="newer than"):
+        ckpt.load(d)
+    # missing required field
+    meta = json.loads(good)
+    del meta["num_qubits"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="num_qubits"):
+        ckpt.load(d)
+    # missing directory entirely
+    with pytest.raises(ckpt.CheckpointError, match="not a checkpoint"):
+        ckpt.load(str(tmp_path / "nowhere"))
+
+
+def test_checkpoint_pre_field_meta_loads_tolerantly(tmp_path, rng):
+    """A format-1 checkpoint (no magic/format fields — written before
+    this PR) must still load: the fields are additive."""
+    import json
+    import os
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    d = str(tmp_path / "old")
+    ckpt.save(q, d)
+    meta_path = os.path.join(d, "qureg_meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["magic"]
+    meta["format_version"] = 1
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    q2 = ckpt.load(d)
+    np.testing.assert_array_equal(to_dense(q2), to_dense(q))
+
+
+def test_sharded_checkpoint_corruption_raises_checkpoint_error(tmp_path,
+                                                               rng):
+    """load_sharded on a missing/corrupt orbax payload raises the one
+    documented CheckpointError (orbax internals chained, not leaked)."""
+    import json
+    import os
+    pytest.importorskip("orbax.checkpoint")
+    d = str(tmp_path / "ock")
+    os.makedirs(d)
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    with open(os.path.join(d, "qureg_meta.json"), "w") as f:
+        json.dump(ckpt._meta(q), f)         # meta ok, payload missing
+    with pytest.raises(ckpt.CheckpointError, match="orbax"):
+        ckpt.load_sharded(d)
+
+
+def test_checkpoint_error_is_a_quest_error(tmp_path):
+    from quest_tpu.validation import QuESTError
+    assert issubclass(ckpt.CheckpointError, QuESTError)

@@ -345,6 +345,58 @@ def test_serve_knob_registry_coverage(tmp_path):
     assert "bypasses" in q4[0].message, q4
 
 
+def test_resilience_knob_registry_coverage(tmp_path):
+    """QUEST_FAULT_PLAN / QUEST_SERVE_RESTART_MAX /
+    QUEST_SERVE_BREAKER_THRESHOLD coverage of the registry rules
+    (ISSUE 7): all three are RUNTIME scope — read once at ServeEngine
+    construction (the fault checks themselves read NO knobs on the hot
+    path) — so a registry read off-jit is clean, the same read on a
+    jit-reachable path fires QL001, and a direct os.environ read fires
+    QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        def configure_resilience():
+            a = knob_value("QUEST_SERVE_RESTART_MAX")
+            b = knob_value("QUEST_SERVE_BREAKER_THRESHOLD")
+            c = knob_value("QUEST_FAULT_PLAN")
+            return a, b, c
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_SERVE_RESTART_MAX") > 1:
+                return amps * 2
+            return amps
+
+        def bypass():
+            return os.environ.get("QUEST_FAULT_PLAN")
+    """, name="resknobs.py")
+    assert not [v for v in vs if v.line in (7, 8, 9)], vs  # runtime, off-jit
+    q1 = [v for v in vs if v.rule == "QL001"]
+    assert len(q1) == 1 and q1[0].line == 14, vs
+    assert "scope='runtime'" in q1[0].message, q1
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and q4[0].line == 19, vs
+    assert "bypasses" in q4[0].message, q4
+
+
+def test_resilience_knobs_registered_with_loud_parsers():
+    """The new knobs are registry-backed with malformed samples that
+    REJECT (docs/CONFIG.md parity rides test_docs.py)."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_SERVE_RESTART_MAX",
+                 "QUEST_SERVE_BREAKER_THRESHOLD", "QUEST_FAULT_PLAN"):
+        k = KNOBS[name]
+        assert k.scope == "runtime" and k.layer == "serve", k
+        assert k.malformed is not None
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    # the fault-plan default is None: no plan, zero hot-path cost
+    assert KNOBS["QUEST_FAULT_PLAN"].default is None
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax

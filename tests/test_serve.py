@@ -379,7 +379,16 @@ def test_drain_flushes_partial_bucket():
         assert occ["mean"] == pytest.approx(0.75)
     finally:
         eng.close(timeout_s=120)
-    with pytest.raises(RuntimeError, match="close"):
+    # post-close the engine is deterministically rejecting: submit AND
+    # drain raise typed RejectedError ("engine closed") instead of
+    # racing the dying worker, and close() stays idempotent
+    with pytest.raises(RejectedError, match="engine closed"):
+        eng.submit(c, state=states[0])
+    with pytest.raises(RejectedError, match="engine closed"):
+        eng.drain(timeout_s=5)
+    eng.close(timeout_s=60)                       # idempotent
+    assert eng.state == "closed"
+    with pytest.raises(RejectedError, match="engine closed"):
         eng.submit(c, state=states[0])
 
 
@@ -437,9 +446,11 @@ def test_metrics_snapshot_schema():
     with _engine(max_wait_ms=5, registry=reg) as eng:
         eng.submit(c, state=_random_states(1)[0]).result(timeout=120)
     snap = reg.snapshot()
-    assert set(snap) == {"counters", "histograms"}
+    assert set(snap) == {"counters", "gauges", "histograms"}
     for name, v in snap["counters"].items():
         assert isinstance(name, str) and isinstance(v, int), (name, v)
+    for name, v in snap["gauges"].items():
+        assert isinstance(name, str) and isinstance(v, float), (name, v)
     for needed in ("serve_requests_submitted", "serve_requests_served",
                    "serve_batches_dispatched"):
         assert snap["counters"][needed] >= 1, snap
@@ -630,7 +641,8 @@ def test_serve_knobs_registered_runtime_scope():
     from quest_tpu.env import KNOBS
     names = {n for n in KNOBS if n.startswith("QUEST_SERVE_")}
     assert names == {"QUEST_SERVE_MAX_WAIT_MS", "QUEST_SERVE_MAX_QUEUE",
-                     "QUEST_SERVE_MAX_BATCH"}
+                     "QUEST_SERVE_MAX_BATCH", "QUEST_SERVE_RESTART_MAX",
+                     "QUEST_SERVE_BREAKER_THRESHOLD"}
     for n in names:
         k = KNOBS[n]
         assert k.scope == "runtime" and k.layer == "serve", k
