@@ -66,6 +66,7 @@ from quest_tpu.measurement import (
     sample,
 )
 from quest_tpu.circuit import Circuit
+from quest_tpu.ops.expec import PauliSum
 from quest_tpu import qasm
 from quest_tpu import api
 from quest_tpu import checkpoint

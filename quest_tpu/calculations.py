@@ -136,10 +136,25 @@ def calc_hilbert_schmidt_distance(a: Qureg, b: Qureg) -> float:
 
 def calc_expec_pauli_prod(q: Qureg, targets: Sequence[int],
                           paulis: Sequence[int]) -> float:
-    """<q| P |q> (statevec) or Tr(P rho) (density)."""
+    """<q| P |q> (statevec) or Tr(P rho) (density).
+
+    Routes through the grouped fused expectation engine (ops/expec) as
+    a one-term sum: one flip-form pass over the state, NO workspace
+    register (the reference — and this port until ISSUE 8 — cloned the
+    register and paid a full apply plus an inner product,
+    QuEST_common.c:464-477). By construction the compiled program IS
+    the one-term `calc_expec_pauli_sum` program — program identity
+    pinned under CompileAuditor in tests/test_expec.py.
+    QUEST_EXPEC_FUSION=0 restores the workspace path."""
+    from quest_tpu.ops import expec as E
     val.validate_multi_targets(q, targets)
     val.validate_pauli_targets(targets, paulis)
     val.validate_pauli_codes(paulis)
+    if E.fusion_enabled():
+        term = [0] * q.num_qubits
+        for t, p in zip(targets, paulis):
+            term[int(t)] = int(p)
+        return E.expec_value(q, np.ones((1,)), (tuple(term),))
     work = gates.apply_pauli_prod(q, targets, paulis)
     if q.is_density:
         return float(_total_prob_density(work.amps, dim=1 << q.num_qubits))
@@ -183,23 +198,14 @@ def _pauli_term_trace(amps, N, term):
     QuEST_common.c:479-491)."""
     from quest_tpu.ops import apply as A
 
+    from quest_tpu.ops.expec import flipped_trace_diag
+
     x_bits = tuple(q for q, p in enumerate(term) if p in (1, 2))
     zy_bits = tuple(q for q, p in enumerate(term) if p in (2, 3))
     ny = sum(1 for p in term if p == 2)
-    dim = 1 << N
-    # stored layout: flat = row + col*2^N, so the row-major (dim, dim)
-    # view M has M[a, b] = rho[row=b, col=a]; we need M[k^x, k]
-    re = amps[0].reshape((dim, dim))
-    im = amps[1].reshape((dim, dim))
-    if x_bits:
-        x_desc = tuple(sorted(x_bits, reverse=True))
-        dims_a, axis_of_a = A.seg_view(N, x_desc)
-        axes = [axis_of_a[q] for q in x_bits]
-        shape = tuple(dims_a) + (dim,)
-        re = jnp.flip(re.reshape(shape), axis=axes).reshape((dim, dim))
-        im = jnp.flip(im.reshape(shape), axis=axes).reshape((dim, dim))
-    rdiag = jnp.diagonal(re)
-    idiag = jnp.diagonal(im)
+    # the flipped-diagonal extraction (layout subtleties included) has
+    # ONE home: expec.flipped_trace_diag, shared with the grouped path
+    rdiag, idiag = flipped_trace_diag(amps, N, x_bits)
     if zy_bits:
         zy_desc = tuple(sorted(zy_bits, reverse=True))
         dims_k, axis_of_k = A.seg_view(N, zy_desc)
@@ -214,15 +220,24 @@ def _pauli_term_trace(amps, N, term):
 
 
 def calc_expec_pauli_sum(q: Qureg, all_codes, coeffs) -> float:
-    """sum_t c_t <P_t>; codes is (numTerms, numQubits) of Pauli codes."""
-    codes = np.asarray(all_codes, dtype=np.int32).reshape(-1, q.num_qubits)
+    """sum_t c_t <P_t>; codes is (numTerms, numQubits) of Pauli codes.
+
+    Default path: the grouped sweep-fused expectation engine
+    (quest_tpu/ops/expec.py, docs/EXPECTATION.md) — the whole
+    Hamiltonian evaluates in O(#flip-mask-groups) HBM sweeps instead of
+    the per-term pass structure (an all-diagonal sum is ONE pass), with
+    the coefficient vector a runtime operand so coefficient-only
+    changes never retrace. Parsing/validation is memoized by value.
+    Sharded statevectors compute per-shard partials + psum.
+    QUEST_EXPEC_FUSION=0 restores the legacy per-term program."""
+    from quest_tpu.ops import expec as E
+    codes_key = E.parse_pauli_sum(all_codes, q.num_qubits)
     coeffs = np.asarray(coeffs, dtype=np.float64).reshape(-1)
-    val.validate_num_pauli_sum_terms(len(coeffs))
-    val.validate_pauli_codes(codes)
-    if len(coeffs) != codes.shape[0]:
+    if len(coeffs) != len(codes_key):
         val._err("Invalid Pauli sum: must give exactly one coefficient "
                  "per term.")
-    codes_key = tuple(tuple(int(c) for c in term) for term in codes)
+    if E.fusion_enabled():
+        return E.expec_value(q, coeffs, codes_key)
     cf = jnp.asarray(coeffs, dtype=q.real_dtype)
     return float(_expec_pauli_sum(q.amps, cf, codes=codes_key,
                                   n=q.num_state_qubits,
@@ -259,15 +274,14 @@ def _apply_pauli_sum(amps, coeffs, *, codes, n):
 def apply_pauli_sum(q: Qureg, all_codes, coeffs) -> Qureg:
     """Return sum_t c_t P_t |q> (or P_t rho) as a new register — the
     (generally unnormalized) Pauli-sum image (ref statevec_applyPauliSum,
-    QuEST_common.c:493-514) — all terms in ONE traced program."""
-    codes = np.asarray(all_codes, dtype=np.int32).reshape(-1, q.num_qubits)
+    QuEST_common.c:493-514) — all terms in ONE traced program. Parsing
+    and validation share the expectation engine's by-value memo."""
+    from quest_tpu.ops import expec as E
+    codes_key = E.parse_pauli_sum(all_codes, q.num_qubits)
     coeffs = np.asarray(coeffs, dtype=np.float64).reshape(-1)
-    val.validate_num_pauli_sum_terms(len(coeffs))
-    val.validate_pauli_codes(codes)
-    if len(coeffs) != codes.shape[0]:
+    if len(coeffs) != len(codes_key):
         val._err("Invalid Pauli sum: must give exactly one coefficient "
                  "per term.")
-    codes_key = tuple(tuple(int(c) for c in term) for term in codes)
     cf = jnp.asarray(coeffs, dtype=q.real_dtype)  # termCoeffs are real
     return q.replace_amps(_apply_pauli_sum(q.amps, cf, codes=codes_key,
                                            n=q.num_state_qubits))

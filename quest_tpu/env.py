@@ -193,6 +193,19 @@ _KNOB_LIST = (
              "kernel segments (incl. across unrolled iterations) into one "
              "HBM sweep per kernel launch: 1/0 (default: 1)",
          malformed="2", flips=("1", "0")),
+    Knob("QUEST_EXPEC_FUSION", _bool01("QUEST_EXPEC_FUSION"), True,
+         scope="keyed", layer="planner",
+         doc="grouped sweep-fused Pauli-sum expectation engine "
+             "(docs/EXPECTATION.md): 1/0 (default: 1; 0 restores the "
+             "legacy per-term workspace-pass evaluation)",
+         malformed="2", flips=("1", "0")),
+    Knob("QUEST_EXPEC_MAX_MASKS",
+         _int_range("QUEST_EXPEC_MAX_MASKS", 1), 64,
+         scope="keyed", layer="planner",
+         doc="max off-diagonal flip-mask groups co-riding one fused "
+             "expectation sweep — the expectation engine's stage "
+             "budget (default: 64)",
+         malformed="0", flips=("64", "1")),
     Knob("QUEST_BATCH_BUCKET",
          _parse_choice("QUEST_BATCH_BUCKET", ("pow2", "off")), "pow2",
          scope="keyed", layer="planner",

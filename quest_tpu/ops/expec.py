@@ -1,0 +1,685 @@
+"""One-sweep Pauli-sum expectation engine: grouped, sweep-fused
+Hamiltonian reductions (docs/EXPECTATION.md).
+
+The reference evaluates an M-term Pauli sum by cloning the register and
+applying each term to a workspace — one full apply pass PLUS one inner
+product per term, ~2M HBM sweeps (QuEST_common.c:479-491); the port's
+legacy `_expec_pauli_sum` kept that per-term pass structure inside one
+program. Information-theoretically the job is 1-2 sweeps: every term's
+value is an elementwise functional of the state read against ONE
+bit-flip-permuted view of itself,
+
+    <P> = sum_j conj(a_j) * (-i)^{ny} * (-1)^{parity(j & zy)} * a_{j^x}
+
+where x is the term's X/Y support (its FLIP MASK), zy its Z/Y support
+and ny its Y count (the flip-form of ops/apply.apply_pauli_string). So:
+
+  * all DIAGONAL terms (x == 0: I/Z-only) reduce from |a_j|^2 under
+    per-term parity sign masks — ONE pass over the state for the whole
+    diagonal block, coefficients applied per element;
+  * OFF-DIAGONAL terms sharing a flip mask share one
+    conj(a_j) * a_{j^x} product pass — the flipped read is the cost,
+    the per-term zy signs are broadcast sign-vector multiplies;
+  * distinct masks CO-RIDE one fused reduction up to the
+    QUEST_EXPEC_MAX_MASKS budget (the expectation-engine analogue of
+    sweep_plan's stage budget, pallas_band.stage_requirements): the
+    packed groups' contributions add elementwise and reduce once.
+
+A whole Hamiltonian therefore evaluates in O(#mask-groups) HBM sweeps
+instead of O(M). The evaluators are pure jnp elementwise+reduce
+programs — XLA fuses each sweep into one loop over the state (no Pallas
+kernel needed; there is no MXU work to win), which also makes the whole
+engine differentiable: `jax.grad` traces straight through the fused
+forward (the autodiff contract of docs/EXPECTATION.md — no custom VJP,
+no fallback path).
+
+Coefficients are RUNTIME operands: the term structure (codes) is the
+static plan key, the coefficient vector is a traced array, so a VQE
+optimizer changing weights every step never retraces (pinned under
+CompileAuditor in tests/test_expec.py).
+
+Sharded statevectors compute per-shard partial sums + one psum
+(shard_map over the amp mesh, the measurement.sample pattern): local
+flip bits flip in-shard, GLOBAL flip bits become one lax.ppermute
+chunk exchange per distinct global mask (the reference's
+MPI pair exchange, QuEST_cpu_distributed.c:481-509), shared by every
+group in the plan that carries the same global mask. Density registers
+get the grouped tr(H rho) strided-trace: each mask group reads ONE
+flipped diagonal of 2^N entries from the 4^N register
+(the `_pauli_term_trace` trick, now amortized over the group).
+
+Introspection: `plan_stats()` reports `expec_groups` /
+`expec_hbm_sweeps` CPU-side (no compile, no chip) — the golden
+discipline of Circuit.plan_stats, gated in
+scripts/check_expec_golden.py and tests/test_expec.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import precision
+
+# Axis chunk width for parity-sign tables: every non-flip axis of a
+# group view spans at most 2^_SEG_BITS indices, so per-term signs are
+# concrete host tables of <= 256 entries broadcast along <= n/8 axes —
+# NEVER a rank-n tensor (the (2,)*n view exceeds the TPU backend's
+# supported rank for n >~ 16, ops/apply.py module docstring) and never
+# a materialized 2^n sign plane.
+_SEG_BITS = 8
+
+
+# ---------------------------------------------------------------------------
+# term parsing (memoized by value — the validate_kraus_ops pattern)
+# ---------------------------------------------------------------------------
+
+
+_PARSE_CACHE: Dict = {}
+
+
+def parse_pauli_sum(all_codes, num_qubits: int) -> Tuple[Tuple[int, ...], ...]:
+    """Validated (M, num_qubits) Pauli-code rows as a nested tuple key,
+    memoized BY VALUE: repeated VQE-step calls with the same Hamiltonian
+    re-validate nothing (the `validate_kraus_ops` memo pattern of
+    trajectories.py; call-count-pinned in tests/test_expec.py). The
+    returned tuple is the plan/jit cache key, so equal code arrays from
+    different callers resolve to the same compiled programs."""
+    codes = np.ascontiguousarray(
+        np.asarray(all_codes, dtype=np.int32).reshape(-1, num_qubits))
+    key = (num_qubits, codes.shape[0], codes.tobytes())
+    hit = _PARSE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    from quest_tpu import validation as val
+    val.validate_num_pauli_sum_terms(codes.shape[0])
+    val.validate_pauli_codes(codes)
+    codes_key = tuple(tuple(int(c) for c in row) for row in codes)
+    _PARSE_CACHE[key] = codes_key
+    return codes_key
+
+
+# ---------------------------------------------------------------------------
+# the plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Term:
+    """One Pauli string in flip form: coefficient row `index`, X/Y
+    support `x_bits` (the flip mask), Z/Y support `zy_bits` (the sign
+    mask), Y count `ny` (the (-i)^ny phase quarter-turn)."""
+    index: int
+    x_bits: Tuple[int, ...]
+    zy_bits: Tuple[int, ...]
+    ny: int
+
+
+@dataclasses.dataclass(frozen=True)
+class _Group:
+    """Terms sharing one flip mask; x_bits == () is the diagonal group."""
+    x_bits: Tuple[int, ...]
+    terms: Tuple[_Term, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpecPlan:
+    """Static (hashable) evaluation plan: jit programs key on it, so a
+    plan is one compiled program per (register shape, dtype) — and the
+    coefficient VECTOR stays a runtime operand."""
+    n: int                                  # state qubits (2N for density)
+    density: bool
+    num_terms: int
+    groups: Tuple[_Group, ...]
+    sweeps: Tuple[Tuple[int, ...], ...]     # packs of group indices
+
+
+def fusion_enabled() -> bool:
+    """QUEST_EXPEC_FUSION (keyed, default on): grouped sweep-fused
+    Pauli-sum evaluation; 0 restores the legacy per-term pass structure
+    (calculations._expec_pauli_sum / the workspace prod path)."""
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_EXPEC_FUSION")
+
+
+def max_masks_per_sweep() -> int:
+    """QUEST_EXPEC_MAX_MASKS (keyed): how many off-diagonal flip-mask
+    groups may co-ride one fused reduction — the expectation engine's
+    stage budget (sweep_plan's MAX_SWEEP_STAGES analogue)."""
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_EXPEC_MAX_MASKS")
+
+
+def _flip_form(term: Sequence[int], index: int) -> _Term:
+    x_bits = tuple(q for q, p in enumerate(term) if p in (1, 2))
+    zy_bits = tuple(q for q, p in enumerate(term) if p in (2, 3))
+    ny = sum(1 for p in term if p == 2)
+    return _Term(index, x_bits, zy_bits, ny)
+
+
+@functools.lru_cache(maxsize=512)
+def _plan_cached(codes_key, n: int, density: bool,
+                 max_masks: int) -> ExpecPlan:
+    terms = [_flip_form(t, i) for i, t in enumerate(codes_key)]
+    by_mask: Dict[Tuple[int, ...], list] = {}
+    order = []
+    for t in terms:
+        if t.x_bits not in by_mask:
+            by_mask[t.x_bits] = []
+            order.append(t.x_bits)
+        by_mask[t.x_bits].append(t)
+    # diagonal group first: it is always its own (|a|^2) sweep
+    order.sort(key=lambda m: (m != (),))
+    groups = tuple(_Group(m, tuple(by_mask[m])) for m in order)
+    sweeps = []
+    pack = []
+    for gi, g in enumerate(groups):
+        if not g.x_bits:
+            sweeps.append((gi,))
+            continue
+        pack.append(gi)
+        if len(pack) >= max_masks:
+            sweeps.append(tuple(pack))
+            pack = []
+    if pack:
+        sweeps.append(tuple(pack))
+    return ExpecPlan(n=n, density=density, num_terms=len(terms),
+                     groups=groups, sweeps=tuple(sweeps))
+
+
+def plan_expec(codes_key, num_qubits: int, *, density: bool) -> ExpecPlan:
+    """Build (or fetch) the grouped plan for validated code rows.
+    `num_qubits` is the LOGICAL qubit count (codes width); a density
+    plan evaluates on the doubled 2N-qubit register."""
+    n = 2 * num_qubits if density else num_qubits
+    return _plan_cached(tuple(tuple(t) for t in codes_key), n,
+                        bool(density), max_masks_per_sweep())
+
+
+# ---------------------------------------------------------------------------
+# view geometry + parity sign tables
+# ---------------------------------------------------------------------------
+
+
+def _group_view(n: int, x_bits: Tuple[int, ...], seg_bits: int = _SEG_BITS):
+    """Axis layout for a (2^n,) plane: each flip bit gets its own size-2
+    axis (so jnp.flip reverses it), and the contiguous bit ranges
+    between them split into chunks of at most `seg_bits` bits (so
+    per-term parity signs are small concrete tables, never rank-n).
+    Returns (dims, axis_of_flip_bit, ranges) with ranges[axis] =
+    (lo_bit, width) in little-endian bit coordinates, axes MSB-first
+    (the ops/apply.seg_view convention)."""
+    dims, ranges = [], []
+    axis_of: Dict[int, int] = {}
+
+    def push(lo, hi):
+        cut = hi
+        while cut > lo:
+            w = min(seg_bits, cut - lo)
+            dims.append(1 << w)
+            ranges.append((cut - w, w))
+            cut -= w
+
+    prev = n
+    for q in sorted(x_bits, reverse=True):
+        if prev > q + 1:
+            push(q + 1, prev)
+        dims.append(2)
+        ranges.append((q, 1))
+        axis_of[q] = len(dims) - 1
+        prev = q
+    if prev > 0:
+        push(0, prev)
+    if not dims:                      # n == 0 edge (never hit in practice)
+        dims, ranges = [1], [(0, 0)]
+    return tuple(dims), axis_of, tuple(ranges)
+
+
+def _parity_tables(ranges, zy_bits, rdt):
+    """[(axis, concrete (+1/-1) vector)] for the axes whose bit range
+    intersects `zy_bits`: table[v] = (-1)^{parity(v & local mask)}. The
+    broadcast PRODUCT of these along the group view is the term's full
+    parity sign — factored per axis, so nothing 2^n-sized ever exists
+    (the parity_sign idiom of ops/apply.py, generalized from size-2
+    axes to bit-range chunks)."""
+    zy = frozenset(zy_bits)
+    out = []
+    for ax, (lo, w) in enumerate(ranges):
+        bits = [b for b in range(lo, lo + w) if b in zy]
+        if not bits:
+            continue
+        idx = np.arange(1 << w)
+        par = np.zeros(1 << w, dtype=np.int64)
+        for b in bits:
+            par ^= (idx >> (b - lo)) & 1
+        out.append((ax, (1.0 - 2.0 * par).astype(rdt)))
+    return out
+
+
+def _signed_weight(cf, t: _Term, extra_sign=None):
+    """Traced scalar weight of term `t`: its coefficient times the sign
+    of the real part of the (-i)^ny quarter-turn (Re[(-i)^ny z] is
+    +zr, +zi, -zr, -zi for ny%4 = 0..3 — the plane itself is selected
+    by the caller). `extra_sign` multiplies in a per-shard global
+    parity sign (the sharded path's device-bit contribution)."""
+    w = cf[t.index]
+    if t.ny % 4 in (2, 3):
+        w = -w
+    if extra_sign is not None:
+        w = w * extra_sign
+    return w
+
+
+def _apply_sign_tables(plane, tables, ndims):
+    for ax, tab in tables:
+        shape = [1] * ndims
+        shape[ax] = tab.size
+        plane = plane * jnp.asarray(tab).reshape(shape)
+    return plane
+
+
+# ---------------------------------------------------------------------------
+# statevector evaluation
+# ---------------------------------------------------------------------------
+
+
+def _group_contrib_sv(ar, ai, fr, fi, group: _Group, cf, ranges, ndims):
+    """Elementwise contribution of one mask group over its view: the
+    shared conj(a) * a_flip products, each term's parity-sign
+    broadcast multiply and runtime coefficient, summed — ONE fused
+    XLA expression reading the state (and its flipped image) once.
+    `fr`/`fi` are the (already flipped) source planes; for the diagonal
+    group they alias `ar`/`ai`."""
+    if group.x_bits:
+        base_re = ar * fr + ai * fi          # Re conj(a_j) a_{j^x}
+        need_im = any(t.ny % 2 for t in group.terms)
+        base_im = (ar * fi - ai * fr) if need_im else None
+    else:
+        base_re = ar * ar + ai * ai          # |a_j|^2; ny == 0 for I/Z
+        base_im = None
+    rdt = np.dtype(base_re.dtype)
+    contrib = None
+    for t in group.terms:
+        plane = base_re if t.ny % 2 == 0 else base_im
+        term = _apply_sign_tables(plane, _parity_tables(ranges, t.zy_bits,
+                                                        rdt), ndims)
+        term = term * _signed_weight(cf, t)
+        contrib = term if contrib is None else contrib + term
+    return contrib
+
+
+def _sweep_value_sv(amps, cf, plan: ExpecPlan, pack, acc):
+    """One co-ride pack = one fused reduction: every group's elementwise
+    contribution flattens and adds, then reduces ONCE (the f64
+    accumulator convert fuses into the reduce — the _sum_sq
+    discipline)."""
+    flat = None
+    for gi in pack:
+        g = plan.groups[gi]
+        dims, axis_of, ranges = _group_view(plan.n, g.x_bits)
+        ar = amps[0].reshape(dims)
+        ai = amps[1].reshape(dims)
+        if g.x_bits:
+            axes = [axis_of[q] for q in g.x_bits]
+            fr = jnp.flip(ar, axes)
+            fi = jnp.flip(ai, axes)
+        else:
+            fr, fi = ar, ai
+        c = _group_contrib_sv(ar, ai, fr, fi, g, cf, ranges,
+                              len(dims)).reshape(-1)
+        flat = c if flat is None else flat + c
+    return jnp.sum(flat.astype(acc))
+
+
+def expec_traced(amps, coeffs, plan: ExpecPlan):
+    """The traced fused evaluation — sum_t c_t <P_t> over `plan` with
+    runtime `coeffs`. Composable: variational energies and the serve
+    reducers trace through this inside their own jit; jax.grad flows
+    through every op (docs/EXPECTATION.md autodiff contract)."""
+    acc = precision.accum_dtype(amps.dtype)
+    cf = jnp.asarray(coeffs, dtype=amps.dtype)
+    total = jnp.zeros((), dtype=acc)
+    for pack in plan.sweeps:
+        if plan.density:
+            total = total + _sweep_value_density(amps, cf, plan, pack, acc)
+        else:
+            total = total + _sweep_value_sv(amps, cf, plan, pack, acc)
+    return total
+
+
+@partial(jax.jit, static_argnames=("plan",))
+def _expec_fused(amps, coeffs, *, plan: ExpecPlan):
+    return expec_traced(amps, coeffs, plan)
+
+
+# ---------------------------------------------------------------------------
+# density evaluation: grouped tr(H rho) strided trace
+# ---------------------------------------------------------------------------
+
+
+def flipped_trace_diag(amps, N: int, x_bits):
+    """(Re, Im) of the flipped diagonal rho[k, k^x] as (2^N,) vectors —
+    the 2^N entries a Pauli trace touches in the 4^N register.
+
+    Stored layout: flat = row + col*2^N, so the row-major (dim, dim)
+    view M has M[a, b] = rho[b, a]; flipping the listed first-axis bits
+    and reading the main diagonal yields rho[k, k^x]. The ONE home of
+    this extraction — the grouped density sweeps here and the legacy
+    per-term `_pauli_term_trace` (calculations.py) both call it."""
+    from quest_tpu.ops import apply as A
+
+    dim = 1 << N
+    re = amps[0].reshape((dim, dim))
+    im = amps[1].reshape((dim, dim))
+    if x_bits:
+        x_desc = tuple(sorted(x_bits, reverse=True))
+        dims_a, axis_of_a = A.seg_view(N, x_desc)
+        axes = [axis_of_a[q] for q in x_bits]
+        shape = tuple(dims_a) + (dim,)
+        re = jnp.flip(re.reshape(shape), axis=axes).reshape((dim, dim))
+        im = jnp.flip(im.reshape(shape), axis=axes).reshape((dim, dim))
+    return jnp.diagonal(re), jnp.diagonal(im)
+
+
+def _sweep_value_density(amps, cf, plan: ExpecPlan, pack, acc):
+    """Density pack: each group reads ONE flipped diagonal — 2^N
+    entries of the 4^N register, Tr(P rho) = sum_k coef(k) rho[k, k^x]
+    (the `_pauli_term_trace` gather, amortized over every term sharing
+    the mask) — then per-term parity signs and coefficients apply on
+    the (2^N,) diagonal and the pack reduces once."""
+    N = plan.n // 2
+    flat = None
+    for gi in pack:
+        g = plan.groups[gi]
+        rdiag, idiag = flipped_trace_diag(amps, N, g.x_bits)
+        dims, _, ranges = _group_view(N, ())
+        rdiag = rdiag.reshape(dims)
+        idiag = idiag.reshape(dims)
+        rdt = np.dtype(rdiag.dtype)
+        contrib = None
+        for t in g.terms:
+            # Re(i^{ny} (rdiag + i idiag)): +r, -i, -r, +i per ny % 4
+            k = t.ny % 4
+            plane = rdiag if k % 2 == 0 else idiag
+            w = cf[t.index]
+            if k in (1, 2):
+                w = -w
+            term = _apply_sign_tables(plane,
+                                      _parity_tables(ranges, t.zy_bits, rdt),
+                                      len(dims))
+            term = term * w
+            contrib = term if contrib is None else contrib + term
+        contrib = contrib.reshape(-1)
+        flat = contrib if flat is None else flat + contrib
+    return jnp.sum(flat.astype(acc))
+
+
+# ---------------------------------------------------------------------------
+# sharded statevector evaluation (per-shard partials + psum)
+# ---------------------------------------------------------------------------
+
+
+# jitted shard_map evaluators, keyed (mesh object, plan, D) — the
+# measurement.sample cache discipline: rebuilding the wrapper per call
+# would retrace every evaluation
+_SHARDED_RUNS: Dict = {}
+
+
+def _device_parity_sign(dev, bits, rdt):
+    """(+1/-1) traced scalar: parity of the device index over the
+    listed (device-local) global bit positions."""
+    par = None
+    for b in bits:
+        bit = (dev >> b) & 1
+        par = bit if par is None else par ^ bit
+    return (1 - 2 * par).astype(rdt)
+
+
+def _group_contrib_sharded(amps, cf, local_n, dev, group: _Group,
+                           exchanged: Dict):
+    """Per-shard contribution of one mask group. Local flip bits flip
+    in-shard; GLOBAL flip bits are one ppermute chunk exchange with
+    device dev ^ gmask (the reference's MPI pair exchange), fetched
+    once per distinct global mask and shared by every group carrying
+    it. Global zy bits contribute a per-device scalar sign (their
+    parity is constant over the shard)."""
+    from quest_tpu.env import AMP_AXIS
+
+    lx = tuple(q for q in group.x_bits if q < local_n)
+    gxm = 0
+    for q in group.x_bits:
+        if q >= local_n:
+            gxm |= 1 << (q - local_n)
+    src = amps
+    if gxm:
+        src = exchanged.get(gxm)
+        if src is None:
+            D = exchanged["__D__"]
+            perm = [(d, d ^ gxm) for d in range(D)]
+            src = jax.lax.ppermute(amps, AMP_AXIS, perm)
+            exchanged[gxm] = src
+    dims, axis_of, ranges = _group_view(local_n, lx)
+    ar = amps[0].reshape(dims)
+    ai = amps[1].reshape(dims)
+    sr = src[0].reshape(dims)
+    si = src[1].reshape(dims)
+    if lx:
+        axes = [axis_of[q] for q in lx]
+        sr = jnp.flip(sr, axes)
+        si = jnp.flip(si, axes)
+    if group.x_bits:
+        base_re = ar * sr + ai * si
+        need_im = any(t.ny % 2 for t in group.terms)
+        base_im = (ar * si - ai * sr) if need_im else None
+    else:
+        base_re = ar * ar + ai * ai
+        base_im = None
+    rdt = np.dtype(base_re.dtype)
+    ndims = len(dims)
+    contrib = None
+    for t in group.terms:
+        plane = base_re if t.ny % 2 == 0 else base_im
+        lzy = tuple(b for b in t.zy_bits if b < local_n)
+        term = _apply_sign_tables(plane, _parity_tables(ranges, lzy, rdt),
+                                  ndims)
+        gzy = tuple(b - local_n for b in t.zy_bits if b >= local_n)
+        extra = _device_parity_sign(dev, gzy, amps.dtype) if gzy else None
+        term = term * _signed_weight(cf, t, extra)
+        contrib = term if contrib is None else contrib + term
+    return contrib.reshape(-1)
+
+
+def _expec_sharded_body(amps, coeffs, *, plan: ExpecPlan, D: int):
+    from quest_tpu.env import AMP_AXIS
+
+    local_n = plan.n - (D.bit_length() - 1)
+    dev = jax.lax.axis_index(AMP_AXIS)
+    acc = precision.accum_dtype(amps.dtype)
+    cf = jnp.asarray(coeffs, dtype=amps.dtype)
+    exchanged: Dict = {"__D__": D}
+    total = jnp.zeros((), dtype=acc)
+    for pack in plan.sweeps:
+        flat = None
+        for gi in pack:
+            c = _group_contrib_sharded(amps, cf, local_n, dev,
+                                       plan.groups[gi], exchanged)
+            flat = c if flat is None else flat + c
+        total = total + jnp.sum(flat.astype(acc))
+    return jax.lax.psum(total, AMP_AXIS)
+
+
+def expec_sharded(amps, coeffs, plan: ExpecPlan, mesh):
+    """Fused expectation of a mesh-sharded statevector: per-shard
+    partial sums + one psum, the state never gathers. Bit-/eps-equal to
+    the single-device fused result (pinned on the 2-dev CPU mesh in
+    tests/test_expec.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from quest_tpu import compat
+    from quest_tpu.env import AMP_AXIS
+
+    D = int(mesh.devices.size)
+    ck = (mesh, plan, D)
+    run = _SHARDED_RUNS.get(ck)
+    if run is None:
+        body = partial(_expec_sharded_body, plan=plan, D=D)
+        run = jax.jit(compat.shard_map(body, mesh,
+                                       (P(None, AMP_AXIS), P()), P()))
+        _SHARDED_RUNS[ck] = run
+    return run(amps, coeffs)
+
+
+# ---------------------------------------------------------------------------
+# register-level entry + introspection
+# ---------------------------------------------------------------------------
+
+
+def expec_value(q, coeffs, codes_key) -> float:
+    """sum_t c_t <P_t> of register `q` through the grouped fused
+    engine. Dispatch: sharded statevectors ride the shard_map
+    partial-sum path; everything else (single device, density — GSPMD
+    partitions the density trace fine) the jitted fused program."""
+    plan = plan_expec(codes_key, q.num_qubits, density=q.is_density)
+    cf = jnp.asarray(coeffs, dtype=q.real_dtype)
+    if not q.is_density:
+        from quest_tpu.env import AMP_AXIS
+        mesh = getattr(getattr(q.amps, "sharding", None), "mesh", None)
+        if (mesh is not None and mesh.devices.size > 1
+                and AMP_AXIS in mesh.axis_names):
+            return float(expec_sharded(q.amps, cf, plan, mesh))
+    return float(_expec_fused(q.amps, cf, plan=plan))
+
+
+def plan_stats(all_codes, num_qubits: int, *, density: bool = False) -> dict:
+    """CPU-assertable plan introspection (no compile, no chip — the
+    Circuit.plan_stats discipline): term/group/sweep counts of the
+    grouped plan vs the per-term baseline's pass count. With
+    QUEST_EXPEC_FUSION=0 the reported `expec_hbm_sweeps` is the
+    baseline's (that is what dispatch would run)."""
+    codes_key = parse_pauli_sum(all_codes, num_qubits)
+    plan = plan_expec(codes_key, num_qubits, density=density)
+    diag = sum(len(g.terms) for g in plan.groups if not g.x_bits)
+    # baseline: one workspace apply + one inner-product pass per term
+    # (statevector); one strided diagonal gather per term (density)
+    baseline = (1 if density else 2) * plan.num_terms
+    fused = fusion_enabled()
+    return {
+        "terms": plan.num_terms,
+        "expec_groups": len(plan.groups),
+        "diagonal_terms": diag,
+        "expec_hbm_sweeps": len(plan.sweeps) if fused else baseline,
+        "baseline_hbm_sweeps": baseline,
+        "max_masks_per_sweep": max_masks_per_sweep(),
+        "fusion": fused,
+    }
+
+
+def explain(all_codes, num_qubits: int, *, density: bool = False) -> str:
+    """Human-readable plan dump (the explain() counterpart of
+    plan_stats): one line per sweep with its mask groups."""
+    codes_key = parse_pauli_sum(all_codes, num_qubits)
+    plan = plan_expec(codes_key, num_qubits, density=density)
+    stats = plan_stats(all_codes, num_qubits, density=density)
+    of_kind = "density tr(H rho)" if density else "statevec"
+    lines = [f"expec plan: {plan.num_terms} terms -> "
+             f"{stats['expec_groups']} mask groups -> "
+             f"{len(plan.sweeps)} sweeps ({of_kind}; baseline "
+             f"{stats['baseline_hbm_sweeps']} passes)"]
+    for si, pack in enumerate(plan.sweeps):
+        parts = []
+        for gi in pack:
+            g = plan.groups[gi]
+            mask = ("diagonal" if not g.x_bits
+                    else "x=" + ",".join(map(str, g.x_bits)))
+            parts.append(f"{mask}({len(g.terms)}t)")
+        lines.append(f"  sweep {si}: " + "  ".join(parts))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Pauli-sum observable spec (serve / variational surface)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PauliSum:
+    """Value-hashable Pauli-sum spec: `codes` is an (M, num_qubits)
+    nested tuple of Pauli codes (0=I 1=X 2=Y 3=Z), `coeffs` the M real
+    weights. Build via `PauliSum.of(...)` (validates + normalizes).
+    Accepted by `ServeEngine.submit(observable=...)` and
+    `variational.expectation` — both resolve it to the grouped fused
+    reduction; equal specs resolve to the SAME reducer object, so a
+    serve batch of like requests runs one compiled reduction per
+    launch."""
+    codes: Tuple[Tuple[int, ...], ...]
+    coeffs: Tuple[float, ...]
+
+    @classmethod
+    def of(cls, all_codes, coeffs, num_qubits: int) -> "PauliSum":
+        codes_key = parse_pauli_sum(all_codes, num_qubits)
+        cf = np.asarray(coeffs, dtype=np.float64).reshape(-1)
+        if len(cf) != len(codes_key):
+            from quest_tpu import validation as val
+            val._err("Invalid Pauli sum: must give exactly one "
+                     "coefficient per term.")
+        return cls(codes=codes_key, coeffs=tuple(float(c) for c in cf))
+
+    @property
+    def num_qubits(self) -> int:
+        return len(self.codes[0]) if self.codes else 0
+
+
+def batched_reducer(spec: PauliSum, num_qubits: int, density: bool = False):
+    """(B, 2, 2^n) planes -> (B,) fused expectations — the serve
+    `observable=` reduction (engine.py demux contract: reduce the
+    CONSTANT bucket-shaped planes on device, values sliced per request
+    after). lru-cached by spec VALUE plus the co-ride budget (the
+    keyed-knob contract: a QUEST_EXPEC_MAX_MASKS flip must resolve to
+    a fresh plan, never a stale cached reducer): equal PauliSums from
+    different requests share one callable, so the demux's per-id
+    reduction cache coalesces them into one launch-side reduction.
+    Zero-padded batch rows reduce to 0 and are sliced off by the
+    caller."""
+    return _batched_reducer_cached(spec, num_qubits, density,
+                                   max_masks_per_sweep())
+
+
+@functools.lru_cache(maxsize=128)
+def _batched_reducer_cached(spec: PauliSum, num_qubits: int, density: bool,
+                            max_masks: int):
+    plan = _plan_cached(spec.codes,
+                        2 * num_qubits if density else num_qubits,
+                        density, max_masks)
+    coeffs = np.asarray(spec.coeffs, dtype=np.float64)
+
+    @jax.jit
+    def reduce(planes_b):
+        planes_b = jnp.asarray(planes_b)
+        cf = jnp.asarray(coeffs, dtype=planes_b.dtype)
+        return jax.vmap(lambda a: expec_traced(a, cf, plan))(planes_b)
+
+    return reduce
+
+
+def resolve_observable(spec, num_qubits: int, density: bool = False):
+    """Serve-side spec resolution: a `PauliSum` (or a bare
+    (codes, coeffs) pair) becomes the cached batched fused reducer.
+    Width mismatches fail loudly at submit time, not at demux."""
+    if not isinstance(spec, PauliSum):
+        if isinstance(spec, tuple) and len(spec) == 2:
+            spec = PauliSum.of(spec[0], spec[1], num_qubits)
+        else:
+            raise TypeError(
+                f"observable must be a callable, a PauliSum, or a "
+                f"(codes, coeffs) pair; got {type(spec).__name__}")
+    if spec.num_qubits != num_qubits:
+        raise ValueError(
+            f"PauliSum is over {spec.num_qubits} qubits but the "
+            f"circuit has {num_qubits}")
+    return batched_reducer(spec, num_qubits, density)

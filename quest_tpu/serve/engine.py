@@ -263,7 +263,12 @@ class ServeEngine:
             With `observable=`, the callable reduces the bucket-shaped
             (B, 2, 2^n) planes ON DEVICE (same convention as
             trajectory observables) and the future resolves to this
-            request's row of its output.
+            request's row of its output. Instead of a callable, an
+            `expec.PauliSum` spec (or a bare (codes, coeffs) pair)
+            is accepted on BOTH request kinds and resolves to the
+            grouped sweep-fused Pauli-sum reduction
+            (docs/EXPECTATION.md); equal specs share one compiled
+            reduction per launch.
           * `shots` — that many stochastic trajectories of the
             circuit (`trajectories.run_batched` semantics, including
             the per-shot key chain: `key` defaults to jax.random.key(0)
@@ -285,6 +290,18 @@ class ServeEngine:
             raise ValueError(
                 "submit() takes exactly one of state= (apply request) "
                 "or shots= (trajectory request)")
+        if observable is not None and not callable(observable):
+            # a Pauli-sum spec (expec.PauliSum or a (codes, coeffs)
+            # pair) resolves HERE — at admission, so a width mismatch
+            # rejects the submit, not a batch-mate's demux — to the
+            # cached fused batched reducer (docs/EXPECTATION.md).
+            # Equal specs resolve to the SAME callable, so the demux's
+            # per-identity reduction cache runs one compiled reduction
+            # per launch for a batch of like observables.
+            from quest_tpu.ops.expec import resolve_observable
+            observable = resolve_observable(observable,
+                                            circuit.num_qubits,
+                                            density=density)
         now = time.monotonic()
         if state is not None:
             kind = "apply"

@@ -127,37 +127,52 @@ def cz(amps, n, q1, q2):
                                       jnp.asarray(0.0, amps.dtype)))
 
 
-def expectation(ansatz: Callable, n: int, all_codes, coeffs,
+def expectation(ansatz: Callable, n: int, all_codes, coeffs=None,
                 initial_index: int = 0, dtype=np.float32) -> Callable:
     """Build `energy(params) -> float`: <psi(params)| H |psi(params)> for
     the Pauli-sum H = sum_t coeffs[t] * P_t (codes as in
-    calc_expec_pauli_sum: one 0..3 code per qubit per term).
+    calc_expec_pauli_sum: one 0..3 code per qubit per term), or an
+    `expec.PauliSum` spec passed as `all_codes` (coeffs omitted).
 
-    The returned function is pure and traced end-to-end: wrap it in
-    jax.jit, differentiate with jax.grad, batch with jax.vmap. The
-    ansatz receives ((2, 2^n) planes, params) and returns new planes.
-    `dtype` is the real plane dtype (float32 matches the TPU fast path;
-    float64 needs jax_enable_x64)."""
+    The Hamiltonian evaluates through the grouped sweep-fused
+    expectation engine (ops/expec, docs/EXPECTATION.md): terms sharing
+    a flip mask share one conj(a)*a_flip pass, so a TFIM-class energy
+    is 2 sweeps instead of one workspace pass per term. The returned
+    function is pure and traced end-to-end: wrap it in jax.jit,
+    differentiate with jax.grad (the fused forward is plain XLA — the
+    gradient traces straight through, parity-pinned against the eager
+    per-term path in tests/test_expec.py), batch with jax.vmap or
+    `sweep`. The ansatz receives ((2, 2^n) planes, params) and returns
+    new planes. `dtype` is the real plane dtype (float32 matches the
+    TPU fast path; float64 needs jax_enable_x64)."""
     from quest_tpu import validation as val
-    from quest_tpu.calculations import _pauli_prod_amps
+    from quest_tpu.ops import expec as E
     from quest_tpu.state import basis_planes
 
-    codes = np.asarray(all_codes, dtype=np.int32).reshape(-1, n)
-    coeffs = np.asarray(coeffs, dtype=np.float64).reshape(-1)
-    val.validate_num_pauli_sum_terms(len(coeffs))
-    val.validate_pauli_codes(codes)
-    codes_key = tuple(tuple(int(c) for c in term) for term in codes)
+    if isinstance(all_codes, E.PauliSum):
+        if coeffs is not None:
+            raise ValueError("pass coefficients inside the PauliSum, "
+                             "not as a separate coeffs= argument")
+        if all_codes.num_qubits != n:
+            raise ValueError(
+                f"PauliSum is over {all_codes.num_qubits} qubits but "
+                f"the ansatz register has {n}")
+        codes_key = E.parse_pauli_sum(np.asarray(all_codes.codes), n)
+        coeffs = np.asarray(all_codes.coeffs, dtype=np.float64)
+    else:
+        codes_key = E.parse_pauli_sum(all_codes, n)
+        coeffs = np.asarray(coeffs, dtype=np.float64).reshape(-1)
+    if len(coeffs) != len(codes_key):
+        val._err("Invalid Pauli sum: must give exactly one coefficient "
+                 "per term.")
+    plan = E.plan_expec(codes_key, n, density=False)
     rdt = np.dtype(dtype)
 
     def energy(params):
         amps = basis_planes(initial_index, n=n, rdt=rdt)
         amps = ansatz(amps, params)
-        total = jnp.zeros((), dtype=amps.dtype)
-        for i, term in enumerate(codes_key):
-            w = _pauli_prod_amps(amps, n, term)
-            total = total + jnp.asarray(coeffs[i], amps.dtype) * jnp.sum(
-                amps[0] * w[0] + amps[1] * w[1])
-        return total
+        return E.expec_traced(amps, jnp.asarray(coeffs, amps.dtype),
+                              plan).astype(amps.dtype)
 
     return energy
 

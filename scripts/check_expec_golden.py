@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""CI smoke gate for the grouped Pauli-sum expectation engine
+(docs/EXPECTATION.md): fails if the grouped planner regresses above the
+committed golden sweep counts, asserted CPU-side through
+quest_tpu.ops.expec.plan_stats — pure host planning, no compile, no
+chip (the check_sweep_golden.py discipline).
+
+Goldens: an M-term all-diagonal sum is ONE |amp|^2 sweep; the 30q TFIM
+sum (30 ZZ + 30 X) is at most 2 mask-group sweeps vs the per-term
+baseline's 120 passes; the bench's 100-term random-support scenario
+stays within 3 sweeps. The goldens live HERE and are mirrored by the
+tier-1 assertions in tests/test_expec.py; a planner change that moves
+either must update both, consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DIAG_GOLDEN_SWEEPS = 1
+TFIM30_GOLDEN_SWEEPS = 2
+RANDOM100_GOLDEN_SWEEPS = 3
+
+
+def main() -> int:
+    import numpy as np
+
+    import bench
+    from quest_tpu.ops import expec as E
+
+    rng = np.random.default_rng(7)
+    diag = E.plan_stats(np.where(rng.random((40, 30)) < 0.4, 3, 0), 30)
+    tfim = E.plan_stats(bench._build_tfim_sum(30)[0], 30)
+    rand = E.plan_stats(bench._build_random_support_sum(30)[0], 30)
+    rec = {
+        "diag30_expec_hbm_sweeps": diag["expec_hbm_sweeps"],
+        "tfim30_expec_hbm_sweeps": tfim["expec_hbm_sweeps"],
+        "tfim30_baseline_hbm_sweeps": tfim["baseline_hbm_sweeps"],
+        "random100_expec_hbm_sweeps": rand["expec_hbm_sweeps"],
+        "random100_expec_groups": rand["expec_groups"],
+        "random100_baseline_hbm_sweeps": rand["baseline_hbm_sweeps"],
+    }
+    print(json.dumps(rec))
+    ok = True
+    if diag["expec_hbm_sweeps"] > DIAG_GOLDEN_SWEEPS:
+        print(f"REGRESSION: all-diagonal sum expec_hbm_sweeps "
+              f"{diag['expec_hbm_sweeps']} > golden {DIAG_GOLDEN_SWEEPS}",
+              file=sys.stderr)
+        ok = False
+    if tfim["expec_hbm_sweeps"] > TFIM30_GOLDEN_SWEEPS:
+        print(f"REGRESSION: TFIM-30 expec_hbm_sweeps "
+              f"{tfim['expec_hbm_sweeps']} > golden {TFIM30_GOLDEN_SWEEPS}",
+              file=sys.stderr)
+        ok = False
+    if not tfim["expec_hbm_sweeps"] * 10 <= tfim["baseline_hbm_sweeps"]:
+        print("REGRESSION: TFIM-30 sweep reduction below 10x the "
+              "per-term baseline", file=sys.stderr)
+        ok = False
+    if rand["expec_hbm_sweeps"] > RANDOM100_GOLDEN_SWEEPS:
+        print(f"REGRESSION: 100-term random-support sum "
+              f"expec_hbm_sweeps {rand['expec_hbm_sweeps']} > golden "
+              f"{RANDOM100_GOLDEN_SWEEPS}", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,404 @@
+"""Grouped sweep-fused Pauli-sum expectation engine (ISSUE 8,
+quest_tpu/ops/expec.py, docs/EXPECTATION.md).
+
+Correctness: randomized Pauli sums against the dense numpy oracle on
+statevector, density, sharded (2-dev CPU mesh) and f64 registers —
+documented eps 1e-4 (f32 planes) / 1e-11 (f64; the engine is
+elementwise+reduce, no matmuls, so the f64 path needs no limb scheme).
+Structure: the CPU-assertable plan goldens (all-diagonal sum == 1
+sweep, 30q TFIM <= 2 mask-group sweeps vs the per-term baseline's
+~2M), the coefficient-as-runtime-operand zero-retrace pin, the
+prod-path/sum-path program identity (no workspace register), the
+by-value parse memo call count, and jax.grad parity of the fused
+energy against the eager per-term path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu import calculations as C
+from quest_tpu import validation as val
+from quest_tpu import variational as V
+from quest_tpu.ops import expec as E
+from quest_tpu.state import init_state_from_amps, basis_planes
+
+from . import oracle
+from .helpers import N, max_mesh_devices
+
+PAULI_MATS = {0: np.eye(2), 1: np.array([[0, 1], [1, 0]]),
+              2: np.array([[0, -1j], [1j, 0]]), 3: np.array([[1, 0], [0, -1]])}
+
+
+def pauli_sum_matrix(n, codes, coeffs):
+    """Dense sum_t c_t P_t; code bit convention: codes[t][q] acts on
+    qubit q = bit q of the flat index (little-endian)."""
+    dim = 1 << n
+    H = np.zeros((dim, dim), dtype=np.complex128)
+    for term, c in zip(codes, coeffs):
+        op = np.eye(1)
+        for q in reversed(range(n)):
+            op = np.kron(op, PAULI_MATS[int(term[q])])
+        H = H + c * op
+    return H
+
+
+def load_sv(vec, dtype=np.complex128):
+    n = int(np.log2(len(vec)))
+    q = qt.create_qureg(n, dtype=dtype)
+    return init_state_from_amps(q, vec.real, vec.imag)
+
+
+def load_dm(rho, dtype=np.complex128):
+    n = int(np.log2(rho.shape[0]))
+    q = qt.create_density_qureg(n, dtype=dtype)
+    flat = rho.reshape(-1, order="F")
+    return init_state_from_amps(q, flat.real, flat.imag)
+
+
+def random_sum(rng, n, terms):
+    codes = rng.integers(0, 4, size=(terms, n))
+    # guarantee coverage of every structural class over the run:
+    # a diagonal term, an identity term, and a repeated-mask pair
+    if terms >= 4:
+        codes[0] = np.where(rng.random(n) < 0.5, 3, 0)     # diagonal
+        codes[1] = 0                                       # identity
+        codes[3] = codes[2]                                # shared mask
+    coeffs = rng.standard_normal(terms)
+    return codes, coeffs
+
+
+def _tol(dtype):
+    return 1e-4 if np.dtype(dtype) == np.complex64 else 1e-11
+
+
+# ---------------------------------------------------------------------------
+# randomized oracle equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_statevector_random_sums_vs_oracle(rng, dtype):
+    for terms in (1, 5, 12):
+        codes, coeffs = random_sum(rng, N, terms)
+        v = oracle.random_statevector(N, rng)
+        want = (v.conj() @ pauli_sum_matrix(N, codes, coeffs) @ v).real
+        got = C.calc_expec_pauli_sum(load_sv(v, dtype), codes, coeffs)
+        assert got == pytest.approx(want, abs=_tol(dtype))
+
+
+def test_density_random_sums_vs_oracle(rng, dtype):
+    for terms in (1, 5, 12):
+        codes, coeffs = random_sum(rng, N, terms)
+        rho = oracle.random_density(N, rng)
+        want = np.trace(pauli_sum_matrix(N, codes, coeffs) @ rho).real
+        got = C.calc_expec_pauli_sum(load_dm(rho, dtype), codes, coeffs)
+        assert got == pytest.approx(want, abs=_tol(dtype))
+
+
+def test_matches_legacy_per_term_path(rng, dtype, monkeypatch):
+    """Fused vs QUEST_EXPEC_FUSION=0 (the reference-shaped per-term
+    program) on the same register — the knob changes the pass
+    structure, never the value."""
+    codes, coeffs = random_sum(rng, N, 9)
+    sv = load_sv(oracle.random_statevector(N, rng), dtype)
+    dm = load_dm(oracle.random_density(N, rng), dtype)
+    got_sv = C.calc_expec_pauli_sum(sv, codes, coeffs)
+    got_dm = C.calc_expec_pauli_sum(dm, codes, coeffs)
+    monkeypatch.setenv("QUEST_EXPEC_FUSION", "0")
+    assert C.calc_expec_pauli_sum(sv, codes, coeffs) == pytest.approx(
+        got_sv, abs=_tol(dtype))
+    assert C.calc_expec_pauli_sum(dm, codes, coeffs) == pytest.approx(
+        got_dm, abs=_tol(dtype))
+
+
+def test_sharded_2dev_matches_single_device(rng, dtype):
+    """Per-shard partials + psum on the 2-dev CPU mesh — eps-equal to
+    the single-device fused result (the acceptance pin). Exercises
+    local flips, GLOBAL flips (top-qubit X/Y terms force the ppermute
+    exchange) and global zy signs."""
+    from quest_tpu.parallel import make_amp_mesh, shard_qureg
+    mesh = make_amp_mesh(2)
+    codes, coeffs = random_sum(rng, N, 10)
+    # force a global-flip group and a global-sign group explicitly
+    codes[4] = 0
+    codes[4][N - 1] = 1        # X on the device-boundary qubit
+    codes[5] = 0
+    codes[5][N - 1] = 3        # Z on the device-boundary qubit
+    v = oracle.random_statevector(N, rng)
+    q = load_sv(v, dtype)
+    want = C.calc_expec_pauli_sum(q, codes, coeffs)
+    got = C.calc_expec_pauli_sum(shard_qureg(q, mesh), codes, coeffs)
+    assert got == pytest.approx(want, abs=_tol(dtype))
+    # and still the oracle's value
+    exact = (v.conj() @ pauli_sum_matrix(N, codes, coeffs) @ v).real
+    assert got == pytest.approx(exact, abs=_tol(dtype))
+
+
+def test_sharded_density_vs_oracle(rng):
+    """Sharded density registers ride the jitted fused trace (GSPMD
+    partitions the diagonal gather) — value parity is what matters."""
+    from quest_tpu.parallel import make_amp_mesh, shard_qureg
+    mesh = make_amp_mesh(max_mesh_devices())
+    codes, coeffs = random_sum(rng, N, 6)
+    rho = oracle.random_density(N, rng)
+    want = np.trace(pauli_sum_matrix(N, codes, coeffs) @ rho).real
+    q = shard_qureg(load_dm(rho), mesh)
+    assert C.calc_expec_pauli_sum(q, codes, coeffs) == pytest.approx(
+        want, abs=1e-11)
+
+
+def test_prod_routes_through_engine_no_workspace(rng, dtype):
+    """calc_expec_pauli_prod == oracle AND compiles into the one-term
+    sum program: after warming the equivalent 1-term sum, the prod
+    call traces NOTHING (program identity — so no workspace register
+    exists on the fused path; the legacy path cloned the state)."""
+    targets, codes = [1, 3, 4], [1, 2, 3]
+    v = oracle.random_statevector(N, rng)
+    op = pauli_sum_matrix(
+        N, [[codes[targets.index(q)] if q in targets else 0
+             for q in range(N)]], [1.0])
+    q = load_sv(v, dtype)
+    got = C.calc_expec_pauli_prod(q, targets, codes)
+    assert got == pytest.approx((v.conj() @ op @ v).real, abs=_tol(dtype))
+
+    term = np.zeros(N, dtype=np.int32)
+    for t, p in zip(targets, codes):
+        term[t] = p
+    C.calc_expec_pauli_sum(q, term.reshape(1, -1), [1.0])   # warm
+    from quest_tpu.analysis.audit import CompileAuditor
+    with CompileAuditor() as aud:
+        C.calc_expec_pauli_prod(q, targets, codes)
+    aud.assert_no_retrace("one-term prod after its sum-path twin")
+
+
+# ---------------------------------------------------------------------------
+# plan goldens (CPU-assertable — no compile, no chip)
+# ---------------------------------------------------------------------------
+
+
+def tfim_codes(n):
+    rows = []
+    for i in range(n):
+        r = [0] * n
+        r[i] = 3
+        r[(i + 1) % n] = 3
+        rows.append(r)
+    for i in range(n):
+        r = [0] * n
+        r[i] = 1
+        rows.append(r)
+    return np.asarray(rows)
+
+
+@pytest.mark.dtype_agnostic
+def test_golden_all_diagonal_one_sweep():
+    """An M-term all-diagonal (I/Z-only) sum is ONE |amp|^2 pass
+    however many terms ride it — the acceptance golden."""
+    rng = np.random.default_rng(7)
+    codes = np.where(rng.random((40, 30)) < 0.4, 3, 0)
+    st = E.plan_stats(codes, 30)
+    assert st["terms"] == 40
+    assert st["expec_groups"] == 1
+    assert st["expec_hbm_sweeps"] == 1
+    assert st["baseline_hbm_sweeps"] == 80
+
+
+@pytest.mark.dtype_agnostic
+def test_golden_tfim30_two_sweeps():
+    """30q TFIM (30 ZZ + 30 X): the ZZ block is the diagonal sweep,
+    all 30 single-bit X masks co-ride ONE off-diagonal sweep — 2
+    sweeps vs the per-term baseline's 120 passes."""
+    st = E.plan_stats(tfim_codes(30), 30)
+    assert st["terms"] == 60
+    assert st["diagonal_terms"] == 30
+    assert st["expec_hbm_sweeps"] <= 2
+    assert st["baseline_hbm_sweeps"] == 120
+
+
+@pytest.mark.dtype_agnostic
+def test_max_masks_budget_bounds_coride(monkeypatch):
+    """QUEST_EXPEC_MAX_MASKS=1 stops co-riding: every off-diagonal
+    mask group becomes its own sweep; the diagonal sweep is always
+    alone."""
+    monkeypatch.setenv("QUEST_EXPEC_MAX_MASKS", "1")
+    st = E.plan_stats(tfim_codes(8), 8)
+    assert st["expec_hbm_sweeps"] == 1 + 8      # diagonal + 8 X masks
+    assert st["max_masks_per_sweep"] == 1
+
+
+@pytest.mark.dtype_agnostic
+def test_plan_stats_reports_baseline_when_fusion_off(monkeypatch):
+    monkeypatch.setenv("QUEST_EXPEC_FUSION", "0")
+    st = E.plan_stats(tfim_codes(8), 8)
+    assert st["fusion"] is False
+    assert st["expec_hbm_sweeps"] == st["baseline_hbm_sweeps"]
+
+
+@pytest.mark.dtype_agnostic
+def test_explain_lists_sweeps():
+    txt = E.explain(tfim_codes(8), 8)
+    assert "mask groups" in txt and "diagonal" in txt
+    assert txt.count("sweep") >= 2
+
+
+# ---------------------------------------------------------------------------
+# cache discipline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.dtype_agnostic
+def test_coefficient_only_changes_zero_retrace():
+    """Coefficients are runtime operands: a VQE optimizer changing
+    weights between calls compiles ZERO new programs (the acceptance
+    pin). Codes are unique to this test so no earlier test warmed
+    them."""
+    from quest_tpu.analysis.audit import CompileAuditor
+    rng = np.random.default_rng(20260803)
+    codes = rng.integers(0, 4, size=(11, 6))
+    q = qt.init_debug_state(qt.create_qureg(6))
+    C.calc_expec_pauli_sum(q, codes, np.ones(11))           # warm
+    with CompileAuditor() as aud:
+        for _ in range(4):
+            C.calc_expec_pauli_sum(q, codes, rng.standard_normal(11))
+    aud.assert_no_retrace("coefficient-only expectation reruns")
+
+
+@pytest.mark.dtype_agnostic
+def test_parse_memoized_by_value(monkeypatch):
+    """Repeated calls with EQUAL (but not identical) code arrays
+    validate once — the validate_kraus_ops memo pattern, pinned by
+    call count."""
+    calls = {"n": 0}
+    real = val.validate_pauli_codes
+
+    def counting(codes):
+        calls["n"] += 1
+        return real(codes)
+
+    monkeypatch.setattr(val, "validate_pauli_codes", counting)
+    rng = np.random.default_rng(987654)
+    codes = rng.integers(0, 4, size=(7, 6))
+    q = qt.init_debug_state(qt.create_qureg(6))
+    for i in range(5):
+        C.calc_expec_pauli_sum(q, codes.copy(), np.full(7, 1.0 + i))
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# autodiff + specs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.dtype_agnostic
+def test_grad_of_fused_energy_matches_eager():
+    """jax.grad of the fused variational energy == the eager per-term
+    energy's gradient on a small ansatz (the docs/EXPECTATION.md
+    autodiff contract: the fused forward is plain XLA, no custom
+    VJP)."""
+    from quest_tpu.calculations import _pauli_prod_amps
+
+    n = 4
+    codes = [[3, 3, 0, 0], [0, 3, 3, 0], [1, 0, 0, 0],
+             [0, 0, 2, 3], [0, 1, 1, 0]]
+    coeffs = [1.0, 0.8, -0.5, 0.25, 0.4]
+
+    def ansatz(amps, params):
+        for q in range(n):
+            amps = V.ry(amps, n, q, params[q])
+        amps = V.cnot(amps, n, 0, 1)
+        amps = V.cnot(amps, n, 2, 3)
+        for q in range(n):
+            amps = V.rz(amps, n, q, params[n + q])
+        return amps
+
+    ck = tuple(tuple(t) for t in codes)
+
+    def eager_energy(params):
+        amps = ansatz(basis_planes(0, n=n, rdt=np.float32), params)
+        tot = jnp.zeros((), amps.dtype)
+        for i, term in enumerate(ck):
+            w = _pauli_prod_amps(amps, n, term)
+            tot = tot + jnp.asarray(coeffs[i], amps.dtype) * jnp.sum(
+                amps[0] * w[0] + amps[1] * w[1])
+        return tot
+
+    fused = V.expectation(ansatz, n, codes, coeffs)
+    params = jnp.asarray(
+        np.random.default_rng(3).uniform(0, 2 * np.pi, 2 * n), jnp.float32)
+    v1, g1 = jax.value_and_grad(fused)(params)
+    v2, g2 = jax.value_and_grad(eager_energy)(params)
+    np.testing.assert_allclose(v1, v2, atol=1e-4, rtol=0)
+    np.testing.assert_allclose(g1, g2, atol=1e-4, rtol=0)
+
+
+@pytest.mark.dtype_agnostic
+def test_pauli_sum_spec_validation_and_identity():
+    codes = [[1, 0, 3], [0, 2, 0]]
+    spec = qt.PauliSum.of(codes, [0.5, -1.0], 3)
+    assert spec.num_qubits == 3
+    # equal specs are equal values AND resolve to the SAME reducer
+    # (lru by value), so a serve batch shares one compiled reduction
+    spec2 = qt.PauliSum.of(np.asarray(codes), (0.5, -1.0), 3)
+    assert spec == spec2
+    assert E.resolve_observable(spec, 3) is E.resolve_observable(spec2, 3)
+    with pytest.raises(qt.QuESTError):
+        qt.PauliSum.of(codes, [0.5], 3)             # coeff count
+    with pytest.raises(qt.QuESTError):
+        qt.PauliSum.of([[7, 0, 0]], [1.0], 3)       # bad code
+    with pytest.raises(ValueError):
+        E.resolve_observable(spec, 5)               # width mismatch
+    with pytest.raises(TypeError):
+        E.resolve_observable(object(), 3)
+
+
+@pytest.mark.dtype_agnostic
+def test_batched_reducer_matches_per_state(rng):
+    """The serve-side reducer: (B, 2, 2^n) planes -> per-state fused
+    expectations, row i == the library call on state i; zero-padded
+    rows reduce to 0."""
+    n = 4
+    codes, coeffs = random_sum(rng, n, 6)
+    spec = qt.PauliSum.of(codes, coeffs, n)
+    reducer = E.batched_reducer(spec, n)
+    states = [oracle.random_statevector(n, rng) for _ in range(3)]
+    planes = np.stack([np.stack([s.real, s.imag]).astype(np.float32)
+                       for s in states]
+                      + [np.zeros((2, 1 << n), np.float32)])
+    vals = np.asarray(reducer(planes))
+    for i, s in enumerate(states):
+        want = C.calc_expec_pauli_sum(load_sv(s, np.complex64),
+                                      codes, coeffs)
+        assert vals[i] == pytest.approx(want, abs=1e-4)
+    assert vals[3] == pytest.approx(0.0, abs=1e-6)
+
+
+@pytest.mark.dtype_agnostic
+def test_serve_observable_pauli_sum(rng):
+    """End-to-end: submit(observable=PauliSum) resolves to the fused
+    reduction and demuxes per request, equal to sequential library
+    calls; a width-mismatched spec rejects AT SUBMIT."""
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.serve import ServeEngine
+    from quest_tpu.state import Qureg
+
+    n = 4
+    codes, coeffs = random_sum(rng, n, 5)
+    spec = qt.PauliSum.of(codes, coeffs, n)
+    circ = Circuit(n).h(0).cnot(0, 1).rz(2, 0.37).cz(1, 3)
+    states = [oracle.random_statevector(n, rng) for _ in range(3)]
+    planes = [np.stack([s.real, s.imag]).astype(np.float32)
+              for s in states]
+    with ServeEngine(interpret=True) as eng:
+        with pytest.raises(ValueError):
+            eng.submit(Circuit(3).h(0), state=np.zeros((2, 8), np.float32),
+                       observable=spec)
+        futs = [eng.submit(circ, state=p, observable=spec) for p in planes]
+        got = [float(f.result(timeout=300)) for f in futs]
+    for p, g in zip(planes, got):
+        out = circ.apply(Qureg(amps=jnp.asarray(p), num_qubits=n,
+                               is_density=False))
+        want = C.calc_expec_pauli_sum(out, codes, coeffs)
+        assert g == pytest.approx(want, abs=1e-4)

@@ -311,6 +311,47 @@ def test_batch_bucket_knob_is_keyed_with_flips():
     assert batch_bucket(5) in (5, 8)      # honors the active knob
 
 
+def test_expec_knob_registry_coverage(tmp_path):
+    """QUEST_EXPEC_* coverage of the registry rules (ISSUE 8): a
+    registry read (knob_value) of the keyed expectation knobs on a
+    jit-reachable path passes QL001; direct os.environ reads of the
+    same knobs fire QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_EXPEC_FUSION"):
+                return amps
+            return amps * knob_value("QUEST_EXPEC_MAX_MASKS")
+
+        def configure():
+            a = os.environ.get("QUEST_EXPEC_FUSION")
+            b = os.environ.get("QUEST_EXPEC_MAX_MASKS")
+            return a, b
+    """, name="expecknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 2 and all("bypasses" in v.message for v in q4), vs
+
+
+def test_expec_knobs_are_keyed_with_flips():
+    """Both expectation knobs must stay keyed (they select which
+    compiled expectation program a call resolves to) and
+    flip-auditable — the knob-flip audit sweeps every keyed knob with
+    registered flips automatically, so this pin keeps them in that
+    sweep, and both parsers must reject malformed input loudly."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_EXPEC_FUSION", "QUEST_EXPEC_MAX_MASKS"):
+        k = KNOBS[name]
+        assert k.scope == "keyed" and k.layer == "planner", name
+        assert k.flips and k.flips[0] != k.flips[1], name
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+
+
 def test_serve_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
     serve knobs are RUNTIME scope — read once at ServeEngine
