@@ -101,6 +101,27 @@ def _build_chain_circuit(n: int):
     return c
 
 
+def _build_deep_global_circuit(n: int, depth: int):
+    """The deep-global testbed (docs/DISTRIBUTED.md): every layer
+    rotates EVERY qubit — including the device-index ones — and
+    entangles with CZs; the worst case for per-gate swap-dancing and
+    the comm planner's headline workload. One home, shared by the
+    multichip scenario, scripts/check_comm_golden.py and
+    tests/test_comm.py so the goldens gate the same circuit the bench
+    measures."""
+    from quest_tpu.circuit import Circuit
+
+    rng = np.random.default_rng(5)
+    c = Circuit(n)
+    for _ in range(depth):
+        for q in range(n):
+            c.rx(q, float(rng.uniform(0, 2 * np.pi)))
+            c.ry(q, float(rng.uniform(0, 2 * np.pi)))
+        for q in range(0, n - 1, 2):
+            c.cz(q, q + 1)
+    return c
+
+
 def _basis_state(shape, rdt=None):
     """|0...0> planes built in ONE fused device buffer DIRECTLY in the
     engine's view shape (zeros().at.set() would briefly hold two
@@ -897,6 +918,78 @@ def serve_main():
     print(json.dumps(rec))
 
 
+_MULTICHIP_WORKER = r'''
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import json, sys
+import numpy as np
+sys.path.insert(0, %(repo)r)
+from jax.sharding import Mesh
+import bench
+from quest_tpu import precision
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.parallel.introspect import sharded_schedule
+
+# f64 registers: the comms trajectory must be comparable to the
+# committed f64 goldens (scripts/check_comm_golden.py, 672 B deep-global)
+precision.set_default_dtype(np.complex128)
+
+D = 8
+mesh = Mesh(np.array(jax.devices()[:D]), (AMP_AXIS,))
+scenarios = {
+    "headline": (bench._build_circuit(14), 14),
+    "deepglobal": (bench._build_deep_global_circuit(6, 6), 6),
+}
+out = {"metric": "multichip comm plan (8-device dryrun mesh)",
+       "unit": "bytes/device"}
+for name, (c, n) in scenarios.items():
+    for engine in ("banded", "pergate"):
+        rec = sharded_schedule(c.ops, n, False, mesh, engine=engine)
+        # the plan->predict->assert contract, INSIDE the bench: a comm
+        # trajectory whose planned and lowered schedules disagree is a
+        # predictor drift, not a measurement
+        assert rec["comm_matches_hlo"], (name, engine, rec)
+        pre = f"{name}_{engine}_"
+        out[pre + "comm_exchanges"] = rec["comm_exchanges"]
+        out[pre + "comm_bytes"] = rec["comm_bytes"]
+        out[pre + "comm_collectives"] = (rec["collective_exchanges"]
+                                         + rec["all_reduces"])
+        out[pre + "comm_strategy"] = rec["comm_strategy"]
+# headline trajectory keys for MULTICHIP_r*.json (banded = the pod path)
+out["value"] = out["deepglobal_banded_comm_bytes"]
+out["comm_exchanges"] = out["deepglobal_banded_comm_exchanges"]
+out["comm_bytes"] = out["deepglobal_banded_comm_bytes"]
+out["comm_collectives"] = out["deepglobal_banded_comm_collectives"]
+print(json.dumps(out))
+'''
+
+
+def multichip_main():
+    """`python bench.py multichip` — the comm-planner scenario: lower
+    the headline + deep-global circuits over the 8-device dryrun mesh
+    (a subprocess with virtual CPU devices, the dryrun_multichip
+    recipe), assert the PLANNED comm_stats equal XLA's lowered
+    collective accounting, and emit one JSON line of comm_* keys so
+    MULTICHIP_r*.json carries a comms trajectory
+    (docs/DISTRIBUTED.md)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    code = _MULTICHIP_WORKER % {"repo": REPO}
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        _log(f"multichip worker failed:\n{r.stderr[-3000:]}")
+        raise SystemExit(1)
+    print(r.stdout.strip().splitlines()[-1])
+
+
 def main():
     from quest_tpu.env import ensure_live_backend
     ensure_live_backend()          # may pin the CPU platform (loudly)
@@ -994,9 +1087,11 @@ if __name__ == "__main__":
         serve_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "expec":
         expec_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "multichip":
+        multichip_main()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench scenario {sys.argv[1]!r} "
-                         f"(known: serve, expec; no argument = headline "
-                         f"run)")
+                         f"(known: serve, expec, multichip; no argument "
+                         f"= headline run)")
     else:
         main()

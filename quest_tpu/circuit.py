@@ -465,6 +465,22 @@ def _human_bytes(b: int) -> str:
     return f"{b / 2**10:.2f} KiB"
 
 
+def _comm_plan_line(rec: dict) -> str:
+    """The comm planner's line in explain_sharded: the PREDICTED
+    exchange schedule (parallel/comm.py) and whether it matches what XLA
+    actually lowered — 'MISMATCH' here means the predictor drifted from
+    the engine and tests/test_comm.py would be red."""
+    verdict = ("matches" if rec.get("comm_matches_hlo")
+               else "MISMATCH vs")
+    return (f"  comm plan: {rec.get('comm_strategy', '?')} "
+            f"(QUEST_COMM_PLAN={1 if rec.get('comm_plan_enabled') else 0})"
+            f": {rec.get('comm_exchanges', 0)} exchange(s) = "
+            f"{rec.get('comm_collective_permutes', 0)} collective-"
+            f"permute(s) + {rec.get('comm_all_to_alls', 0)} "
+            f"all-to-all(s), {_human_bytes(rec.get('comm_bytes', 0))} "
+            f"ICI per device planned [{verdict} lowered StableHLO]")
+
+
 class Circuit:
     """Builder for a fixed gate sequence over `num_qubits` qubits.
 
@@ -1293,7 +1309,7 @@ class Circuit:
         return fn(amps_b)
 
     def plan_stats(self, density: bool = False,
-                   batch: int = None) -> dict:
+                   batch: int = None, devices: int = None) -> dict:
         """Hardware-independent plan statistics — the pass-count metric
         the commutation-aware scheduler is judged by, assertable on CPU
         (no compile, no chip): 'banded' is fusion.plan_stats's model
@@ -1308,7 +1324,15 @@ class Circuit:
         states_per_sweep, hbm_sweeps) describing what compiled_batched
         would execute for that many states — its hbm_sweeps equals the
         unbatched fused plan's by construction: launches do not scale
-        with B (docs/BATCHING.md; scripts/check_batch_golden.py)."""
+        with B (docs/BATCHING.md; scripts/check_batch_golden.py).
+        `devices` adds a 'comm' record — the comm planner's PREDICTED
+        collective schedule for the banded/fused sharded engines over
+        that many devices (strategy, exchange counts, per-device ICI
+        bytes at the session dtype) — pure host math, no mesh: a
+        40q/256-device schedule prices on a laptop
+        (docs/DISTRIBUTED.md; scripts/check_comm_golden.py holds the
+        goldens and tests/test_comm.py pins it equal to the lowered
+        StableHLO accounting)."""
         self._reject_measure("plan_stats")
         from quest_tpu.ops import fusion as F
         from quest_tpu.ops import pallas_band as PB
@@ -1366,6 +1390,47 @@ class Circuit:
                 "hbm_sweeps": rec["banded"]["full_state_passes"],
                 "kernel_sweeps": 0, "batched_stages": 0,
             }
+        if devices is not None:
+            rec["comm"] = self._comm_plan_stats(n, density, int(devices))
+        return rec
+
+    def _comm_plan_stats(self, n: int, density: bool, devices: int) -> dict:
+        """The plan_stats 'comm' record: predicted collective schedule
+        of the banded/fused sharded engines over `devices`, through the
+        SAME policy home they execute (parallel.sharded.engine_flat +
+        comm predictor) so it cannot drift from the lowered program."""
+        from quest_tpu import precision
+        from quest_tpu.ops import fusion as F
+        from quest_tpu.parallel import comm as C
+        from quest_tpu.parallel import sharded as S
+
+        if devices < 2 or devices & (devices - 1):
+            raise ValueError(
+                f"devices must be a power of two >= 2, got {devices}")
+        g = devices.bit_length() - 1
+        local_n = n - g
+        if local_n < 1:
+            raise ValueError(
+                f"register too small to shard over {devices} devices "
+                f"(ref E_DISTRIB_QUREG_TOO_SMALL)")
+        cinfo: dict = {}
+        bands = S._shard_bands(n, local_n)
+        flat_r = S.engine_flat(self.ops, n, density, local_n,
+                               bands=bands, comm_info=cinfo)
+        items = cinfo.get("items")
+        if items is None:
+            items = F.plan(flat_r, n, bands=bands)
+        rdt = precision.real_dtype_of(precision.get_default_dtype())
+        rec = C.comm_stats(C.predict_exchanges_items(items, local_n),
+                           num_devices=devices,
+                           bytes_per_real=np.dtype(rdt).itemsize)
+        rec.update({
+            "devices": devices,
+            "comm_strategy": cinfo.get("strategy", "plain"),
+            "comm_plan_enabled": C.plan_enabled(),
+            "relabel_events": sum(1 for op in flat_r
+                                  if op.kind == "relabel"),
+        })
         return rec
 
     def explain(self, density: bool = False, batch: int = None) -> str:
@@ -1557,6 +1622,7 @@ class Circuit:
                 + (f" ({rec['kernel_segments']} kernel segments)"
                    if rec['kernel_segments'] else ""),
                 f"  relabel events: {rec['relabel_events']}",
+                _comm_plan_line(rec),
                 f"  collective exchanges: {rec['collective_exchanges']} "
                 f"({_human_bytes(rec['ici_bytes_per_device'])} ICI per "
                 f"device per application)",
@@ -1609,6 +1675,7 @@ class Circuit:
             f"{rec['global_qubits']} device qubits, "
             f"{_human_bytes(rec['chunk_bytes'])} chunk per device",
             *plan_lines,
+            _comm_plan_line(rec),
             f"  collective exchanges: {rec['collective_exchanges']} "
             f"({_human_bytes(rec['ici_bytes_per_device'])} ICI per device "
             f"per application)",

@@ -138,6 +138,20 @@ def _parse_engine_ladder(raw: str) -> Tuple[str, ...]:
     return ladder
 
 
+def _parse_exchange_slices(raw: str) -> int:
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"QUEST_EXCHANGE_SLICES must be an integer, got {raw!r}")
+    if v < 1 or v > 1024 or (v & (v - 1)):
+        raise ValueError(
+            f"QUEST_EXCHANGE_SLICES must be a power of two in [1, 1024] "
+            f"(exchange blocks are power-of-two sized, so any other "
+            f"slice count cannot divide them), got {v}")
+    return v
+
+
 def _parse_fault_plan(raw: str):
     # the resilience package is stdlib-only at import time, so the lazy
     # import cannot cycle back into env.py's module load
@@ -206,6 +220,21 @@ _KNOB_LIST = (
              "expectation sweep — the expectation engine's stage "
              "budget (default: 64)",
          malformed="0", flips=("64", "1")),
+    Knob("QUEST_COMM_PLAN", _bool01("QUEST_COMM_PLAN"), True,
+         scope="keyed", layer="planner",
+         doc="communication planner for the sharded engines "
+             "(docs/DISTRIBUTED.md): pick the cheapest of plain/"
+             "coalesced-reshard/relabel-events/lazy per circuit by "
+             "predicted comm_stats bytes: 1/0 (default: 1; 0 restores "
+             "the fixed legacy policies)",
+         malformed="2", flips=("1", "0")),
+    Knob("QUEST_EXCHANGE_SLICES", _parse_exchange_slices, 1,
+         scope="keyed", layer="planner",
+         doc="collective-permute slices each sharded pair exchange "
+             "splits into, so transfer overlaps the consuming compute "
+             "on real ICI (default: 1; power of two; NOT "
+             "silicon-validated — A/B vs 1 on first chip run)",
+         malformed="3", flips=("1", "4")),
     Knob("QUEST_BATCH_BUCKET",
          _parse_choice("QUEST_BATCH_BUCKET", ("pow2", "off")), "pow2",
          scope="keyed", layer="planner",

@@ -1,0 +1,450 @@
+"""Communication planner for the sharded engines — the `sweep_plan` of ICI.
+
+`hbm_sweeps` made the fused engine's HBM traffic a CPU-assertable plan
+metric (docs/SWEEPS.md); this module does the same for the interconnect,
+which the TPU-pod statevector work identifies as the binding resource at
+pod scale (arXiv:2111.10466 — ICI collectives, not FLOPs, bound
+distributed throughput). Three pieces, one discipline
+(plan -> predict -> assert):
+
+* **routing table** (`matrix_route`) — the single home of the sharded
+  engines' per-op communication dispatch (diagonal / one-global-target
+  pair exchange / single-qubit butterfly / swap-to-local dance), shared
+  by `parallel.sharded._matrix_op` and the predictor below so the
+  predicted schedule CANNOT drift from the executed one;
+
+* **reshard coalescing** (`coalesce`) — mpiQulacs-style batched qubit
+  reordering (arXiv:2203.16044): defer commuting global-qubit matrix
+  work, then move ALL the qubits a stretch needs local in ONE
+  `all_to_all` relabel event instead of per-gate exchanges or per-qubit
+  SWAPs, choosing per stretch between the a2a and ppermute forms by
+  predicted (bytes, collective-steps) cost. `choose_plan` then picks the
+  cheapest of {plain, coalesce, relabel-events, lazy} per circuit and
+  per engine through the SAME predictor — so the banded engine can never
+  select a plan costlier than its incumbent (the lazy-relabel regression
+  class, docs/DISTRIBUTED.md), by construction;
+
+* **comm_stats** (`predict_*` / `comm_stats`) — CPU-side predicted
+  exchange counts and per-device ICI payload bytes, asserted EQUAL to
+  XLA's lowered StableHLO collective accounting
+  (`parallel.introspect.parse_collectives`) in tests/test_comm.py and
+  inside `bench.py multichip`. Pure host math: a 40q/256-device schedule
+  prices on a laptop (scripts/pod_projection.py builds on it).
+
+Knobs (quest_tpu/env.py registry, both keyed):
+
+* `QUEST_COMM_PLAN` (default 1): enables the per-circuit plan choice in
+  the sharded builders; 0 restores the legacy fixed policies (plain
+  per-gate schedule, layer-amortized relabel on banded/fused).
+* `QUEST_EXCHANGE_SLICES` (default 1): split each pair exchange into
+  this many collective-permute slices so transfer can overlap the local
+  compute that consumes it on real ICI (the collective-matmul overlap
+  pattern). Structure-verifiable on the CPU mesh; NOT silicon-validated
+  — A/B against QUEST_EXCHANGE_SLICES=1 on first chip run, exactly like
+  MAX_SWEEP_STAGES.
+
+Reference analogue: none. The reference's exchange schedule is implicit
+in C control flow (QuEST_cpu_distributed.c:481-509) and fixed: one
+full-chunk MPI_Sendrecv per global gate, swap-in/swap-out per relabel
+(:1441-1483), nothing planned, predicted, or assertable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# shared routing table
+# ---------------------------------------------------------------------------
+
+def dense_operand(m_pair, k: int) -> Optional[np.ndarray]:
+    """The (2^k, 2^k) complex operator of a packed (re, im) operand pair,
+    or None when either plane is traced (runtime operands skip structure
+    specialization — the engines' existing contract)."""
+    if not (isinstance(m_pair[0], np.ndarray)
+            and isinstance(m_pair[1], np.ndarray)):
+        return None
+    dim = 1 << k
+    return (np.asarray(m_pair[0]) + 1j * np.asarray(m_pair[1])).reshape(
+        dim, dim)
+
+
+def pair2t_blocks(sup: np.ndarray, jg: int):
+    """Split a 4x4 two-target operator by the global index bit `jg` into
+    same-block and cross-block 2x2s, plus the input values of the local
+    bit each parity's cross-block actually reads (`need`). Shared by
+    sharded._pair_exchange_2t and matrix_route, so the engine's
+    half-vs-full-chunk exchange decision and the predictor's byte count
+    come from one computation."""
+    def sub(out_v, in_v):
+        rows = [i for i in range(4) if ((i >> jg) & 1) == out_v]
+        cols = [j for j in range(4) if ((j >> jg) & 1) == in_v]
+        return sup[np.ix_(rows, cols)]
+
+    same = [sub(0, 0), sub(1, 1)]
+    cross = [sub(0, 1), sub(1, 0)]
+    need = [sorted(set(np.nonzero(np.abs(cross[gv]) > 0)[1].tolist()))
+            for gv in (0, 1)]
+    return same, cross, need
+
+
+def matrix_route(sup: Optional[np.ndarray], targets, controls,
+                 local_n: int) -> Tuple:
+    """Route of ONE matrix op through the sharded engines' distributed
+    dispatch (parallel.sharded._matrix_op) — the single home of the
+    decision table. Returns one of
+
+      ("local",)                      all targets inside the chunk
+      ("diagonal",)                   diagonal operand: rerouted, 0 comm
+      ("pair2t", half, t, jg, gbit)   2 targets, 1 global: ONE direct
+                                      pair exchange (half chunk when
+                                      every cross-block reads <= 1
+                                      column, else full chunk)
+      ("butterfly", gbit)             single global target: full-chunk
+                                      pair exchange
+      ("swapdance", k)                k global targets swap-to-local and
+                                      back (2k half-chunk exchanges)
+    """
+    glob = [t for t in targets if t >= local_n]
+    if not glob:
+        return ("local",)
+    if sup is not None and not controls:
+        if np.count_nonzero(sup - np.diag(np.diagonal(sup))) == 0:
+            return ("diagonal",)
+        if len(targets) == 2 and len(glob) == 1:
+            jg = list(targets).index(glob[0])
+            t = targets[1 - jg]
+            if t < local_n:
+                _, _, need = pair2t_blocks(sup, jg)
+                half = all(len(nd) <= 1 for nd in need)
+                return ("pair2t", half, t, jg, glob[0] - local_n)
+    if len(targets) == 1:
+        return ("butterfly", glob[0] - local_n)
+    return ("swapdance", len(glob))
+
+
+def route_gateop(op, local_n: int) -> Tuple:
+    """matrix_route for a flat GateOp (flattened kinds + relabel).
+    Superops must be flattened to doubled-target matrix ops first
+    (circuit.flatten_ops) — every sharded builder's input already is."""
+    kind = op.kind
+    if kind == "relabel":
+        return ("relabel",)
+    if kind in ("diagonal", "parity", "allones"):
+        return ("none",)
+    if kind in ("measure", "measure_dm", "classical"):
+        raise ValueError(
+            f"comm planning applies to static circuits only (got "
+            f"kind={op.kind!r}); the dynamic engine prices per stretch "
+            "(introspect.sharded_measured_schedule)")
+    from quest_tpu import cplx
+    sup = dense_operand(cplx.pack(op.operand), len(op.targets))
+    return matrix_route(sup, tuple(op.targets), tuple(op.controls), local_n)
+
+
+# ---------------------------------------------------------------------------
+# exchange slicing
+# ---------------------------------------------------------------------------
+
+def effective_slices(x: int) -> int:
+    """Number of collective-permute slices one pair exchange of `x`
+    per-plane elements splits into: QUEST_EXCHANGE_SLICES clamped to the
+    block (slices must divide it; x is a power of two on every engine
+    path, as is the validated knob). The ONE clamp — the engines' sliced
+    ppermutes and the predictor both call it, so planned and lowered
+    collective counts agree at any knob value."""
+    from quest_tpu.env import knob_value
+    s = min(int(knob_value("QUEST_EXCHANGE_SLICES")), int(x))
+    while x % s:            # non-pow2 x cannot occur today; stay safe
+        s >>= 1
+    return max(s, 1)
+
+
+def _route_exchanges(route: Tuple, local_n: int) -> List[Tuple[str, int]]:
+    """(kind, per-device operand elements) collective list of one routed
+    op: 'cp' = lax.ppermute (collective-permute), 'a2a' = lax.all_to_all.
+    Elements count BOTH planes of the (2, 2^local_n) chunk, mirroring the
+    lowered operand tensors parse_collectives sizes."""
+    m = 1 << local_n
+    tag = route[0]
+    if tag in ("local", "none", "diagonal"):
+        return []
+    if tag == "relabel":
+        return [("a2a", 2 * m)]
+    if tag == "pair2t":
+        x = (m // 2) if route[1] else m
+        s = effective_slices(x)
+        return [("cp", 2 * x // s)] * s
+    if tag == "butterfly":
+        s = effective_slices(m)
+        return [("cp", 2 * m // s)] * s
+    # swapdance: one half-chunk exchange in + one out per global target
+    x = m // 2
+    s = effective_slices(x)
+    return [("cp", 2 * x // s)] * (2 * route[1] * s)
+
+
+def gateop_exchanges(op, local_n: int) -> List[Tuple[str, int]]:
+    return _route_exchanges(route_gateop(op, local_n), local_n)
+
+
+def predict_exchanges_flat(flat: Sequence, local_n: int) -> List:
+    """Collective schedule of a FLAT op list through the per-gate engine
+    (compile_circuit_sharded executes exactly one routed op per list
+    entry)."""
+    out: List = []
+    for op in flat:
+        out += gateop_exchanges(op, local_n)
+    return out
+
+
+def predict_exchanges_items(items: Sequence, local_n: int) -> List:
+    """Collective schedule of a fusion plan (F.plan output) through the
+    banded/fused sharded engines: local BandOps and diagonal items never
+    communicate; width-1 global BandOps ride the single-qubit routes
+    (including the diagonal-2x2 zero-comm reroute); PassOps price as
+    their underlying GateOp. The fused engine's kernel segments are
+    purely local, so banded and fused share this walk."""
+    from quest_tpu.ops import fusion as F
+    out: List = []
+    for it in items:
+        if isinstance(it, F.BandOp):
+            if it.ql < local_n:
+                continue
+            sup = (np.asarray(it.gre, dtype=np.complex128)
+                   + 1j * np.asarray(it.gim))
+            route = matrix_route(sup, (it.ql,),
+                                 tuple(q for q, _ in it.preds), local_n)
+            out += _route_exchanges(route, local_n)
+            continue
+        op = getattr(it, "op", it)
+        out += gateop_exchanges(op, local_n)
+    return out
+
+
+def comm_stats(exchanges: Sequence, *, num_devices: int,
+               bytes_per_real: int) -> dict:
+    """The comm_stats record: counts plus per-device ICI payload bytes,
+    in EXACTLY parse_collectives' accounting (collective-permutes ship
+    their whole operand; an all_to_all ships (D-1)/D of it, floored on
+    bytes) — the parity the tests assert."""
+    cp = [e for k, e in exchanges if k == "cp"]
+    a2a = [e for k, e in exchanges if k == "a2a"]
+    d = num_devices
+    return {
+        "comm_collective_permutes": len(cp),
+        "comm_all_to_alls": len(a2a),
+        "comm_exchanges": len(cp) + len(a2a),
+        "comm_bytes": int(sum(e * bytes_per_real for e in cp)
+                          + sum((e * bytes_per_real) * (d - 1) // d
+                                for e in a2a)),
+    }
+
+
+def _cost(exchanges: Sequence, num_devices: int) -> Tuple[float, int]:
+    """(per-device element-bytes, collective steps) of an exchange list —
+    the planner's bytes x steps cost scale. Fractional a2a payload (no
+    byte floor): selection is dtype-free."""
+    d = num_devices
+    total = 0.0
+    for k, e in exchanges:
+        total += e * (d - 1) / d if k == "a2a" else float(e)
+    return (total, len(exchanges))
+
+
+# ---------------------------------------------------------------------------
+# reshard coalescing
+# ---------------------------------------------------------------------------
+
+def _home_order(victims: List[int], tr) -> List[int]:
+    """Assign the Belady-chosen victim SET to device bits so any victim
+    whose occupant is an owed global logical (local_n + j) lands on its
+    HOME bit j: alternating layers then undo each other's permutation
+    exactly and the trailing restore costs zero events instead of two
+    (measured 8 -> 6 all-to-alls on the deep-global testbed)."""
+    g = len(victims)
+    order: List[Optional[int]] = [None] * g
+    rest = []
+    for s in victims:
+        j = tr.inv[s] - tr.local_n
+        if 0 <= j < g and order[j] is None:
+            order[j] = s
+        else:
+            rest.append(s)
+    for j in range(g):
+        if order[j] is None:
+            order[j] = rest.pop()
+    return order
+
+
+def coalesce(flat: Sequence, n: int, local_n: int) -> List:
+    """Rewrite a flat op list so commuting stretches of global-qubit
+    matrix work run LOCALLY after one all_to_all relabel event each
+    (mpiQulacs-style batched reordering): global-target matrix ops are
+    DEFERRED while later ops that structurally commute with them slide
+    ahead; when a non-commuting op (or the end) forces a flush, the
+    whole pending batch localizes through either
+
+      * ONE relabel event (all g device bits swap with g Belady-chosen
+        local slots — (1 - 1/D) of the chunk, one collective), or
+      * the engines' per-op exchanges at current positions,
+
+    whichever predicts fewer (bytes, steps) — an isolated global gate
+    keeps its single pair exchange; a rotation layer's g global qubits
+    share one a2a. A trailing restore returns standard order (at most
+    two events + free local swaps, parallel.relabel._PermTracker).
+
+    Where plan_full_relabels walks strictly in program order — on a
+    layer that rotates the currently-LOCAL half first it fires TWO
+    events per layer (measured 12 events / 1344 B on the deep-global
+    testbed) — the deferral here reaches the one-event-per-layer floor
+    (6 events / 672 B, tests/test_comm.py goldens). Reordering is
+    restricted to structurally-commuting ops (fusion._commutes), the
+    same legality rule the gate scheduler uses."""
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.parallel import relabel as R
+
+    g = n - local_n
+    if g == 0 or g > local_n:
+        return list(flat)
+    R.reject_dynamic_ops(flat, "coalesce")
+    if not any(op.kind == "matrix" and any(t >= local_n for t in op.targets)
+               for op in flat):
+        return list(flat)
+
+    uses = R._uses(flat, n)
+    ptr = [0] * n
+    out: List = []
+    tr = R._PermTracker(n, local_n, out)
+    pending: List = []        # (op, nondiag_logical, all_logical)
+
+    def next_use(lq, i):
+        u, p = uses[lq], ptr[lq]
+        while p < len(u) and u[p] <= i:
+            p += 1
+        ptr[lq] = p
+        return u[p] if p < len(u) else len(flat) + 1
+
+    def route_phys(op):
+        """The op's route at CURRENT physical positions."""
+        if op.kind != "matrix":
+            return ("none",)
+        from quest_tpu import cplx
+        sup = dense_operand(cplx.pack(op.operand), len(op.targets))
+        return matrix_route(sup, tuple(tr.perm[t] for t in op.targets),
+                            tuple(tr.perm[c] for c in op.controls),
+                            local_n)
+
+    def emit(op):
+        out.append(dataclasses.replace(
+            op, targets=tuple(tr.perm[t] for t in op.targets),
+            controls=tuple(tr.perm[c] for c in op.controls)))
+
+    def flush(i):
+        if not pending:
+            return
+        ops_p = [op for op, _, _ in pending]
+        pp: List = []
+        paying = 0
+        for op in ops_p:
+            ex = _route_exchanges(route_phys(op), local_n)
+            paying += bool(ex)
+            pp += ex
+        need_local = {t for op in ops_p for t in op.targets}
+        slots = [s for s in range(local_n) if tr.inv[s] not in need_local]
+        D = 1 << g
+        a2a_cost = _cost([("a2a", 2 << local_n)], D)
+        if (paying >= 2 and len(slots) >= g
+                and len(need_local) <= local_n
+                and a2a_cost < _cost(pp, D)):
+            slots.sort(key=lambda s: next_use(tr.inv[s], i), reverse=True)
+            tr.emit_relabel(_home_order(slots[:g], tr))
+        for op in ops_p:
+            emit(op)
+        pending.clear()
+
+    for i, op in enumerate(flat):
+        nd = F._nondiag_qubits(op)
+        al = frozenset(op.targets) | frozenset(op.controls)
+        if (op.kind == "matrix"
+                and route_phys(op)[0] in ("pair2t", "butterfly",
+                                          "swapdance")):
+            # exchange-paying ops JOIN the batch unconditionally: batch
+            # members keep their relative order, so they need not
+            # commute with each other — only ops that slide PAST the
+            # batch do (the flush below preserves program order)
+            pending.append((op, nd, al))
+            continue
+        if pending and not all(F._commutes(nd, al, pnd, pal)
+                               for _, pnd, pal in pending):
+            flush(i)
+        emit(op)
+    flush(len(flat))
+    tr.restore()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-circuit, per-engine plan choice
+# ---------------------------------------------------------------------------
+
+def plan_enabled() -> bool:
+    from quest_tpu.env import knob_value
+    return bool(knob_value("QUEST_COMM_PLAN"))
+
+
+def choose_plan(flat: Sequence, n: int, local_n: int, *,
+                engine: str = "banded",
+                bands: Optional[Sequence] = None) -> Tuple[List, dict]:
+    """Pick the cheapest rewrite of `flat` among {plain, coalesce,
+    relabel-events, lazy} by PREDICTED (bytes, steps) through the target
+    engine's own pricing: the per-gate engine prices one routed op per
+    list entry; the banded/fused engines price the fusion plan their run
+    loop executes (F.plan over `bands`). The incumbent policy (plain for
+    per-gate, layer-amortized relabel for banded/fused) wins ties, so no
+    engine can select a plan costlier than what it ran before the
+    planner existed — the lazy-relabel banded regression is impossible
+    by construction. Returns (chosen list, info dict with the strategy
+    and every candidate's predicted cost)."""
+    from quest_tpu.parallel import relabel as R
+
+    D = 1 << (n - local_n)
+    cands = {"plain": list(flat)}
+    if any(op.kind == "matrix" and any(t >= local_n for t in op.targets)
+           for op in flat):
+        cands["coalesce"] = coalesce(flat, n, local_n)
+        cands["relabel"] = R.plan_full_relabels(flat, n, local_n)
+        cands["lazy"] = R.lazy_relabel_ops(flat, n, local_n)
+
+    plans: dict = {}
+
+    def score(name, lst):
+        if engine == "pergate":
+            ex = predict_exchanges_flat(lst, local_n)
+        else:
+            from quest_tpu.ops import fusion as F
+            plans[name] = F.plan(lst, n, bands=bands)
+            ex = predict_exchanges_items(plans[name], local_n)
+        return _cost(ex, D)
+
+    incumbent = "plain" if engine == "pergate" else "relabel"
+    if incumbent not in cands:
+        incumbent = "plain"
+    scores = {name: score(name, lst) for name, lst in cands.items()}
+    best = incumbent
+    for name in ("coalesce", "relabel", "plain", "lazy"):
+        if name in scores and scores[name] < scores[best]:
+            best = name
+    info = {"strategy": best,
+            "candidates": {k: {"elem_bytes": v[0], "exchanges": v[1]}
+                           for k, v in scores.items()}}
+    if best in plans:
+        # the winner's fusion plan rides along so the calling engine
+        # (and introspect) need not re-run F.plan on the identical
+        # input — scoring already paid that O(ops x items) pass
+        info["items"] = plans[best]
+    return cands[best], info

@@ -20,37 +20,187 @@ import jax
 import jax.numpy as jnp
 
 
+_FUNC_RE = re.compile(r"func\.func\s+(?:public|private)?\s*@([\w$.-]+)\s*\(")
+_CALL_RE = re.compile(r"(?<!custom_)call\s+@([\w$.-]+)\s*\(")
+_WHILE_RE = re.compile(r"stablehlo\.while\(([^)]*)\)")
+_CONST_RE = re.compile(r"%([\w.#]+)\s*=\s*stablehlo\.constant\s+"
+                       r"dense<(-?\d+)>\s*:\s*tensor<i\d+>")
+_CMP_LT_RE = re.compile(r"stablehlo\.compare\s+LT,\s*%([\w.#]+),"
+                        r"\s*%([\w.#]+)")
+_COLLECTIVE_RE = re.compile(r'"?stablehlo\.(collective_permute|all_to_all'
+                            r"|all_reduce)\"?[\s(]")
+_OPERAND_RE = re.compile(r"tensor<([0-9x]+)xf(32|64)>")
+
+
+def _scan_collectives(stablehlo_text: str):
+    """Walk the module function by function, tracking `stablehlo.while`
+    regions (the body of a lax.fori_loop/scan — its collectives execute
+    TRIP-COUNT times, not once) and call-graph multiplicity (XLA often
+    outlines a loop body into a private func; its collectives belong to
+    every call site). A flat regex over the text counts each textual
+    occurrence once — exactly the undercount that would let a comm_stats
+    parity assertion pass vacuously on looped programs. Trip counts are
+    derived from the canonical fori pattern (counter init constant,
+    `compare LT` against a constant bound, unit step); anything else
+    conservatively counts once.
+
+    Returns {func: {"ops": [(op, elems, dtype_bytes, mult)],
+                    "calls": [(callee, mult)], "public": bool}}."""
+    funcs = {}
+    cur = None
+    # scope stack entries: (kind, mult_at_entry); mult = product of
+    # enclosing while trip counts
+    stack = []
+    mult = 1
+    consts = {}
+    pending_while = None    # {"inits": {arg: ssa}, "cond_done": bool,
+    #                          "bound": int|None, "arg": str|None}
+    for raw in stablehlo_text.splitlines():
+        line = raw.strip()
+        mfun = _FUNC_RE.search(line)
+        if mfun and cur is None:
+            cur = mfun.group(1)
+            funcs[cur] = {"ops": [], "calls": [],
+                          "public": "public" in line.split("@")[0]}
+            stack = [("func", 1)]
+            mult = 1
+            consts = {}
+            pending_while = None
+            continue
+        if cur is None:
+            continue
+        for mc in _CONST_RE.finditer(line):
+            consts[mc.group(1)] = int(mc.group(2))
+        mw = _WHILE_RE.search(line)
+        if mw:
+            inits = {}
+            for part in mw.group(1).split(","):
+                if "=" in part:
+                    a, v = part.split("=", 1)
+                    inits[a.strip().lstrip("%")] = v.strip().lstrip("%")
+            pending_while = {"inits": inits, "cond_done": False,
+                            "trip": None}
+        if pending_while is not None and not pending_while["cond_done"]:
+            mcmp = _CMP_LT_RE.search(line)
+            if mcmp:
+                arg, bound = mcmp.group(1), mcmp.group(2)
+                init_ssa = pending_while["inits"].get(arg)
+                if init_ssa is not None and bound in consts \
+                        and init_ssa in consts:
+                    pending_while["trip"] = max(
+                        consts[bound] - consts[init_ssa], 0)
+        mcoll = _COLLECTIVE_RE.search(line)
+        if mcoll:
+            op = mcoll.group(1)
+            elems, dbytes = 0, 0
+            for mo in _OPERAND_RE.finditer(line[mcoll.end():]):
+                e = 1
+                for d in mo.group(1).split("x"):
+                    e *= int(d)
+                elems, dbytes = e, (4 if mo.group(2) == "32" else 8)
+                break
+            funcs[cur]["ops"].append((op, elems, dbytes, mult))
+        for mcall in _CALL_RE.finditer(line):
+            funcs[cur]["calls"].append((mcall.group(1), mult))
+        # region tracking: every '{' opens a scope carrying the loop
+        # multiplicity inside it; every '}' returns to the enclosing one
+        for ch in line:
+            if ch == "{":
+                kind, m = "plain", mult
+                if pending_while is not None:
+                    if not pending_while["cond_done"]:
+                        kind = "cond"
+                    else:
+                        kind = "do"
+                        t = pending_while["trip"]
+                        m = mult * (t if t is not None else 1)
+                        pending_while = None
+                stack.append((kind, m))
+                mult = m
+            elif ch == "}":
+                if not stack:
+                    continue
+                kind, _ = stack.pop()
+                if kind == "cond" and pending_while is not None:
+                    pending_while["cond_done"] = True
+                if kind == "func" or not stack:
+                    cur = None
+                    stack = []
+                    mult = 1
+                else:
+                    mult = stack[-1][1]
+    return funcs
+
+
 def parse_collectives(stablehlo_text: str, num_devices: int = None) -> dict:
     """Counts and per-device payload bytes of cross-device collectives
     in a lowered module's StableHLO text. all-to-all relabel events
     (parallel/relabel.py) ship (D-1)/D of their operand off-device;
     pass `num_devices` for that accounting (defaults to counting the
-    whole operand, an upper bound)."""
-    def payload_bytes(op_name):
-        """Per-occurrence operand bytes of a StableHLO collective."""
-        sizes = []
-        for m in re.finditer(
-                rf"stablehlo\.{op_name}.*?tensor<([0-9x]+)xf(32|64)>",
-                stablehlo_text):
-            e = 1
-            for d in m.group(1).split("x"):
-                e *= int(d)
-            sizes.append(e * (4 if m.group(2) == "32" else 8))
-        return sizes
+    whole operand, an upper bound).
 
-    cp_elems = payload_bytes("collective_permute")
-    a2a_bytes = payload_bytes("all_to_all")
+    Counts THROUGH `stablehlo.while` bodies (x derivable trip count) and
+    called private functions (x call-site multiplicity): XLA lowers
+    lax.fori_loop/scan-wrapped exchanges as one textual op executing many
+    times, and the flat count would otherwise undercount — letting the
+    comm_stats parity assertion pass vacuously (fixture-pinned in
+    tests/test_comm.py)."""
+    funcs = _scan_collectives(stablehlo_text)
+    # execution counts through the call graph (a DAG in HLO): public
+    # funcs run once; a callee runs caller_count x call multiplicity
+    exec_count = {name: (1 if rec["public"] else 0)
+                  for name, rec in funcs.items()}
+    for _ in range(len(funcs)):
+        nxt = {name: (1 if rec["public"] else 0)
+               for name, rec in funcs.items()}
+        for name, rec in funcs.items():
+            for callee, m in rec["calls"]:
+                if callee in nxt:
+                    nxt[callee] += exec_count[name] * m
+        if nxt == exec_count:
+            break
+        exec_count = nxt
+
+    cp_bytes, a2a_bytes = [], []
+    all_reduces = 0
+    for name, rec in funcs.items():
+        runs = exec_count[name]
+        for op, elems, dbytes, m in rec["ops"]:
+            count = runs * m
+            if op == "all_reduce":
+                all_reduces += count
+            elif op == "collective_permute":
+                cp_bytes += [elems * dbytes] * count
+            elif op == "all_to_all":
+                a2a_bytes += [elems * dbytes] * count
     if num_devices:
         a2a_bytes = [b * (num_devices - 1) // num_devices
                      for b in a2a_bytes]
-    all_reduces = len(re.findall(r"stablehlo\.all_reduce", stablehlo_text))
     return {
-        "collective_permutes": len(cp_elems),
+        "collective_permutes": len(cp_bytes),
         "all_to_alls": len(a2a_bytes),
-        "collective_exchanges": len(cp_elems) + len(a2a_bytes),
-        "ici_bytes_per_device": int(sum(cp_elems) + sum(a2a_bytes)),
+        "collective_exchanges": len(cp_bytes) + len(a2a_bytes),
+        "ici_bytes_per_device": int(sum(cp_bytes) + sum(a2a_bytes)),
         "all_reduces": all_reduces,
     }
+
+
+def _merge_comm(rec: dict, predicted, cinfo: dict, D: int,
+                bytes_per_real: int) -> None:
+    """Fold the comm planner's PREDICTED schedule into a sharded-
+    schedule record and flag whether it matches XLA's lowered collective
+    accounting — the plan->predict->assert contract (tests/test_comm.py
+    and bench.py multichip assert comm_matches_hlo)."""
+    from quest_tpu.parallel import comm as C
+    rec.update(C.comm_stats(predicted, num_devices=D,
+                            bytes_per_real=bytes_per_real))
+    rec["comm_strategy"] = cinfo.get("strategy", "plain")
+    rec["comm_plan_enabled"] = C.plan_enabled()
+    rec["comm_matches_hlo"] = (
+        rec["comm_collective_permutes"] == rec["collective_permutes"]
+        and rec["comm_all_to_alls"] == rec["all_to_alls"]
+        and rec["comm_exchanges"] == rec["collective_exchanges"]
+        and rec["comm_bytes"] == rec["ici_bytes_per_device"])
 
 
 def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
@@ -60,7 +210,6 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
     the STATE-qubit count (2x the logical count for density registers),
     matching the compile_circuit_sharded* builders."""
     from quest_tpu import precision
-    from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
     from quest_tpu.parallel import sharded as S
 
@@ -94,13 +243,25 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
         "chunk_bytes": 2 * bytes_per_real * (1 << n) // D,
     })
 
-    flat = flatten_ops(ops, n, density)
+    from quest_tpu.parallel import comm as C
+
     if engine == "pergate":
         # the per-gate engine runs one pass per op — band-plan stats
-        # would describe passes it never executes
+        # would describe passes it never executes. The op list comes
+        # from the SAME policy home the compiler executes
+        # (S.pergate_flat), so the comm plan below is the executed one
+        cinfo: dict = {}
+        chosen = S.pergate_flat(ops, n, density, local_n,
+                                comm_info=cinfo)
+        # gate counts exclude planner-injected relabel events (their
+        # targets span every qubit; they have their own line below)
+        gate_ops = [op for op in chosen if op.kind != "relabel"]
         rec["local_ops"] = sum(
-            1 for op in flat if max(op.targets) < local_n)
-        rec["global_ops"] = len(flat) - rec["local_ops"]
+            1 for op in gate_ops if max(op.targets) < local_n)
+        rec["global_ops"] = len(gate_ops) - rec["local_ops"]
+        rec["relabel_events"] = len(chosen) - len(gate_ops)
+        predicted = C.predict_exchanges_flat(chosen, local_n)
+        _merge_comm(rec, predicted, cinfo, D, bytes_per_real)
     else:
         # band layout AND op-list rewrite PER ENGINE, via the engines'
         # own helpers (S.engine_flat is the ONE home of the rewrite
@@ -120,10 +281,16 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
         # engine_flat schedules before relabeling; ONE scheduler run
         # serves both the plan and the reported counters
         sstats: dict = {}
+        cinfo = {}
         flat_r = S.engine_flat(ops, n, density, local_n,
-                               sched_stats=sstats)
+                               sched_stats=sstats, bands=bands,
+                               comm_info=cinfo)
         rec["scheduler"] = sstats
-        items = F.plan(flat_r, n, bands=bands)
+        items = cinfo.get("items")
+        if items is None:
+            items = F.plan(flat_r, n, bands=bands)
+        _merge_comm(rec, C.predict_exchanges_items(items, local_n),
+                    cinfo, D, bytes_per_real)
         rec["local_band_passes"] = sum(
             1 for it in items
             if isinstance(it, F.BandOp) and it.ql < local_n)
@@ -214,4 +381,28 @@ def sharded_measured_schedule(ops: Sequence, n: int, density: bool, mesh,
         "local_band_passes": band_passes,
         "kernel_segments": kernel_segments,
     })
+
+    # predicted comm schedule: stretch items price like the static
+    # engines; each measurement is one psum (all_reduce); classical
+    # feedback applies its inner gates unconditionally (blended by the
+    # outcome predicate), so they price at face value
+    from quest_tpu.parallel import comm as C
+    predicted = []
+    pred_psums = 0
+    for el in program:
+        if el[0] == "dyn":
+            op = el[1]
+            if op.kind in ("measure", "measure_dm"):
+                pred_psums += 1
+            else:
+                for gop in op.operand[0]:
+                    predicted += C.gateop_exchanges(gop, local_n)
+        else:
+            predicted += C.predict_exchanges_items(el[1], local_n)
+    _merge_comm(rec, predicted,
+                {"strategy": "relabel" if relabel else "plain"},
+                D, bytes_per_real)
+    rec["comm_all_reduces"] = pred_psums
+    rec["comm_matches_hlo"] = (rec["comm_matches_hlo"]
+                               and pred_psums == rec["all_reduces"])
     return rec

@@ -45,6 +45,100 @@ SWAP = np.array([[1, 0, 0, 0],
                  [0, 0, 0, 1]], dtype=np.complex128)
 
 
+def reject_dynamic_ops(flat: Sequence, pass_name: str) -> None:
+    """Dynamic-circuit ops carry NESTED gate lists in their operands that
+    the relabel/comm rewrites do not remap — the sharded builders that
+    call these passes reject measure ops up front (_reject_measure_ops);
+    this guard keeps a future caller from silently corrupting a dynamic
+    circuit. Shared by plan_full_relabels and comm.coalesce."""
+    for op in flat:
+        if op.kind in ("measure", "measure_dm", "classical"):
+            raise ValueError(
+                f"{pass_name} cannot rewrite dynamic-circuit ops (got "
+                f"kind={op.kind!r}); relabeling applies to static "
+                "circuits only")
+
+
+class _PermTracker:
+    """Logical->physical permutation bookkeeping for the rewrite passes
+    that move qubits (plan_full_relabels, comm.coalesce): emits relabel
+    events / explicit SWAPs into `out` while keeping perm (logical ->
+    physical) and inv (physical -> logical) consistent, and restores
+    standard order at the end in at most two events + free local swaps.
+    The ONE home of this bookkeeping — a drifted copy here and in the
+    comm planner would break the restore invariant silently."""
+
+    def __init__(self, n: int, local_n: int, out: List):
+        self.n, self.local_n, self.out = n, local_n, out
+        self.g = n - local_n
+        self.perm = list(range(n))
+        self.inv = list(range(n))
+
+    def emit_relabel(self, slots) -> None:
+        """slots[j] is the local slot swapping with device bit j."""
+        from quest_tpu.circuit import GateOp
+        self.out.append(GateOp(kind="relabel",
+                               targets=tuple(range(self.n)),
+                               operand=tuple(slots)))
+        for j, s in enumerate(slots):
+            gpos = self.local_n + j
+            ls, lg = self.inv[s], self.inv[gpos]
+            self.perm[ls], self.perm[lg] = gpos, s
+            self.inv[s], self.inv[gpos] = lg, ls
+
+    def emit_swap(self, a: int, b: int) -> None:
+        """Physical 2q SWAP of positions a, b."""
+        from quest_tpu.circuit import GateOp
+        self.out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP))
+        la, lb = self.inv[a], self.inv[b]
+        self.perm[la], self.perm[lb] = b, a
+        self.inv[a], self.inv[b] = lb, la
+
+    def restore(self) -> None:
+        """Restore standard order in at most two events + free swaps:
+        (1) if the device bits need fixing and any owed logical
+        (local_n+j) sits at SOME device bit, one event pulls ALL
+        device-bit occupants into local slots — slots chosen so no owed
+        logical gets evicted back out; (2) one event sends each owed
+        logical to its own device bit; (3) the remaining mismatches are
+        local-local, communication-free in-chunk 2q swaps. A purely
+        local-local residual (device bits already home) emits ZERO
+        events — only free swaps."""
+        perm, inv, local_n, g = self.perm, self.inv, self.local_n, self.g
+        if perm == list(range(self.n)):
+            return
+        needs_fix = any(inv[local_n + j] != local_n + j for j in range(g))
+        owed_at_device = any(perm[local_n + j] >= local_n
+                             for j in range(g))
+        safe = [s for s in range(local_n) if inv[s] < local_n]
+        if needs_fix and owed_at_device and len(safe) < g:
+            # tiny chunk: not enough safe slots for the two-step
+            # restore; fall back to plain swaps (the engine swap-dances
+            # the global ones, global-global pairs route through local
+            # slot 0 like lazy_relabel_ops' restore)
+            for q in range(self.n):
+                while perm[q] != q:
+                    a, b = perm[q], q
+                    if a >= local_n and b >= local_n:
+                        self.emit_swap(a, 0)
+                    else:
+                        self.emit_swap(a, b)
+        else:
+            if needs_fix:
+                if owed_at_device:
+                    self.emit_relabel(safe[:g])
+                slots = [perm[local_n + j] for j in range(g)]
+                assert (all(s < local_n for s in slots)
+                        and len(set(slots)) == g)
+                self.emit_relabel(slots)
+            for q in range(local_n):
+                while perm[q] != q:
+                    a, b = perm[q], q
+                    assert a < local_n and b < local_n
+                    self.emit_swap(a, b)
+        assert perm == list(range(self.n))
+
+
 def _uses(flat, n):
     """Per logical qubit, the sorted indices of ops where it is a MATRIX
     TARGET — the only role that demands a local slot (controls are free
@@ -164,11 +258,16 @@ def _compose_free_flags(flat: Sequence) -> List[bool]:
 
 
 def _op_exchange_price(op, pperm, local_n: int) -> float:
-    """Chunk-equivalents the sharded banded/fused engines ship for ONE
-    matrix op at the given logical->physical permutation — the single
-    home of the engine's exchange price table, shared by the greedy
-    placer and the A/B accept test (they must agree on prices; they
-    deliberately differ only on the composition discount)."""
+    """Chunk-equivalents THIS PASS's greedy placer and A/B accept test
+    price ONE matrix op at — deliberately a simplified, optimistic
+    table (no diagonal-operand reroute, one-way swap-dance cost): the
+    optimistic count places events denser, which measured BETTER plans
+    on the deep-global testbed (see exchange_cost below). The EXACT
+    engine-faithful model lives in parallel/comm.py
+    (matrix_route/_route_exchanges, shared with the engines) and is
+    the final arbiter: comm.choose_plan rescores this pass's output
+    with it against the other candidates, so a plan shaped by these
+    heuristic prices can win only when the exact model agrees."""
     if op.kind != "matrix":
         return 0.0               # diagonal/parity/allones never move data
     t_phys = [pperm[t] for t in op.targets]
@@ -238,17 +337,7 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
         # slots, so it needs g <= local_n; tiny chunks keep the plain
         # swap-dance schedule
         return list(flat)
-    for op in flat:
-        if op.kind in ("measure", "measure_dm", "classical"):
-            # dynamic-circuit ops carry NESTED gate lists in their
-            # operands that this pass does not remap — the sharded
-            # builders that call it reject measure ops up front
-            # (_reject_measure_ops); this guard keeps a future caller
-            # from silently corrupting a dynamic circuit
-            raise ValueError(
-                "plan_full_relabels cannot rewrite dynamic-circuit ops "
-                f"(got kind={op.kind!r}); relabeling applies to static "
-                "circuits only")
+    reject_dynamic_ops(flat, "plan_full_relabels")
 
     def exchange_cost(op, pperm):
         """Per-op price via the shared table (_op_exchange_price).
@@ -262,9 +351,9 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
 
     uses = _uses(flat, n)
     ptr = [0] * n
-    perm = list(range(n))
-    inv = list(range(n))
     out: List = []
+    tr = _PermTracker(n, local_n, out)
+    perm, inv = tr.perm, tr.inv
 
     def next_use(lq, i):
         u, p = uses[lq], ptr[lq]
@@ -272,26 +361,6 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
             p += 1
         ptr[lq] = p
         return u[p] if p < len(u) else len(flat) + 1
-
-    def emit_relabel(slots):
-        """slots[j] is the local slot swapping with device bit j."""
-        from quest_tpu.circuit import GateOp
-        out.append(GateOp(kind="relabel", targets=tuple(range(n)),
-                          operand=tuple(slots)))
-        for j, s in enumerate(slots):
-            gpos = local_n + j
-            ls, lg = inv[s], inv[gpos]
-            perm[ls], perm[lg] = gpos, s
-            inv[s], inv[gpos] = lg, ls
-
-    def emit_swap(a: int, b: int):
-        """Physical 2q SWAP of positions a, b (the ONE home of the
-        swap-emit + perm/inv bookkeeping for this pass)."""
-        from quest_tpu.circuit import GateOp
-        out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP))
-        la, lb = inv[a], inv[b]
-        perm[la], perm[lb] = b, a
-        inv[a], inv[b] = lb, la
 
     def plan_event(i):
         """(slots, fires) for a relabel at op i: pick the g Belady
@@ -330,51 +399,12 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
                 and any(perm[t] >= local_n for t in op.targets)):
             victims, fires = plan_event(i)
             if fires:
-                emit_relabel(victims)
+                tr.emit_relabel(victims)
         out.append(dataclasses.replace(
             op, targets=tuple(perm[t] for t in op.targets),
             controls=tuple(perm[c] for c in op.controls)))
 
-    if perm != list(range(n)):
-        # restore standard order in at most two events + free swaps:
-        # (1) if the device bits need fixing and any owed logical
-        # (local_n+j) sits at SOME device bit, one event pulls ALL
-        # device-bit occupants into local slots — slots chosen so no
-        # owed logical gets evicted back out; (2) one event sends each
-        # owed logical to its own device bit; (3) the remaining
-        # mismatches are local-local, communication-free in-chunk 2q
-        # swaps. A purely local-local residual (device bits already
-        # home) emits ZERO events — only free swaps.
-        needs_fix = any(inv[local_n + j] != local_n + j for j in range(g))
-        owed_at_device = any(perm[local_n + j] >= local_n
-                             for j in range(g))
-        safe = [s for s in range(local_n) if inv[s] < local_n]
-        if needs_fix and owed_at_device and len(safe) < g:
-            # tiny chunk: not enough safe slots for the two-step
-            # restore; fall back to plain swaps (the engine
-            # swap-dances the global ones, global-global pairs route
-            # through local slot 0 like lazy_relabel_ops' restore)
-            for q in range(n):
-                while perm[q] != q:
-                    a, b = perm[q], q
-                    if a >= local_n and b >= local_n:
-                        emit_swap(a, 0)
-                    else:
-                        emit_swap(a, b)
-        else:
-            if needs_fix:
-                if owed_at_device:
-                    emit_relabel(safe[:g])
-                slots = [perm[local_n + j] for j in range(g)]
-                assert (all(s < local_n for s in slots)
-                        and len(set(slots)) == g)
-                emit_relabel(slots)
-            for q in range(local_n):
-                while perm[q] != q:
-                    a, b = perm[q], q
-                    assert a < local_n and b < local_n
-                    emit_swap(a, b)
-        assert perm == list(range(n))
+    tr.restore()
 
     # plan-time A/B: the greedy event cascade can lose on workloads
     # whose runs all compose (every qubit's gates merge into ONE band

@@ -43,6 +43,7 @@ from quest_tpu import cplx
 from quest_tpu.env import AMP_AXIS
 from quest_tpu import validation as val
 from quest_tpu.ops import apply as A
+from quest_tpu.parallel import comm as C
 from quest_tpu.state import Qureg
 
 
@@ -89,6 +90,23 @@ def _mask_blend(new, old, local_n, loc_c, loc_s, pred):
     return jnp.where(pred, new, old)
 
 
+def _sliced_ppermute(block, D, gbit):
+    """One pair exchange of `block` ((2, x) planes), split into
+    QUEST_EXCHANGE_SLICES independent collective-permutes
+    (comm.effective_slices is the shared clamp, so the predicted and
+    lowered collective counts agree at any knob value). Slicing lets the
+    compiler overlap transfer with the consuming compute on real ICI —
+    structure-verifiable on the CPU mesh; wall-clock A/B deferred to
+    first chip run (docs/DISTRIBUTED.md)."""
+    s = C.effective_slices(block.shape[-1])
+    if s == 1:
+        return lax.ppermute(block, AMP_AXIS, _pair_perm(D, gbit))
+    xs = block.reshape(2, s, -1)
+    recv = [lax.ppermute(xs[:, i], AMP_AXIS, _pair_perm(D, gbit))
+            for i in range(s)]
+    return jnp.concatenate(recv, axis=1)
+
+
 def _swap_global_local(chunk, dev, D, gbit, l, local_n):
     """Distributed SWAP of global qubit (device bit `gbit`) with local qubit
     l — a half-chunk ppermute (the reference exchanges full chunks for this,
@@ -99,7 +117,8 @@ def _swap_global_local(chunk, dev, D, gbit, l, local_n):
     ax = 1 + axis_of[l]
     g = (dev >> gbit) & 1
     moving = lax.dynamic_slice_in_dim(t, 1 - g, 1, axis=ax)
-    recv = lax.ppermute(moving, AMP_AXIS, _pair_perm(D, gbit))
+    recv = _sliced_ppermute(moving.reshape(2, -1), D, gbit).reshape(
+        moving.shape)
     t = lax.dynamic_update_slice_in_dim(t, recv, 1 - g, axis=ax)
     return t.reshape(2, -1)
 
@@ -130,26 +149,24 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
       swap-in + swap-out round trip).
 
     Measured (benchmarks/channel_bytes.py, 8-device mesh): outer-qubit
-    damping 4096 -> 2048 bytes per channel; dephasing 4096 -> 0."""
-    glob_targets = [t for t in targets if t >= local_n]
+    damping 4096 -> 2048 bytes per channel; dephasing 4096 -> 0.
 
-    if (glob_targets and not controls and isinstance(m_pair[0], np.ndarray)):
-        sup = np.asarray(m_pair[0]) + 1j * np.asarray(m_pair[1])
-        dim = 1 << len(targets)
-        sup = sup.reshape(dim, dim)
-        if np.count_nonzero(sup - np.diag(np.diagonal(sup))) == 0:
-            return _diagonal_op(chunk, dev, local_n=local_n,
-                                d_pair=cplx.pack(np.diagonal(sup)),
-                                targets=targets, controls=(), cstates=())
-        if len(targets) == 2 and len(glob_targets) == 1:
-            jg = list(targets).index(glob_targets[0])
-            t = targets[1 - jg]
-            if t < local_n:
-                return _pair_exchange_2t(
-                    chunk, dev, D=D, local_n=local_n, sup=sup, t=t, jg=jg,
-                    gbit=glob_targets[0] - local_n)
+    The routing decision itself lives in comm.matrix_route — shared with
+    the comm planner's predictor, so the planned exchange schedule
+    cannot drift from what executes here."""
+    sup = C.dense_operand(m_pair, len(targets))
+    route = C.matrix_route(sup, tuple(targets), tuple(controls), local_n)
 
-    if not glob_targets:
+    if route[0] == "diagonal":
+        return _diagonal_op(chunk, dev, local_n=local_n,
+                            d_pair=cplx.pack(np.diagonal(sup)),
+                            targets=targets, controls=(), cstates=())
+    if route[0] == "pair2t":
+        _, _, t, jg, gbit = route
+        return _pair_exchange_2t(chunk, dev, D=D, local_n=local_n,
+                                 sup=sup, t=t, jg=jg, gbit=gbit)
+
+    if route[0] == "local":
         loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
         pred = _global_pred(dev, glob_c)
         # local controls are handled inside apply_matrix; only the global
@@ -159,13 +176,14 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
             new = jnp.where(pred, new, chunk)
         return new
 
-    if len(targets) == 1:
+    if route[0] == "butterfly":
         loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
         pred = _global_pred(dev, glob_c)
         # single-qubit butterfly via one full-chunk pair exchange
-        # (ref statevec_compactUnitary distributed path, :846-881)
-        gbit = targets[0] - local_n
-        recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
+        # (ref statevec_compactUnitary distributed path, :846-881),
+        # sliced per QUEST_EXCHANGE_SLICES with the combine consuming
+        # each received slice independently
+        gbit = route[1]
         mybit = (dev >> gbit) & 1
         mre = jnp.asarray(m_pair[0], dtype=chunk.dtype)
         mim = jnp.asarray(m_pair[1], dtype=chunk.dtype)
@@ -175,12 +193,27 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
         die = jnp.where(mybit == 0, mim[0, 0], mim[1, 1])
         ore = jnp.where(mybit == 0, mre[0, 1], mre[1, 0])
         oie = jnp.where(mybit == 0, mim[0, 1], mim[1, 0])
-        re, im = chunk[0], chunk[1]
-        rre, rim = recv[0], recv[1]
-        new = jnp.stack([
-            dre * re - die * im + ore * rre - oie * rim,
-            dre * im + die * re + ore * rim + oie * rre,
-        ])
+
+        def combine(part, recv):
+            re, im = part[0], part[1]
+            rre, rim = recv[0], recv[1]
+            return jnp.stack([
+                dre * re - die * im + ore * rre - oie * rim,
+                dre * im + die * re + ore * rim + oie * rre,
+            ])
+
+        s = C.effective_slices(chunk.shape[-1])
+        if s == 1:
+            recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
+            new = combine(chunk, recv)
+        else:
+            xs = chunk.reshape(2, s, -1)
+            parts = []
+            for i in range(s):
+                recv = lax.ppermute(xs[:, i], AMP_AXIS,
+                                    _pair_perm(D, gbit))
+                parts.append(combine(xs[:, i], recv))
+            new = jnp.concatenate(parts, axis=1)
         return _mask_blend(new, chunk, local_n, loc_c, loc_s, pred)
 
     # multi-target with global targets: swap each global target into a local
@@ -188,6 +221,7 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
     # targets are eligible — including control qubits, whose role then moves
     # to the vacated global position (the reference's ctrlMask fixup under
     # relabeling, QuEST_cpu_distributed.c:1457-1466).
+    glob_targets = [t for t in targets if t >= local_n]
     slots = [q for q in range(local_n) if q not in targets]
     ctrl_slots = set(controls)
     slots.sort(key=lambda q: (q in ctrl_slots, q))  # prefer non-control slots
@@ -309,16 +343,10 @@ def _pair_exchange_2t(chunk, dev, *, D, local_n, sup, t, jg, gbit):
     rdt = chunk.dtype
     g = (dev >> gbit) & 1
 
-    def sub(out_v, in_v):
-        rows = [i for i in range(4) if ((i >> jg) & 1) == out_v]
-        cols = [j for j in range(4) if ((j >> jg) & 1) == in_v]
-        return sup[np.ix_(rows, cols)]
-
-    same = [sub(0, 0), sub(1, 1)]
-    cross = [sub(0, 1), sub(1, 0)]
-    # which input values of bit t does each parity's cross-block read?
-    need = [sorted(set(np.nonzero(np.abs(cross[gv]) > 0)[1].tolist()))
-            for gv in (0, 1)]
+    # block split + the cross-blocks' read sets come from the comm
+    # planner's shared helper, so the half-vs-full exchange decision
+    # here and the predicted byte count are one computation
+    same, cross, need = C.pair2t_blocks(sup, jg)
 
     def tr(mats):  # traced per-device 2x2 (re, im) pair
         p0, p1 = cplx.pack(mats[0]), cplx.pack(mats[1])
@@ -337,7 +365,8 @@ def _pair_exchange_2t(chunk, dev, *, D, local_n, sup, t, jg, gbit):
         tview = chunk.reshape((2,) + dims)
         send_idx = jnp.where(g == 0, nv[1], nv[0])
         moving = lax.dynamic_slice_in_dim(tview, send_idx, 1, axis=ax)
-        recv = lax.ppermute(moving, AMP_AXIS, _pair_perm(D, gbit))
+        recv = _sliced_ppermute(moving.reshape(2, -1), D, gbit).reshape(
+            moving.shape)
         # cross contribution: out(r) += cross[g][r, need[g]] * recv
         col = [np.asarray(cross[gv])[:, nv[gv]] for gv in (0, 1)]
         shape = [1] * len(dims)
@@ -358,7 +387,7 @@ def _pair_exchange_2t(chunk, dev, *, D, local_n, sup, t, jg, gbit):
 
     # dense cross-block (generic crossing 2q unitaries; 1q channels all
     # take the half-chunk branch above): one full-chunk exchange
-    recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
+    recv = _sliced_ppermute(chunk, D, gbit)
     return new + A.apply_matrix(recv, local_n, tr(cross), (t,))
 
 
@@ -433,24 +462,31 @@ def _apply_gateop(chunk, dev, *, D, local_n, density, op):
 
 def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
                 lazy: bool = False, relabel: bool = None,
-                sched_stats: Optional[dict] = None):
+                sched_stats: Optional[dict] = None,
+                bands: Sequence = None,
+                comm_info: Optional[dict] = None):
     """The flat op list the banded/fused sharded engines EXECUTE:
     flatten_ops plus the one relabel-rewrite policy. The single home of
     that policy — parallel.introspect reads plan statistics through
     this same function, so the reported schedule cannot drift from the
-    executed one. relabel=None means on-unless-lazy; requesting both
-    strategies explicitly raises. `sched_stats`, when a dict, receives
+    executed one. relabel=None means AUTO under QUEST_COMM_PLAN
+    (the comm planner picks the cheapest of plain/coalesce/
+    relabel-events/lazy by predicted comm_stats bytes through the
+    engine's own fusion-plan pricing — parallel/comm.py; `bands` is the
+    calling engine's band layout so the pricing matches what it runs)
+    and plan_full_relabels when the knob is off; requesting both lazy
+    and relabel explicitly raises. `sched_stats`, when a dict, receives
     the scheduler's counters from the SAME scheduler run that produced
-    the returned list (introspect's consumer — a second schedule() pass
-    just for stats would double the O(ops x pool) planning cost)."""
+    the returned list; `comm_info` likewise receives the comm planner's
+    strategy + per-candidate costs, plus — when the auto path ran —
+    the winning candidate's fusion plan under "items" so callers don't
+    re-run F.plan on the identical input (introspect's consumers)."""
     from quest_tpu.circuit import flatten_ops
     from quest_tpu.ops import fusion as F
 
     if lazy and relabel:
         raise ValueError("lazy and relabel are mutually exclusive "
                          "relabeling strategies; pick one")
-    if relabel is None:
-        relabel = not lazy
     # the commutation-aware scheduler runs BEFORE relabel planning: a
     # reorder changes which qubits co-occur between exchanges, so the
     # relabel pass must see the order that will actually execute (its
@@ -468,11 +504,53 @@ def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
         flat = sched if enabled else list(flat0)
     if lazy:
         from quest_tpu.parallel.relabel import lazy_relabel_ops
+        if comm_info is not None:
+            comm_info.update({"strategy": "lazy"})
         return lazy_relabel_ops(flat, n, local_n)
-    if relabel:
+    if relabel is None and C.plan_enabled():
+        chosen, info = C.choose_plan(
+            flat, n, local_n, engine="banded",
+            bands=bands if bands is not None else _shard_bands(n, local_n))
+        if comm_info is not None:
+            comm_info.update(info)
+        return chosen
+    if relabel or relabel is None:
         from quest_tpu.parallel.relabel import plan_full_relabels
+        if comm_info is not None:
+            comm_info.update({"strategy": "relabel"})
         return plan_full_relabels(flat, n, local_n)
+    if comm_info is not None:
+        comm_info.update({"strategy": "plain"})
     return flat
+
+
+def pergate_flat(ops: Sequence, n: int, density: bool, local_n: int,
+                 lazy: bool = False,
+                 comm_info: Optional[dict] = None) -> List:
+    """The flat op list the PER-GATE engine (compile_circuit_sharded)
+    executes — flatten (duals explicit, superops doubled) plus the comm
+    planner's per-circuit choice under QUEST_COMM_PLAN (priced per
+    routed op, the per-gate engine's real cost: no band composition).
+    The single home of that policy, shared with parallel.introspect so
+    the reported per-gate schedule cannot drift from the executed one.
+    lazy=True forces the legacy lazy rewrite; QUEST_COMM_PLAN=0 keeps
+    the reference-faithful plain schedule."""
+    from quest_tpu.circuit import flatten_ops
+    from quest_tpu.parallel.relabel import lazy_relabel_ops
+
+    flat = flatten_ops(ops, n, density)
+    if lazy:
+        if comm_info is not None:
+            comm_info.update({"strategy": "lazy"})
+        return lazy_relabel_ops(flat, n, local_n)
+    if C.plan_enabled():
+        chosen, info = C.choose_plan(flat, n, local_n, engine="pergate")
+        if comm_info is not None:
+            comm_info.update(info)
+        return chosen
+    if comm_info is not None:
+        comm_info.update({"strategy": "plain"})
+    return list(flat)
 
 
 def _shard_bands(n: int, local_n: int):
@@ -556,8 +634,13 @@ def compile_circuit_sharded_banded(ops: Sequence, n: int, density: bool,
     _reject_measure_ops(ops)
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
-    flat = engine_flat(ops, n, density, local_n, lazy=lazy, relabel=relabel)
-    items = F.plan(flat, n, bands=_shard_bands(n, local_n))
+    bands = _shard_bands(n, local_n)
+    cinfo: dict = {}
+    flat = engine_flat(ops, n, density, local_n, lazy=lazy, relabel=relabel,
+                       bands=bands, comm_info=cinfo)
+    items = cinfo.get("items")
+    if items is None:
+        items = F.plan(flat, n, bands=bands)
 
     def run(chunk):
         chunk = chunk.reshape(2, -1)
@@ -700,8 +783,12 @@ def compile_circuit_sharded_fused(ops: Sequence, n: int, density: bool,
         return compile_circuit_sharded_banded(ops, n, density, mesh,
                                               donate, relabel=relabel)
 
-    flat = engine_flat(ops, n, density, local_n, relabel=relabel)
-    items = F.plan(flat, n, bands=bands)
+    cinfo: dict = {}
+    flat = engine_flat(ops, n, density, local_n, relabel=relabel,
+                       bands=bands, comm_info=cinfo)
+    items = cinfo.get("items")
+    if items is None:
+        items = F.plan(flat, n, bands=bands)
     parts = _plan_fused_parts(items, local_n, interpret, {})
 
     def apply_sharded_item(chunk, dev, it):
@@ -759,9 +846,13 @@ def compile_circuit_sharded_fused_batched(ops: Sequence, n: int,
     if local_n < 1:
         val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
     bands = fused_shard_bands(n, local_n)
-    flat = engine_flat(ops, n, density, local_n, relabel=relabel)
-    items = F.plan(flat, n, bands=bands if bands is not None
-                   else _shard_bands(n, local_n))
+    eff_bands = bands if bands is not None else _shard_bands(n, local_n)
+    cinfo: dict = {}
+    flat = engine_flat(ops, n, density, local_n, relabel=relabel,
+                       bands=eff_bands, comm_info=cinfo)
+    items = cinfo.get("items")
+    if items is None:
+        items = F.plan(flat, n, bands=eff_bands)
     parts = None
     if bands is not None:
         parts = []
@@ -842,12 +933,12 @@ def compile_circuit_sharded(ops: Sequence, n: int, density: bool, mesh: Mesh,
         raise QuESTError(
             "Invalid operation: noise channels require a density-matrix "
             "register")
-    if lazy:
-        from quest_tpu.circuit import flatten_ops
-        from quest_tpu.parallel.relabel import lazy_relabel_ops
-        ops = tuple(lazy_relabel_ops(flatten_ops(ops, n, density), n,
-                                     local_n))
-        density = False  # duals are explicit in the flattened list
+    if lazy or C.plan_enabled():
+        # flatten + rewrite through the per-gate comm policy (the comm
+        # planner's per-circuit choice, or the legacy lazy rewrite);
+        # duals are explicit in the flattened list
+        ops = tuple(pergate_flat(ops, n, density, local_n, lazy=lazy))
+        density = False
     else:
         ops = tuple(ops)
 

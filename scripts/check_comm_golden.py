@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""CI smoke gate for the comm planner (docs/DISTRIBUTED.md): fails if
+the deep-global testbed's PLANNED collective schedule regresses above
+its committed goldens, asserted CPU-side through the comm predictor —
+pure host planning, no mesh, no compile, no chip (the comm analogue of
+check_sweep_golden.py; tests/test_comm.py separately pins the same
+predictions EQUAL to XLA's lowered StableHLO accounting).
+
+Gates (8-device shard geometry, f64 registers):
+  * per-gate engine: planned bytes >= 2x below the lazy-relabel plan —
+    the mpiQulacs-style coalescing must keep beating per-qubit SWAPs;
+  * banded engine: planned bytes no worse than BOTH its pre-lazy
+    baseline (the plain composed schedule) and its layer-amortized
+    relabel incumbent — the planner can only ever improve it;
+  * absolute ceilings on the chosen plan (6 all-to-alls / 672 B).
+
+The goldens live HERE (the CI gate) and are mirrored by the tier-1
+assertions in tests/test_comm.py; a planner change that moves either
+must update both, consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEEPGLOBAL_GOLDEN_EXCHANGES = 6
+DEEPGLOBAL_GOLDEN_BYTES = 672       # f64, 8 devices
+N, DEPTH, DEVICES = 6, 6, 8
+BPR = 8                              # f64 planes
+
+
+def main() -> int:
+    import bench
+    from quest_tpu.circuit import flatten_ops
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.parallel import comm as C
+    from quest_tpu.parallel import relabel as R
+    from quest_tpu.parallel import sharded as S
+
+    local_n = N - (DEVICES.bit_length() - 1)
+    c = bench._build_deep_global_circuit(N, DEPTH)
+    flat = flatten_ops(c.ops, N, False)
+
+    def stats_flat(lst):
+        return C.comm_stats(C.predict_exchanges_flat(lst, local_n),
+                            num_devices=DEVICES, bytes_per_real=BPR)
+
+    def stats_items(lst):
+        items = F.plan(lst, N, bands=S._shard_bands(N, local_n))
+        return C.comm_stats(C.predict_exchanges_items(items, local_n),
+                            num_devices=DEVICES, bytes_per_real=BPR)
+
+    pg_info: dict = {}
+    pg = stats_flat(S.pergate_flat(c.ops, N, False, local_n,
+                                   comm_info=pg_info))
+    pg_lazy = stats_flat(R.lazy_relabel_ops(flat, N, local_n))
+    bd_info: dict = {}
+    bd = stats_items(S.engine_flat(c.ops, N, False, local_n,
+                                   comm_info=bd_info))
+    bd_plain = stats_items(list(F.maybe_schedule(flat, N)))
+    bd_relabel = stats_items(R.plan_full_relabels(
+        list(F.maybe_schedule(flat, N)), N, local_n))
+
+    rec = {
+        "pergate_bytes": pg["comm_bytes"],
+        "pergate_exchanges": pg["comm_exchanges"],
+        "pergate_strategy": pg_info.get("strategy"),
+        "pergate_lazy_bytes": pg_lazy["comm_bytes"],
+        "banded_bytes": bd["comm_bytes"],
+        "banded_exchanges": bd["comm_exchanges"],
+        "banded_strategy": bd_info.get("strategy"),
+        "banded_plain_bytes": bd_plain["comm_bytes"],
+        "banded_relabel_bytes": bd_relabel["comm_bytes"],
+    }
+    print(json.dumps(rec))
+    ok = True
+    if 2 * pg["comm_bytes"] > pg_lazy["comm_bytes"]:
+        print(f"REGRESSION: per-gate planned bytes {pg['comm_bytes']} "
+              f"not >=2x below the lazy-relabel plan "
+              f"{pg_lazy['comm_bytes']}", file=sys.stderr)
+        ok = False
+    if bd["comm_bytes"] > bd_plain["comm_bytes"]:
+        print(f"REGRESSION: banded planned bytes {bd['comm_bytes']} "
+              f"above the pre-lazy plain baseline "
+              f"{bd_plain['comm_bytes']}", file=sys.stderr)
+        ok = False
+    if bd["comm_bytes"] > bd_relabel["comm_bytes"]:
+        print(f"REGRESSION: banded planned bytes {bd['comm_bytes']} "
+              f"above the layer-amortized relabel incumbent "
+              f"{bd_relabel['comm_bytes']} — choose_plan's tie-break "
+              f"contract is broken", file=sys.stderr)
+        ok = False
+    for name, st in (("pergate", pg), ("banded", bd)):
+        if st["comm_exchanges"] > DEEPGLOBAL_GOLDEN_EXCHANGES:
+            print(f"REGRESSION: {name} exchanges {st['comm_exchanges']} "
+                  f"> golden {DEEPGLOBAL_GOLDEN_EXCHANGES}",
+                  file=sys.stderr)
+            ok = False
+        if st["comm_bytes"] > DEEPGLOBAL_GOLDEN_BYTES:
+            print(f"REGRESSION: {name} bytes {st['comm_bytes']} > "
+                  f"golden {DEEPGLOBAL_GOLDEN_BYTES}", file=sys.stderr)
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

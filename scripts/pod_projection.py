@@ -49,9 +49,18 @@ t0 = time.time()
 rec = sharded_schedule(c.ops, n, False, mesh, engine="banded")
 lower_s = time.time() - t0
 
+# the projection builds on the comm planner's metric, which must match
+# XLA's lowered accounting — a projection from a drifted predictor
+# would be fiction (tests/test_comm.py pins this; re-asserted here)
+assert rec["comm_matches_hlo"], rec
+
 print(json.dumps({
     "gates": len(c.ops), "lower_s": round(lower_s, 2),
     "collective_permutes": rec["collective_permutes"],
+    "comm_exchanges": rec["comm_exchanges"],
+    "comm_all_to_alls": rec["comm_all_to_alls"],
+    "comm_bytes": rec["comm_bytes"],
+    "comm_strategy": rec["comm_strategy"],
     "ici_bytes_per_device_per_step": rec["ici_bytes_per_device"],
     "local_band_passes": rec["local_band_passes"],
     "global_qubit_items": rec["global_qubit_items"],
@@ -82,6 +91,11 @@ def main():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={args.devices}")
+    # project the UNSLICED schedule: the HBM term below charges one
+    # chunk read+write per exchange, and a sliced exchange (S
+    # collective-permutes of 1/S chunk each) would inflate that by the
+    # slice factor at unchanged real traffic
+    env["QUEST_EXCHANGE_SLICES"] = "1"
     code = WORKER % {"repo": REPO, "n": args.n, "depth": args.depth,
                      "D": args.devices, "circuit": args.circuit}
     r = subprocess.run([sys.executable, "-c", code], env=env,
@@ -92,11 +106,15 @@ def main():
     rec = json.loads(r.stdout.strip().splitlines()[-1])
 
     chunk_gb = 2 * 4 * (1 << args.n) / args.devices / 1e9
-    # each local band pass reads+writes the chunk; each collective also
-    # costs ~1 read+write to apply the received half
-    hbm_gb = (rec["local_band_passes"] + rec["collective_permutes"]) \
+    # each local band pass reads+writes the chunk; each collective
+    # exchange (pair permute OR all-to-all relabel) also costs ~1
+    # read+write to apply/shuffle what moved. comm_exchanges is the comm
+    # planner's HLO-verified count — the old hand-derived
+    # collective_permutes figure missed the all-to-all events entirely
+    hbm_gb = (rec["local_band_passes"] + rec["comm_exchanges"]) \
         * 2 * chunk_gb
-    ici_gb = rec["ici_bytes_per_device_per_step"] / 1e9
+    # ICI from the planner's verified per-device payload bytes
+    ici_gb = rec["comm_bytes"] / 1e9
     t_hbm = hbm_gb / args.hbm
     t_ici = ici_gb / args.ici
     rec.update({

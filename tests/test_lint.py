@@ -352,6 +352,53 @@ def test_expec_knobs_are_keyed_with_flips():
             k.parse(k.malformed)
 
 
+def test_comm_knob_registry_coverage(tmp_path):
+    """QUEST_COMM_PLAN / QUEST_EXCHANGE_SLICES coverage of the registry
+    rules (ISSUE 9): a registry read (knob_value) of the keyed comm
+    knobs on a jit-reachable path passes QL001; direct os.environ reads
+    of the same knobs fire QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_COMM_PLAN"):
+                return amps
+            return amps * knob_value("QUEST_EXCHANGE_SLICES")
+
+        def configure():
+            a = os.environ.get("QUEST_COMM_PLAN")
+            b = os.environ.get("QUEST_EXCHANGE_SLICES")
+            return a, b
+    """, name="commknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 2 and all("bypasses" in v.message for v in q4), vs
+
+
+def test_comm_knobs_are_keyed_with_flips():
+    """Both comm-planner knobs must stay keyed (they select which
+    compiled sharded program a call resolves to) and flip-auditable —
+    the knob-flip audit sweeps every keyed knob with registered flips
+    automatically, so this pin keeps them in that sweep, and both
+    parsers must reject malformed input loudly."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_COMM_PLAN", "QUEST_EXCHANGE_SLICES"):
+        k = KNOBS[name]
+        assert k.scope == "keyed" and k.layer == "planner", name
+        assert k.flips and k.flips[0] != k.flips[1], name
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    # the slices parser rejects non-pow2 and out-of-range values
+    parse = KNOBS["QUEST_EXCHANGE_SLICES"].parse
+    for bad in ("0", "3", "2048", "x"):
+        with pytest.raises(ValueError):
+            parse(bad)
+    assert parse("4") == 4
+
+
 def test_serve_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
     serve knobs are RUNTIME scope — read once at ServeEngine
