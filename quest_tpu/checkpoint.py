@@ -7,21 +7,44 @@ a real gap; here checkpointing is a first-class feature:
 
   * `save` / `load`: binary .npz of the (2, 2^n) float planes + register
     metadata — exact to the bit, any register size, any platform.
+    Writes are ATOMIC (temp dir + rename commit), so a crash mid-save
+    never leaves a half-written checkpoint where a complete one stood.
+  * per-plane SHA-256 digests stamped at save (format_version 3) and
+    verified at load: a flipped bit on disk raises `CheckpointError`
+    NAMING the corrupt plane and the expected/got digests instead of
+    silently resuming from garbage. v1/v2 checkpoints (pre-digest)
+    still load, with a one-time stderr warning (the native.py degrade
+    pattern).
+  * `save_step` / `step_dirs`: versioned `ckpt-<step>` checkpoints under
+    one root with keep-last-K retention (`QUEST_CHECKPOINT_KEEP`) — the
+    durable executor's resume chain (quest_tpu/resilience/durable.py,
+    docs/RESILIENCE.md §durable).
   * `save_sharded` / `load_sharded`: orbax-backed checkpoint of the
     sharded device array (per-shard files, suitable for multi-host pods
     where no single host holds the full state). Falls back with a clear
     error if orbax is unavailable.
 
-Both paths restore INTO a freshly created register, so a checkpoint can be
-reloaded under a different mesh/sharding than it was saved with (the
-analogue of changing MPI rank counts between runs — something the
+Both npz paths restore INTO a freshly created register, so a checkpoint
+can be reloaded under a different mesh/sharding than it was saved with
+(the analogue of changing MPI rank counts between runs — something the
 reference's CSV path also supports, one rank at a time).
+
+Fault sites (docs/RESILIENCE.md): `checkpoint.save` fires at the commit
+point (after the temp files are written, before the rename) — an
+injected error there emulates a crash mid-save and must leave the
+previous checkpoint loadable; `checkpoint.load` fires at the top of the
+read path.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import re
+import shutil
+import sys
+import uuid
 
 
 import jax
@@ -29,6 +52,7 @@ import numpy as np
 
 from quest_tpu import precision
 from quest_tpu import validation
+from quest_tpu.resilience import faults
 from quest_tpu.state import Qureg, create_density_qureg, create_qureg
 
 _META_NAME = "qureg_meta.json"
@@ -38,16 +62,91 @@ _ORBAX_DIR = "orbax"
 # checkpoint at all" from "a quest checkpoint from the future" from "a
 # quest checkpoint that's merely corrupt" — three different clear
 # errors instead of one leaked KeyError/BadZipFile. Version-1
-# checkpoints predate the field and load tolerantly.
+# checkpoints predate the field; format 3 adds per-plane digests.
+# Pre-3 checkpoints load tolerantly (one stderr warning per process).
 _MAGIC = "quest-checkpoint"
-_FORMAT_VERSION = 2
+_FORMAT_VERSION = 3
+# {:08d} zero-pads SMALL steps; a step past 10^8 (trajectory chains
+# index by shots done) widens the field, so the matcher must accept it
+_STEP_RE = re.compile(r"^ckpt-(\d{8,})$")
+
+_legacy_warned = False
 
 
 class CheckpointError(validation.QuESTError):
-    """A checkpoint could not be read: missing/corrupt/truncated files
-    or metadata that does not match the register being restored. The
-    message always names the offending file and the mismatch — numpy /
-    orbax internals never leak to the caller (docs/RESILIENCE.md)."""
+    """A checkpoint could not be read: missing/corrupt/truncated files,
+    a failed per-plane integrity digest, or metadata that does not match
+    the register being restored. The message always names the offending
+    file (and for digest failures, the plane plus expected/got digests)
+    — numpy / orbax internals never leak to the caller
+    (docs/RESILIENCE.md)."""
+
+
+def _warn_legacy_once(directory: str, version: int) -> None:
+    """One warning per process when a pre-digest (v1/v2) checkpoint
+    loads: the load is tolerant — the fields are additive — but the
+    planes carry no integrity checksums, so corruption there is
+    undetectable (the native.py degrade-to-Python warn-once pattern)."""
+    global _legacy_warned
+    if _legacy_warned:
+        return
+    _legacy_warned = True
+    print(f"[quest_tpu.checkpoint] loading format_version {version} "
+          f"checkpoint from {directory!r}: no per-plane checksums "
+          f"(added in format 3) — corruption on disk cannot be "
+          f"detected; re-save to upgrade", file=sys.stderr, flush=True)
+
+
+def _digest(arr: np.ndarray) -> str:
+    h = hashlib.sha256()
+    # feed the array's buffer directly — .tobytes() would copy the
+    # whole plane per checkpoint (checkpoint cadence is a hot path for
+    # the durable executor's overhead budget)
+    h.update(memoryview(np.ascontiguousarray(arr)).cast("B"))
+    return h.hexdigest()
+
+
+def _meta_digest(meta: dict) -> str:
+    """Self-digest of the metadata (canonical JSON, the digest field
+    itself excluded): the meta carries the durable RESUME CURSOR, and a
+    corrupted-but-parseable cursor (one flipped digit in 'step') would
+    otherwise resume silently to wrong amplitudes — the per-plane
+    digests only cover the array bytes."""
+    clean = {k: v for k, v in meta.items() if k != "meta_digest"}
+    return hashlib.sha256(
+        json.dumps(clean, sort_keys=True,
+                   separators=(",", ":")).encode()).hexdigest()
+
+
+def _plane_digests(arrays: dict) -> dict:
+    """Per-plane SHA-256 digests of a checkpoint payload: the 'planes'
+    array's leading re/im planes digest separately (so the error can
+    name WHICH plane rotted), every other array digests whole."""
+    out = {}
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        if name == "planes" and arr.ndim >= 1 and arr.shape[0] == 2:
+            out["planes[re]"] = _digest(arr[0])
+            out["planes[im]"] = _digest(arr[1])
+        else:
+            out[name] = _digest(arr)
+    return out
+
+
+def _digest_target(name: str, arrays: dict):
+    """The array (or plane slice) a digest entry names, or None when its
+    base array is absent from the payload."""
+    m = re.match(r"^(.*)\[(re|im)\]$", name)
+    if m:
+        base = arrays.get(m.group(1))
+        if base is None or base.ndim < 1 or base.shape[0] < 2:
+            # a corrupt rewrite can shrink the stored array below the
+            # plane index: treat it as the plane being missing (one
+            # documented CheckpointError, never a leaked IndexError —
+            # the durable resume chain must SKIP this, not crash)
+            return None
+        return base[0 if m.group(2) == "re" else 1]
+    return arrays.get(name)
 
 
 def _meta(qureg: Qureg) -> dict:
@@ -93,40 +192,106 @@ def _read_meta(directory: str) -> dict:
             f"Invalid checkpoint: {path!r} is format_version "
             f"{version!r}, newer than this build supports "
             f"(<= {_FORMAT_VERSION}) — upgrade quest_tpu to load it")
-    missing = [k for k in ("num_qubits", "is_density", "real_dtype")
-               if k not in meta]
-    if missing:
-        raise CheckpointError(
-            f"Invalid checkpoint: {path!r} is missing required "
-            f"field(s) {missing}")
+    if meta.get("payload", "qureg") == "qureg":
+        missing = [k for k in ("num_qubits", "is_density", "real_dtype")
+                   if k not in meta]
+        if missing:
+            raise CheckpointError(
+                f"Invalid checkpoint: {path!r} is missing required "
+                f"field(s) {missing}")
     return meta
 
 
-def save(qureg: Qureg, directory: str) -> None:
-    """Write the full state to `directory` (host-gathered .npz planes)."""
-    os.makedirs(directory, exist_ok=True)
-    planes = np.asarray(jax.device_get(qureg.amps))
-    np.savez(os.path.join(directory, _AMPS_NAME), planes=planes)
-    with open(os.path.join(directory, _META_NAME), "w") as f:
-        json.dump(_meta(qureg), f)
+# ---------------------------------------------------------------------------
+# atomic write + verified read of the npz payload
+# ---------------------------------------------------------------------------
 
 
-def load(directory: str, env=None, dtype=None) -> Qureg:
-    """Recreate a register from a checkpoint written by `save`. Every
-    failure mode — missing/corrupt/truncated files, metadata that does
-    not match the stored planes — raises CheckpointError naming the
-    file and the mismatch (never a leaked numpy/zipfile internal)."""
+def _write_atomic(directory: str, meta: dict, arrays: dict) -> None:
+    """Write a complete checkpoint into a sibling temp dir, then commit
+    with one directory rename: a crash at ANY point before the commit
+    leaves the target untouched (either absent or the previous complete
+    checkpoint); a crash after it leaves the new complete checkpoint.
+    The `checkpoint.save` fault site fires at the commit point so
+    tests/soaks can emulate the mid-save crash deterministically. The
+    overwrite path (target already a directory) swaps via a second
+    sibling rename — never half-written, but a hard kill inside its
+    two-syscall window leaves the target absent with the previous
+    payload stranded under a `.old-<tag>` sibling (recoverable by
+    hand); the versioned save_step path therefore ALWAYS commits to a
+    fresh name (same-step leftovers are deleted first) and is fully
+    atomic."""
+    directory = os.path.abspath(directory)
+    parent = os.path.dirname(directory) or "."
+    os.makedirs(parent, exist_ok=True)
+    if os.path.isdir(directory) and os.listdir(directory) \
+            and not os.path.exists(os.path.join(directory, _META_NAME)):
+        # the swap below REPLACES the whole target directory; silently
+        # rmtree'ing a non-checkpoint directory a caller pointed at by
+        # mistake would destroy unrelated files (the old merge-write
+        # behavior tolerated that call; refusing loudly is safer)
+        raise ValueError(
+            f"refusing to overwrite {directory!r}: it exists, is not "
+            f"empty, and holds no {_META_NAME} — not a checkpoint "
+            f"directory; pick a new/empty path")
+    meta = dict(meta)
+    meta["plane_digests"] = _plane_digests(arrays)
+    meta["meta_digest"] = _meta_digest(meta)
+    tag = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    tmp = f"{directory}.tmp-{tag}"
+    os.makedirs(tmp)
+    try:
+        np.savez(os.path.join(tmp, _AMPS_NAME), **arrays)
+        with open(os.path.join(tmp, _META_NAME), "w") as f:
+            json.dump(meta, f)
+        # the commit point: an injected error here aborts BEFORE the
+        # rename, so the previous checkpoint (if any) stays loadable —
+        # the mid-save-crash contract (a python-level abort also cleans
+        # its temp dir below; only a hard kill leaves one behind, and
+        # sweep_stale/prune_steps reclaims those)
+        if faults.ACTIVE:
+            faults.check("checkpoint.save", directory=directory, tmp=tmp)
+        if os.path.isdir(directory):
+            if not os.listdir(directory):
+                os.rmdir(directory)          # empty dir: plain commit
+                os.rename(tmp, directory)
+            else:
+                old = f"{directory}.old-{tag}"
+                os.rename(directory, old)
+                try:
+                    os.rename(tmp, directory)
+                except BaseException:
+                    # best-effort rollback so a python-level rename
+                    # failure doesn't leave the target absent
+                    os.rename(old, directory)
+                    raise
+                shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, directory)
+    except BaseException:
+        # a FAILED (python-level) save must not leak a payload-sized
+        # temp dir per attempt — long durable runs on flaky disks would
+        # otherwise grow the checkpoint root unboundedly. (A hard kill
+        # still leaves the tmp; step_dirs ignores it and sweep_stale
+        # reclaims it.)
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_arrays(directory: str, require=()):
+    """(meta, arrays) of a checkpoint written by `save` / `save_arrays`
+    / `save_step`, with every per-plane digest VERIFIED against the
+    stored bytes (format 3; pre-digest checkpoints warn once on stderr
+    and load unverified). `require` names arrays that must be present
+    (the qureg loader requires 'planes'). Every failure mode raises
+    CheckpointError naming the file and the mismatch."""
+    if faults.ACTIVE:
+        faults.check("checkpoint.load", directory=directory)
     meta = _read_meta(directory)
     amps_path = os.path.join(directory, _AMPS_NAME)
     try:
         with np.load(amps_path) as data:
-            if "planes" not in data:
-                raise CheckpointError(
-                    f"Invalid checkpoint: {amps_path!r} holds no "
-                    f"'planes' array (found {sorted(data.files)})")
-            planes = data["planes"]
-    except CheckpointError:
-        raise
+            arrays = {k: data[k] for k in data.files}
     except FileNotFoundError:
         raise CheckpointError(
             f"Invalid checkpoint: amplitude file {amps_path!r} is "
@@ -138,6 +303,116 @@ def load(directory: str, env=None, dtype=None) -> Qureg:
         raise CheckpointError(
             f"Invalid checkpoint: amplitude file {amps_path!r} is "
             f"corrupt or truncated ({type(e).__name__}: {e})") from e
+    for name in require:
+        if name not in arrays:
+            raise CheckpointError(
+                f"Invalid checkpoint: {amps_path!r} holds no "
+                f"{name!r} array (found {sorted(arrays)})")
+    version = meta.get("format_version", 1)
+    md = meta.get("meta_digest")
+    if md is not None and _meta_digest(meta) != md:
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata in {directory!r} fails its "
+            f"self-digest — the cursor/fields were altered after the "
+            f"save (corrupt meta resumes to WRONG amplitudes; refusing "
+            f"to load)")
+    if md is None and version >= 3:
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata in {directory!r} claims "
+            f"format_version {version} but carries no meta_digest — "
+            f"the integrity metadata was stripped or the file is "
+            f"corrupt")
+    digests = meta.get("plane_digests")
+    if digests:
+        for name, expect in sorted(digests.items()):
+            target = _digest_target(name, arrays)
+            if target is None:
+                raise CheckpointError(
+                    f"Invalid checkpoint: {amps_path!r} is missing the "
+                    f"digested array behind plane {name!r} "
+                    f"(found {sorted(arrays)})")
+            got = _digest(np.asarray(target))
+            if got != expect:
+                raise CheckpointError(
+                    f"Invalid checkpoint: plane {name!r} in "
+                    f"{amps_path!r} fails its integrity digest "
+                    f"(expected sha256 {expect[:16]}…, got {got[:16]}…)"
+                    f" — the stored bytes are corrupt; refusing to "
+                    f"restore from them")
+    elif version >= 3:
+        # a v3 meta with the digest table stripped is not "old and
+        # tolerable", it is tampered/corrupt: loading it unverified
+        # would silently void the format-3 integrity guarantee
+        raise CheckpointError(
+            f"Invalid checkpoint: metadata in {directory!r} claims "
+            f"format_version {version} but carries no plane_digests "
+            f"table — the integrity metadata was stripped or the file "
+            f"is corrupt; refusing to load unverified planes")
+    else:
+        _warn_legacy_once(directory, version)
+    return meta, arrays
+
+
+def read_extra(directory: str):
+    """The `extra` payload stored by save(..., extra=) — the durable
+    executor's cursor — without touching the amplitude arrays. Returns
+    None when the checkpoint carries no extra payload."""
+    return _read_meta(directory).get("extra")
+
+
+def save(qureg: Qureg, directory: str, extra=None) -> None:
+    """Write the full state to `directory` (host-gathered .npz planes),
+    ATOMICALLY: the payload lands in a temp dir and commits with one
+    rename, so a crash mid-save never corrupts an existing checkpoint
+    at the same path. Per-plane digests are stamped into the metadata
+    (format 3) and verified on load. `extra` (a JSON-serializable dict)
+    rides in the metadata — the durable executor's cursor; read it back
+    with `read_extra` / the meta of `load_arrays`."""
+    planes = np.asarray(jax.device_get(qureg.amps))
+    meta = _meta(qureg)
+    if extra is not None:
+        meta["extra"] = extra
+    _write_atomic(directory, meta, {"planes": planes})
+
+
+def save_arrays(directory: str, arrays: dict, extra=None) -> None:
+    """Atomic checkpoint of raw named arrays (payload='arrays'): the
+    durable TRAJECTORY executor's accumulated (shots, 2, 2^n) planes +
+    draws, digested and verified exactly like the qureg payload. Load
+    with `load_arrays`; `load` rejects it loudly (it is not a register
+    snapshot)."""
+    for name in arrays:
+        if re.search(r"\[(re|im)\]$", name):
+            # such a name would collide with the per-plane digest
+            # entries ('planes[re]'/'planes[im]') and write a
+            # checkpoint _digest_target can never resolve — i.e. a
+            # valid save that is permanently unreadable
+            raise ValueError(
+                f"array name {name!r} must not end with '[re]'/'[im]' "
+                f"(reserved for per-plane digest entries)")
+    meta = {"magic": _MAGIC, "format_version": _FORMAT_VERSION,
+            "payload": "arrays"}
+    if extra is not None:
+        meta["extra"] = extra
+    _write_atomic(directory, meta,
+                  {k: np.asarray(jax.device_get(v))
+                   for k, v in arrays.items()})
+
+
+def load(directory: str, env=None, dtype=None) -> Qureg:
+    """Recreate a register from a checkpoint written by `save`. Every
+    failure mode — missing/corrupt/truncated files, a failed per-plane
+    digest, metadata that does not match the stored planes — raises
+    CheckpointError naming the file and the mismatch (never a leaked
+    numpy/zipfile internal)."""
+    meta, arrays = load_arrays(directory, require=("planes",))
+    if meta.get("payload", "qureg") != "qureg":
+        raise CheckpointError(
+            f"Invalid checkpoint: {directory!r} holds a "
+            f"{meta['payload']!r} payload, not a register snapshot — "
+            f"use checkpoint.load_arrays")
+    planes = arrays["planes"]
+    amps_path = os.path.join(directory, _AMPS_NAME)
     try:
         rdt = np.dtype(meta["real_dtype"])
     except TypeError as e:
@@ -156,6 +431,94 @@ def load(directory: str, env=None, dtype=None) -> Qureg:
     amps = jax.device_put(jax.numpy.asarray(planes.astype(q.real_dtype)),
                           q.amps.sharding)
     return q.replace_amps(amps)
+
+
+# ---------------------------------------------------------------------------
+# versioned step checkpoints: the durable executor's resume chain
+# ---------------------------------------------------------------------------
+
+
+def step_path(root: str, step: int) -> str:
+    return os.path.join(root, f"ckpt-{int(step):08d}")
+
+
+def step_dirs(root: str):
+    """[(step, path)] of the versioned checkpoints under `root`,
+    ascending by step. Temp/old dirs from interrupted saves and foreign
+    entries are ignored — only committed `ckpt-<step>` names count."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _STEP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+_STALE_RE = re.compile(r"^ckpt-\d{8,}\.(tmp|old)-")
+
+
+def sweep_stale(root: str) -> int:
+    """Reclaim payload-sized `.tmp-*`/`.old-*` leftovers that hard
+    kills strand under a step-checkpoint root (the preemptible-pod
+    headline scenario kills mid-save REPEATEDLY — without a sweep the
+    root grows by a full-state payload per kill). Safe under the
+    chain's single-writer contract: a live save's temp dir belongs to
+    THIS process and is never mid-flight while prune_steps runs.
+    Returns the number of entries removed."""
+    if not os.path.isdir(root):
+        return 0
+    removed = 0
+    for name in os.listdir(root):
+        if _STALE_RE.match(name):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+            removed += 1
+    return removed
+
+
+def prune_steps(root: str, keep: int = None) -> None:
+    """Keep-last-K retention over the versioned checkpoints under
+    `root` (default: the QUEST_CHECKPOINT_KEEP knob, 2): at least two
+    survivors means a checkpoint that turns out corrupt on resume
+    always leaves an older valid one to fall back to. Also sweeps
+    stale `.tmp-*`/`.old-*` leftovers from killed saves."""
+    if keep is None:
+        from quest_tpu.env import knob_value
+        keep = knob_value("QUEST_CHECKPOINT_KEEP")
+    keep = int(keep)
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    for _, path in step_dirs(root)[:-keep]:
+        shutil.rmtree(path, ignore_errors=True)
+    sweep_stale(root)
+
+
+def save_step(root: str, step: int, *, qureg: Qureg = None, arrays=None,
+              extra=None, keep: int = None) -> str:
+    """Atomic versioned checkpoint `root/ckpt-<step>` of either a
+    register (`qureg=`) or raw arrays (`arrays=`), then keep-last-K
+    retention (prune_steps). Step numbers must be distinct per root —
+    the durable executor's monotone cut index. Returns the committed
+    path."""
+    if (qureg is None) == (arrays is None):
+        raise ValueError("save_step takes exactly one of qureg=/arrays=")
+    path = step_path(root, step)
+    if os.path.isdir(path):
+        # a same-step leftover is either corrupt (the durable resume
+        # skipped it and is now replaying past its cut) or identical by
+        # deterministic replay; removing it first keeps the commit on
+        # the fully-atomic fresh-name rename — the two-rename overwrite
+        # swap has a crash window that strands the old payload under an
+        # undiscoverable .old- name, and an older valid checkpoint
+        # survives either way (keep-last-K), so deleting loses nothing
+        shutil.rmtree(path, ignore_errors=True)
+    if qureg is not None:
+        save(qureg, path, extra=extra)
+    else:
+        save_arrays(path, arrays, extra=extra)
+    prune_steps(root, keep)
+    return path
 
 
 # ---------------------------------------------------------------------------

@@ -152,6 +152,18 @@ def _parse_exchange_slices(raw: str) -> int:
     return v
 
 
+def _parse_pos_float(name: str) -> Callable[[str], float]:
+    def parse(raw: str) -> float:
+        try:
+            v = float(raw)
+        except ValueError:
+            raise ValueError(f"{name} must be a float, got {raw!r}")
+        if not (v > 0.0):
+            raise ValueError(f"{name} must be > 0, got {v}")
+        return v
+    return parse
+
+
 def _parse_fault_plan(raw: str):
     # the resilience package is stdlib-only at import time, so the lazy
     # import cannot cycle back into env.py's module load
@@ -354,6 +366,35 @@ _KNOB_LIST = (
              "after, every, times, p, seed); unset = no injection, "
              "zero hot-path cost",
          malformed="serve.not_a_site"),
+    Knob("QUEST_DURABLE_EVERY", _int_range("QUEST_DURABLE_EVERY", 1), 8,
+         scope="runtime", layer="serve",
+         doc="sweep-plan steps between checkpoints of the durable "
+             "executor (resilience/durable.py, docs/RESILIENCE.md "
+             "§durable; default: 8)",
+         malformed="0"),
+    Knob("QUEST_INTEGRITY", _bool01("QUEST_INTEGRITY"), True,
+         scope="runtime", layer="serve",
+         doc="in-flight corruption sentinels at checkpoint cadence "
+             "(statevector norm / density trace+hermiticity drift vs "
+             "the run's baseline): 1/0 (default: 1; a trip raises "
+             "IntegrityError and refuses to stamp the checkpoint)",
+         malformed="2"),
+    Knob("QUEST_INTEGRITY_TOL", _parse_pos_float("QUEST_INTEGRITY_TOL"),
+         1e-3,
+         scope="runtime", layer="serve",
+         doc="relative drift budget of the durable integrity sentinels "
+             "(absolute for unit-scale invariants; default: 1e-3 — "
+             "orders above honest f32 rounding drift, orders below "
+             "real corruption)",
+         malformed="-1"),
+    Knob("QUEST_CHECKPOINT_KEEP",
+         _int_range("QUEST_CHECKPOINT_KEEP", 1), 2,
+         scope="runtime", layer="serve",
+         doc="versioned checkpoints retained per durable run "
+             "(checkpoint.prune_steps keep-last-K; default: 2 — a "
+             "corrupt newest checkpoint always leaves a valid "
+             "predecessor to resume from)",
+         malformed="0"),
     Knob("_QUEST_DRYRUN_BOOTSTRAPPED", _parse_choice(
          "_QUEST_DRYRUN_BOOTSTRAPPED", ("1",)), None,
          scope="runtime", layer="infra",

@@ -139,6 +139,32 @@ class _PermTracker:
         assert perm == list(range(self.n))
 
 
+def replay_perm(flat_prefix: Sequence, n: int, local_n: int) -> List[int]:
+    """Logical->physical permutation after executing `flat_prefix` of a
+    relabel-rewritten op list, REPLAYED through the same _PermTracker
+    bookkeeping that produced it: relabel events apply their slot
+    updates, explicit inserted SWAPs (value-matched against the pass's
+    SWAP operand) apply their position swap; everything else leaves the
+    permutation alone. The durable executor stores this in its
+    checkpoint cursor and re-derives it on resume — a mismatch means
+    the plan drifted between save and resume (a knob flip, a planner
+    change) and the cut amplitudes would be interpreted under the wrong
+    layout (quest_tpu/resilience/durable.py). Note: SWAPs that the
+    fusion planner composed INTO band operators are invisible here by
+    construction — both sides of the comparison replay the same op
+    list, so the fingerprint stays exact."""
+    sink: List = []
+    tr = _PermTracker(n, local_n, sink)
+    for op in flat_prefix:
+        kind = getattr(op, "kind", None)
+        if kind == "relabel":
+            tr.emit_relabel(op.operand)
+        elif (kind == "matrix" and len(op.targets) == 2
+              and not op.controls and np.array_equal(op.operand, SWAP)):
+            tr.emit_swap(op.targets[0], op.targets[1])
+    return list(tr.perm)
+
+
 def _uses(flat, n):
     """Per logical qubit, the sorted indices of ops where it is a MATRIX
     TARGET — the only role that demands a local slot (controls are free

@@ -670,6 +670,37 @@ def _apply_plan_item(chunk, dev, *, D, local_n, it):
                          op=op)
 
 
+def compile_plan_items_sharded(items, n: int, mesh: Mesh,
+                               donate: bool = False):
+    """One jitted shard_map program applying a SLICE of fusion-plan
+    items to the sharded (2, 2^n) planes — the durable executor's
+    per-step program (quest_tpu/resilience/durable.py): the full
+    circuit's plan is cut at item boundaries (each item is one launch
+    on this engine — a band contraction, a relabel all-to-all, a pair
+    exchange) and each cut compiles through here, so an uninterrupted
+    run and a resumed run execute the IDENTICAL program sequence and
+    land on bit-identical amplitudes. Reuses the banded engine's
+    shared applier (_apply_plan_item); donate defaults OFF because the
+    caller snapshots the input for checkpoints."""
+    D = int(mesh.devices.size)
+    local_n = n - int(math.log2(D))
+    if local_n < 1:
+        val._err(val.ErrorCode.E_DISTRIB_QUREG_TOO_SMALL)
+    items = tuple(items)
+
+    def run(chunk):
+        chunk = chunk.reshape(2, -1)
+        dev = lax.axis_index(AMP_AXIS)
+        for it in items:
+            chunk = _apply_plan_item(chunk, dev, D=D, local_n=local_n,
+                                     it=it)
+        return chunk
+
+    sharded = compat.shard_map(run, mesh, P(None, AMP_AXIS),
+                               P(None, AMP_AXIS))
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
 def plan_fused_structural(items, local_n: int):
     """Structural fused plan of a sharded item stream: maximal runs of
     purely-local fusion-plan items become ("segment", stages, arrays)

@@ -1,4 +1,5 @@
-"""quest_tpu.resilience — fault injection, supervision, degradation.
+"""quest_tpu.resilience — fault injection, supervision, degradation,
+durable execution.
 
 The robustness layer under the serving runtime (docs/RESILIENCE.md):
 
@@ -7,10 +8,15 @@ The robustness layer under the serving runtime (docs/RESILIENCE.md):
   * `supervisor` — bounded-restart backoff policy for the serve worker.
   * `breaker` — per-program circuit breaker driving the fused -> banded
     -> host degradation ladder.
+  * `durable` — mid-circuit checkpointing + preemption-tolerant resume
+    + corruption sentinels (`run_durable`, `run_durable_trajectories`;
+    docs/RESILIENCE.md §durable).
 
-Everything here is standard-library-only at import time: these modules
-sit UNDER the serving engine and inside env.py's knob parser, so they
-must never drag jax in.
+faults/supervisor/breaker are standard-library-only at import time:
+they sit UNDER the serving engine and inside env.py's knob parser, so
+they must never drag jax in. `durable` DOES import jax (it drives the
+compiled engines), so it loads lazily through this namespace — the
+package import stays stdlib-only.
 """
 
 from quest_tpu.resilience import faults  # noqa: F401
@@ -18,4 +24,27 @@ from quest_tpu.resilience.breaker import Breaker  # noqa: F401
 from quest_tpu.resilience.faults import FaultPlan, InjectedFault  # noqa: F401
 from quest_tpu.resilience.supervisor import Supervisor  # noqa: F401
 
-__all__ = ["faults", "FaultPlan", "InjectedFault", "Breaker", "Supervisor"]
+_LAZY = {
+    "durable": ("quest_tpu.resilience.durable", None),
+    "run_durable": ("quest_tpu.resilience.durable", "run_durable"),
+    "run_durable_trajectories": ("quest_tpu.resilience.durable",
+                                 "run_durable_trajectories"),
+    "DurableError": ("quest_tpu.resilience.durable", "DurableError"),
+    "IntegrityError": ("quest_tpu.resilience.durable", "IntegrityError"),
+}
+
+__all__ = ["faults", "FaultPlan", "InjectedFault", "Breaker",
+           "Supervisor"] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module 'quest_tpu.resilience' has no "
+                             f"attribute {name!r}") from None
+    import importlib
+    mod = importlib.import_module(mod_name)
+    value = mod if attr is None else getattr(mod, attr)
+    globals()[name] = value
+    return value

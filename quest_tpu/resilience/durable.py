@@ -1,0 +1,651 @@
+"""Durable execution: mid-circuit checkpointing, preemption-tolerant
+resume, and corruption sentinels (docs/RESILIENCE.md §durable).
+
+The reference can only restart a run from gate 0 and trusts every
+amplitude blindly; on preemptible pods a 30q+ job measured in hours
+makes "lose the run" the dominant failure cost (the TPU brute-force
+paper's operating regime, arXiv:2111.10466). `run_durable` executes a
+circuit in STEPS cut at the engines' own launch boundaries — the
+sweep-plan parts of the fused engine, fusion-plan items of the banded
+and sharded engines, shot chunks of the trajectory engine; NEVER
+mid-kernel — and checkpoints the amplitude planes plus a cursor every
+`QUEST_DURABLE_EVERY` steps through quest_tpu.checkpoint's atomic
+versioned chain:
+
+  * RESUME: a rerun of the same call finds the newest VALID checkpoint
+    under `directory`, verifies its cursor against the re-derived plan
+    (engine, step count, keyed-knob mode key, and — on the sharded
+    engine — the relabel `_PermTracker` permutation at the cut), and
+    continues from the cut. Interrupted and uninterrupted runs execute
+    the IDENTICAL per-step program sequence, so the final amplitudes
+    are BIT-IDENTICAL (pinned per engine in tests/test_durable.py).
+  * CORRUPTION ON DISK: every checkpoint's per-plane SHA-256 digests
+    are verified at load (checkpoint.py format 3); a corrupt checkpoint
+    is skipped LOUDLY (stderr + `durable_corrupt_checkpoints_skipped`)
+    in favor of the previous valid one — never silently consumed.
+  * CORRUPTION IN FLIGHT: cheap on-device sentinel reductions run at
+    checkpoint cadence — statevector norm drift vs the run's baseline,
+    density trace + hermiticity residual (`QUEST_INTEGRITY`, budget
+    `QUEST_INTEGRITY_TOL`). A trip raises typed `IntegrityError` and
+    REFUSES to stamp the checkpoint, so a NaN'd or drifted state can
+    never poison the resume chain.
+
+Fault sites `durable.step` / `durable.preempt` (plus `checkpoint.save`
+/ `checkpoint.load`) make every path provable: a seeded FaultPlan kills
+a deep run K times at random boundaries — including mid-save — and the
+chaos soak pins that it still completes with the exact uninterrupted
+amplitudes (tests/test_durable.py).
+
+Metrics (serve.metrics.REGISTRY): counters `durable_steps_run`,
+`durable_checkpoints_saved`, `durable_resumes`,
+`durable_corrupt_checkpoints_skipped`, `durable_sentinel_trips`; gauge
+`durable_last_checkpoint_step`.
+
+This module imports jax and is therefore loaded LAZILY by
+quest_tpu.resilience.__getattr__ — the rest of the resilience package
+stays stdlib-only (env.py's knob parser imports it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import time as _time
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import checkpoint as ckpt
+from quest_tpu import validation
+from quest_tpu.resilience import faults
+from quest_tpu.serve import metrics as _metrics
+from quest_tpu.state import Qureg
+
+
+class DurableError(validation.QuESTError):
+    """A durable resume could not be reconciled with the re-derived
+    plan: the cursor's engine/step-count/mode-key/permutation disagrees
+    with what this process would execute (a keyed-knob flip or circuit
+    edit between save and resume). The message names the field and the
+    expected/got values — resuming anyway would execute the wrong
+    program suffix over the checkpointed amplitudes."""
+
+
+class IntegrityError(validation.QuESTError):
+    """An in-flight corruption sentinel tripped: the state's cheap
+    invariant (statevector norm / density trace+hermiticity) drifted
+    beyond QUEST_INTEGRITY_TOL from the run's baseline — NaN poisoning,
+    a silently corrupt plane, or a non-CPTP evolution. The checkpoint
+    at this cut was NOT stamped (docs/RESILIENCE.md §durable)."""
+
+
+def _counter(name: str):
+    return _metrics.REGISTRY.counter(name)
+
+
+def _ops_sha(ops) -> str:
+    """Value fingerprint of a circuit's op stream — kinds, qubits AND
+    operand bytes. The cursor's op COUNT alone cannot catch an edited
+    rotation angle (same count, same plan shape, different program);
+    resuming across one would splice two different circuits' amplitude
+    prefixes silently."""
+    h = hashlib.sha256()
+    for op in ops:
+        h.update(repr((op.kind, op.targets, op.controls,
+                       op.cstates)).encode())
+        if op.operand is not None:
+            try:
+                h.update(np.asarray(op.operand).tobytes())
+            except Exception:       # nested structures (classical ops)
+                h.update(repr(op.operand).encode())
+    return h.hexdigest()[:32]
+
+
+def _state_fingerprint(state: Qureg) -> str:
+    """Cheap value fingerprint of the INITIAL register, stored in the
+    cursor and re-derived at resume from the caller's own argument: a
+    rerun that passes a different initial state (or dtype) must fail
+    typed, not splice prefixes. Small registers hash every amplitude;
+    huge ones hash shape/dtype plus a leading slice — a full host
+    gather per run is the cost this executor exists to avoid."""
+    amps = state.amps
+    h = hashlib.sha256()
+    h.update(repr((tuple(amps.shape), str(amps.dtype))).encode())
+    if amps.size <= (1 << 22):
+        payload = np.asarray(jax.device_get(amps))
+    else:
+        payload = np.asarray(jax.device_get(amps[:, :4096]))
+    h.update(memoryview(np.ascontiguousarray(payload)).cast("B"))
+    return h.hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# step plans: the circuit cut at launch boundaries, per engine
+# ---------------------------------------------------------------------------
+
+
+def _resolve_state_engine(engine, n: int, is_f32: bool, mesh) -> str:
+    from quest_tpu.ops import pallas_band as PB
+    if mesh is not None:
+        if engine not in (None, "sharded"):
+            raise ValueError(
+                f"engine {engine!r} does not take a mesh; pass "
+                f"engine='sharded' (or None) with mesh=")
+        return "sharded"
+    if engine == "sharded":
+        raise ValueError("engine='sharded' requires mesh=")
+    if engine not in (None, "fused", "banded"):
+        raise ValueError(
+            f"engine must be None, 'fused', 'banded' or 'sharded', "
+            f"got {engine!r}")
+    if engine in (None, "fused") and PB.usable(n) and is_f32:
+        return "fused"
+    # compiled_fused's own fallback: f64 planes and sub-kernel-tier
+    # registers ride the banded XLA program
+    return "banded"
+
+
+def _build_steps(circuit, n: int, density: bool, engine: str,
+                 interpret: bool, mesh) -> Tuple[List, dict]:
+    """(steps, info) for one engine's durable plan: `steps` is the list
+    of independently-jitted per-launch programs (cached on the circuit,
+    so a resume in a warm process retraces NOTHING — the zero-retrace
+    pin), `info` the plan fingerprint the cursor validates against.
+    Cuts reuse the engines' own planners — pallas_band.segment_plan /
+    sweep_plan for the fused engine, fusion.plan items for banded and
+    sharded — so a cut can never land mid-kernel."""
+    from quest_tpu.circuit import (_apply_banded_items, _engine_mode_key,
+                                   _xla_part_applier)
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+
+    key = ("durable", engine, n, density, interpret,
+           mesh if mesh is not None else None, _engine_mode_key())
+    cached = circuit._compiled.get(key)
+    if cached is not None:
+        return cached
+
+    perm_ops = None
+    devices = 1
+    if engine == "fused":
+        flat = circuit._planned_flat(n, density)
+        items = F.plan(flat, n, bands=PB.plan_bands(n))
+        parts = PB.maybe_sweep(PB.segment_plan(items, n), n)
+        seg_cache: dict = {}
+        steps = []
+        for part in parts:
+            if part[0] == "segment":
+                seg = PB.compile_segment_cached(
+                    seg_cache, tuple(part[1]), n, interpret=interpret)
+                fn = (lambda a, seg=seg, arrays=part[2]: seg(a, arrays))
+            else:
+                fn = _xla_part_applier(part, n)
+            steps.append(jax.jit(fn))
+        layout = "fused"
+    elif engine == "banded":
+        items = F.plan(circuit._planned_flat(n, density), n)
+        steps = [jax.jit(lambda a, it=it: _apply_banded_items(a, n, (it,)))
+                 for it in items]
+        layout = "flat"
+    else:                                   # sharded
+        import math
+        from quest_tpu.parallel import sharded as S
+        devices = int(mesh.devices.size)
+        local_n = n - int(math.log2(devices))
+        bands = S._shard_bands(n, local_n)
+        cinfo: dict = {}
+        flat_r = S.engine_flat(circuit.ops, n, density, local_n,
+                               bands=bands, comm_info=cinfo)
+        items = cinfo.get("items")
+        if items is None:
+            items = F.plan(flat_r, n, bands=bands)
+        steps = [S.compile_plan_items_sharded((it,), n, mesh)
+                 for it in items]
+        layout = "sharded"
+        # the relabel-permutation trajectory at every cut: perm_ops[k]
+        # is the GateOp stream behind items[:k] that replay_perm
+        # fingerprints (band-composed ops expose no op; relabel events
+        # and explicit SWAPs do — see relabel.replay_perm)
+        perm_ops = []
+        acc: list = []
+        for it in items:
+            op = getattr(it, "op", None)
+            perm_ops.append(tuple(acc))
+            if op is not None:
+                acc.append(op)
+        perm_ops.append(tuple(acc))
+
+    info = {
+        "engine": engine,
+        "n": n,
+        "density": density,
+        "num_steps": len(steps),
+        "mode_key": repr(_engine_mode_key()),
+        "circuit_ops": len(circuit.ops),
+        "layout": layout,
+        "devices": devices,
+        "mesh": mesh,
+        "perm_ops": perm_ops,
+    }
+    circuit._compiled[key] = (steps, info)
+    return steps, info
+
+
+def _cut_perm(info: dict, step: int) -> Optional[List[int]]:
+    """The relabel `_PermTracker` permutation at cut `step` (sharded
+    engine only): which logical qubit sits at which physical position
+    when the first `step` plan items have executed."""
+    if info["engine"] != "sharded":
+        return None
+    import math
+    from quest_tpu.parallel import relabel as R
+    local_n = info["n"] - int(math.log2(info["devices"]))
+    return R.replay_perm(info["perm_ops"][step], info["n"], local_n)
+
+
+# ---------------------------------------------------------------------------
+# layouts: each engine's native amplitude view <-> the (2, 2^n) planes
+# ---------------------------------------------------------------------------
+
+
+def _to_layout(amps, info: dict):
+    from quest_tpu.ops import pallas_band as PB
+    if info["layout"] == "fused":
+        return jnp.asarray(amps).reshape(2, -1, PB.LANES)
+    if info["layout"] == "sharded":
+        from quest_tpu.parallel.mesh import amp_sharding
+        return jax.device_put(jnp.asarray(amps).reshape(2, -1),
+                              amp_sharding(info["mesh"]))
+    return jnp.asarray(amps).reshape(2, -1)
+
+
+def _from_layout(amps, info: dict):
+    return amps.reshape(2, -1)
+
+
+# ---------------------------------------------------------------------------
+# corruption sentinels: cheap on-device invariants at checkpoint cadence
+# ---------------------------------------------------------------------------
+
+_SENTINEL_FNS: dict = {}
+
+
+def _sentinel_values(amps, info: dict) -> dict:
+    """The state's cheap integrity invariants, as host floats: one
+    reduction pass for a statevector (norm), trace + hermiticity
+    residual for a density register — orders cheaper than a sweep, and
+    NaN anywhere fails every comparison (NaN <= tol is False)."""
+    density = info["density"]
+    key = ("dm" if density else "sv", info["n"], amps.shape,
+           str(amps.dtype))
+    fn = _SENTINEL_FNS.get(key)
+    if fn is None:
+        if density:
+            nq = info["n"] // 2          # rho is 2^nq x 2^nq
+
+            def f(a):
+                v = a.reshape(2, 1 << nq, 1 << nq)
+                # flat index r + c*2^nq => v[plane, c, r]
+                tr_re = jnp.trace(v[0])
+                tr_im = jnp.trace(v[1])
+                herm = jnp.maximum(
+                    jnp.max(jnp.abs(v[0] - v[0].T)),
+                    jnp.max(jnp.abs(v[1] + v[1].T)))
+                return tr_re, tr_im, herm
+        else:
+            def f(a):
+                return (jnp.sum(a.astype(jnp.float32) ** 2),)
+        fn = _SENTINEL_FNS[key] = jax.jit(f)
+    vals = [float(v) for v in fn(amps)]
+    if density:
+        return {"trace_re": vals[0], "trace_im": vals[1],
+                "herm_residual": vals[2]}
+    return {"norm": vals[0]}
+
+
+def _check_integrity(vals: dict, baseline: dict, tol: float,
+                     step) -> None:
+    for name, got in vals.items():
+        ref = float(baseline.get(name, 0.0))
+        # relative drift with a floor of 1: registers need not be
+        # normalized (init_debug_state is not), so the budget scales
+        # with the invariant's own magnitude and becomes absolute for
+        # unit-scale invariants (norm/trace of normalized states)
+        drift = abs(got - ref) / max(1.0, abs(ref))
+        if not (drift <= tol):           # NaN-safe: NaN fails the <=
+            _counter("durable_sentinel_trips").inc()
+            raise IntegrityError(
+                f"Integrity sentinel tripped at step {step}: {name} = "
+                f"{got!r}, baseline {ref!r}, drift beyond the "
+                f"QUEST_INTEGRITY_TOL budget {tol} — the state is "
+                f"corrupt (NaN poisoning or a bad plane); REFUSING to "
+                f"stamp a checkpoint from it (docs/RESILIENCE.md "
+                f"§durable)")
+
+
+# ---------------------------------------------------------------------------
+# cursor + resume chain
+# ---------------------------------------------------------------------------
+
+
+def _validate_cursor(cursor: dict, want: dict, path: str) -> None:
+    """Every field of the re-derived plan must match the checkpointed
+    cursor — resuming across a drifted plan would run the wrong program
+    suffix over the cut amplitudes. Raises DurableError naming the
+    first mismatching field."""
+    for field, expect in want.items():
+        got = cursor.get(field)
+        if got != expect:
+            raise DurableError(
+                f"Invalid durable resume: checkpoint {path!r} was cut "
+                f"under {field}={got!r}, but this process would execute "
+                f"{field}={expect!r} — a keyed knob flip or circuit "
+                f"change between save and resume; finish the run under "
+                f"the original configuration (or clear the checkpoint "
+                f"directory to restart from op 0)")
+
+
+def _latest_valid(directory: str, kind: str):
+    """Newest checkpoint under `directory` that loads AND digests
+    cleanly, scanning newest -> oldest: corrupt or unreadable entries
+    are skipped LOUDLY (stderr + counter) in favor of older ones —
+    never silently consumed. Returns (meta, arrays, cursor, path) or
+    None when no valid checkpoint exists (the run restarts from op
+    0)."""
+    for step, path in reversed(ckpt.step_dirs(directory)):
+        try:
+            meta, arrays = ckpt.load_arrays(path, require=("planes",))
+            cursor = meta.get("extra")
+            if not isinstance(cursor, dict) or cursor.get("kind") != kind:
+                raise ckpt.CheckpointError(
+                    f"Invalid checkpoint: {path!r} carries no "
+                    f"{kind!r} durable cursor")
+            # belt to the meta self-digest's suspenders: the cursor's
+            # cut index must agree with the committed directory name (a
+            # save-side bug writing the wrong step would pass digests)
+            cut = cursor.get("step", cursor.get("shots_done"))
+            if int(cut) != step:
+                raise ckpt.CheckpointError(
+                    f"Invalid checkpoint: {path!r} carries cursor cut "
+                    f"{cut!r}, directory name says {step}")
+        except (ckpt.CheckpointError, OSError,
+                faults.InjectedFault) as e:
+            # InjectedFault: the checkpoint.load site's default error —
+            # its documented contract is that the resume chain SKIPS to
+            # an older checkpoint, so the injected failure must prove
+            # the fallback, not take the run down
+            _counter("durable_corrupt_checkpoints_skipped").inc()
+            print(f"[durable] SKIPPING corrupt checkpoint {path!r} "
+                  f"({e}); falling back to the previous one",
+                  file=sys.stderr, flush=True)
+            continue
+        return meta, arrays, cursor, path
+    return None
+
+
+def _clear_chain(directory: str) -> None:
+    """A COMPLETED run consumes its resume chain: the checkpoints exist
+    to finish this run, and leaving them would make a later run over
+    the same directory resume mid-circuit with a different initial
+    state."""
+    import shutil
+    for _, path in ckpt.step_dirs(directory):
+        shutil.rmtree(path, ignore_errors=True)
+    ckpt.sweep_stale(directory)
+
+
+# ---------------------------------------------------------------------------
+# the durable executor: state engines
+# ---------------------------------------------------------------------------
+
+
+def run_durable(circuit, state: Qureg, directory: str, *,
+                every: int = None, engine: str = None, mesh=None,
+                interpret: bool = False, keep: int = None) -> Qureg:
+    """Apply `circuit` to `state` durably: execute the engine's own
+    launch plan step by step, checkpoint planes + cursor every `every`
+    steps (default QUEST_DURABLE_EVERY) under `directory`, and — when a
+    valid checkpoint already exists there — RESUME from it instead of
+    op 0. The final register is bit-identical to an uninterrupted run
+    whatever mix of preemptions, mid-save crashes and on-disk
+    corruption happened in between, because interrupted and
+    uninterrupted runs execute the identical per-step program sequence
+    and a corrupt checkpoint is never consumed (tests/test_durable.py;
+    docs/RESILIENCE.md §durable).
+
+    engine: None auto-resolves like apply_fused (Pallas kernels on the
+    kernel tier at f32, banded XLA otherwise); 'fused' / 'banded' pin
+    it; mesh= selects the sharded banded engine (its relabel
+    permutation rides the cursor and is re-verified at resume). Noise
+    channels run through the density engines as usual; for trajectory
+    unraveling use run_durable_trajectories. Integrity sentinels run at
+    checkpoint cadence (QUEST_INTEGRITY / QUEST_INTEGRITY_TOL); a
+    completed run removes its own checkpoint chain."""
+    from quest_tpu.env import knob_value
+
+    if circuit.num_qubits != state.num_qubits:
+        raise ValueError("circuit/register size mismatch")
+    circuit._reject_measure("run_durable")
+    n = state.num_state_qubits
+    density = state.is_density
+    every = int(every) if every is not None else knob_value(
+        "QUEST_DURABLE_EVERY")
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    is_f32 = state.real_dtype == np.dtype(np.float32)
+    engine = _resolve_state_engine(engine, n, is_f32, mesh)
+    steps, info = _build_steps(circuit, n, density, engine, interpret,
+                               mesh)
+    integrity = knob_value("QUEST_INTEGRITY")
+    tol = knob_value("QUEST_INTEGRITY_TOL")
+
+    want = {
+        "engine": engine,
+        # interpret-mode kernels round differently from compiled ones,
+        # and a different mesh width changes the shard layout: both
+        # must match the save-side plan exactly, like every other field
+        "interpret": bool(interpret),
+        "devices": info["devices"],
+        "num_steps": info["num_steps"],
+        "mode_key": info["mode_key"],
+        "circuit_ops": info["circuit_ops"],
+        "plan_sha": _ops_sha(circuit.ops),
+        "state_fp": _state_fingerprint(state),
+    }
+    start, baseline = 0, None
+    found = _latest_valid(directory, "state")
+    if found is not None:
+        meta, arrays, cursor, path = found
+        _validate_cursor(cursor, want, path)
+        step = int(cursor["step"])
+        _validate_cursor(cursor, {"perm": _cut_perm(info, step)}, path)
+        planes = arrays["planes"]
+        if planes.shape != state.amps.shape:
+            raise DurableError(
+                f"Invalid durable resume: checkpoint {path!r} holds "
+                f"planes of shape {tuple(planes.shape)}, register "
+                f"expects {tuple(state.amps.shape)}")
+        amps = _to_layout(planes.astype(state.real_dtype), info)
+        start = step
+        baseline = cursor.get("baseline")
+        _counter("durable_resumes").inc()
+    else:
+        amps = _to_layout(state.amps, info)
+    if baseline is None and integrity:
+        baseline = _sentinel_values(amps, info)
+
+    for i in range(start, len(steps)):
+        if faults.ACTIVE:
+            faults.check("durable.step", step=i, engine=engine)
+            faults.check("durable.preempt", step=i, engine=engine)
+        amps = steps[i](amps)
+        _counter("durable_steps_run").inc()
+        done = i + 1
+        if done % every == 0 and done < len(steps):
+            # drain the async step queue BEFORE the checkpoint timer:
+            # the first sync point would otherwise absorb the pending
+            # steps' compute into the measured checkpoint cost
+            from quest_tpu.env import sync_array
+            sync_array(amps)
+            t0 = _time.perf_counter()
+            if integrity:
+                _check_integrity(_sentinel_values(amps, info), baseline,
+                                 tol, done)
+            cursor = dict(want, kind="state", step=done,
+                          perm=_cut_perm(info, done), baseline=baseline)
+            ckpt.save_step(directory, done,
+                           qureg=state.replace_amps(
+                               _from_layout(amps, info)),
+                           extra=cursor, keep=keep)
+            _counter("durable_checkpoints_saved").inc()
+            _metrics.REGISTRY.gauge("durable_last_checkpoint_step").set(
+                done)
+            # per-cut cost (sentinel + host gather + atomic write):
+            # bench.py's durable scenario derives its overhead fraction
+            # from this histogram — one instrumented run instead of a
+            # noisy wall-clock A/B difference
+            _metrics.REGISTRY.histogram("durable_checkpoint_s").observe(
+                _time.perf_counter() - t0)
+    if integrity:
+        # the run's exit gate: a durable run must never RETURN a
+        # corrupt state silently either — same sentinel, same budget
+        _check_integrity(_sentinel_values(amps, info), baseline, tol,
+                         "final")
+    out = state.replace_amps(_from_layout(amps, info))
+    _clear_chain(directory)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the durable executor: trajectory engine
+# ---------------------------------------------------------------------------
+
+
+def _key_fingerprint(key) -> str:
+    try:
+        data = jax.random.key_data(key)
+    except Exception:
+        data = key
+    return hashlib.sha256(
+        np.ascontiguousarray(jax.device_get(data)).tobytes()
+    ).hexdigest()[:32]
+
+
+def run_durable_trajectories(circuit, key, shots: int, directory: str, *,
+                             every: int = None, chunk: int = None,
+                             engine: str = None, interpret: bool = False,
+                             keep: int = None):
+    """Durable counterpart of trajectories.run_batched: run `shots`
+    stochastic trajectories of a noisy Circuit in the SAME bucket-sized
+    chunks run_batched would dispatch (trajectories._bucket_for), and
+    checkpoint the accumulated (shots_done, 2, 2^n) planes + (shots_done,
+    C) draws plus a cursor every `every` chunks. The cursor carries the
+    root key's fingerprint, so a resumed run provably continues the
+    exact `split(key, shots)` chain — completed shots load from the
+    checkpoint, remaining shots re-dispatch from their own keys, and
+    the result is bit-identical to an uninterrupted run (and to
+    run_batched at the same chunking). Per-shot norm sentinels run at
+    checkpoint cadence (every trajectory is a normalized statevector by
+    construction). Returns (planes, draws) exactly like run_batched;
+    `observable=` reductions are deliberately unsupported here — the
+    planes ARE the resume payload.
+
+    COST NOTE: each checkpoint stores the FULL accumulated payload
+    (delta-chained checkpoints would break keep-last-K retention — the
+    corrupt-skip fallback needs every surviving checkpoint to be
+    self-contained), so total checkpoint bytes grow quadratically in
+    shot count at fixed cadence. Size `every` to the failure rate, not
+    the chunk count; shot counts whose planes don't comfortably fit in
+    host memory should reduce with run_batched(observable=) instead of
+    running durably."""
+    from quest_tpu import trajectories as T
+    from quest_tpu.circuit import _engine_mode_key
+    from quest_tpu.env import knob_value
+
+    n = circuit.num_qubits
+    shots = int(shots)
+    if shots < 1:
+        raise ValueError(f"shots must be >= 1, got {shots}")
+    every = int(every) if every is not None else knob_value(
+        "QUEST_DURABLE_EVERY")
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    integrity = knob_value("QUEST_INTEGRITY")
+    tol = knob_value("QUEST_INTEGRITY_TOL")
+    engine = T._resolve_engine(engine, n, interpret)
+    bucket = T._bucket_for(shots, chunk)
+    fn = T._compiled_traj(circuit, n, bucket, engine, interpret)
+    keys = jax.random.split(key, shots)
+    want = {
+        "engine": engine,
+        "interpret": bool(interpret),
+        "bucket": bucket,
+        "shots": shots,
+        "mode_key": repr(_engine_mode_key()),
+        "circuit_ops": len(circuit.ops),
+        "plan_sha": _ops_sha(circuit.ops),
+        "key_fp": _key_fingerprint(key),
+    }
+
+    planes_acc: list = []
+    draws_acc: list = []
+    shots_done = 0
+    found = _latest_valid(directory, "traj")
+    if found is not None:
+        meta, arrays, cursor, path = found
+        _validate_cursor(cursor, want, path)
+        shots_done = int(cursor["shots_done"])
+        planes_acc.append(np.asarray(arrays["planes"]))
+        draws_acc.append(np.asarray(arrays["draws"]))
+        _counter("durable_resumes").inc()
+
+    chunks_done = 0
+    for lo in range(shots_done, shots, bucket):
+        if faults.ACTIVE:
+            faults.check("durable.step", shot=lo, engine=engine)
+            faults.check("durable.preempt", shot=lo, engine=engine)
+        # the SAME chunk dispatch (slice/pad/unpad) run_batched uses —
+        # the bit-identity pin depends on the loops never diverging
+        planes, draws = T._dispatch_chunk(fn, keys, lo, bucket)
+        planes_acc.append(np.asarray(planes))
+        draws_acc.append(np.asarray(draws))
+        _counter("durable_steps_run").inc()
+        shots_done = min(lo + bucket, shots)
+        chunks_done += 1
+        if chunks_done % every == 0 and shots_done < shots:
+            t0 = _time.perf_counter()
+            all_planes = np.concatenate(planes_acc, axis=0)
+            all_draws = np.concatenate(draws_acc, axis=0)
+            planes_acc, draws_acc = [all_planes], [all_draws]
+            if integrity:
+                norms = np.sum(all_planes.astype(np.float32) ** 2,
+                               axis=(1, 2))
+                worst = int(np.argmax(np.abs(norms - 1.0)))
+                _check_integrity(
+                    {"norm": float(norms[worst])}, {"norm": 1.0}, tol,
+                    f"shot {worst} (of {shots_done} done)")
+            cursor = dict(want, kind="traj", shots_done=shots_done)
+            ckpt.save_step(directory, shots_done,
+                           arrays={"planes": all_planes,
+                                   "draws": all_draws},
+                           extra=cursor, keep=keep)
+            _counter("durable_checkpoints_saved").inc()
+            _metrics.REGISTRY.gauge("durable_last_checkpoint_step").set(
+                shots_done)
+            _metrics.REGISTRY.histogram("durable_checkpoint_s").observe(
+                _time.perf_counter() - t0)
+    planes = (planes_acc[0] if len(planes_acc) == 1
+              else np.concatenate(planes_acc, axis=0))
+    draws = (draws_acc[0] if len(draws_acc) == 1
+             else np.concatenate(draws_acc, axis=0))
+    if integrity:
+        # exit gate: every trajectory is a normalized statevector by
+        # construction — a NaN'd or drifted shot must fail loudly
+        norms = np.sum(planes.astype(np.float32) ** 2, axis=(1, 2))
+        worst = int(np.argmax(np.abs(norms - 1.0)))
+        _check_integrity({"norm": float(norms[worst])}, {"norm": 1.0},
+                         tol, f"final (shot {worst})")
+    _clear_chain(directory)
+    return jnp.asarray(planes), jnp.asarray(draws)

@@ -63,6 +63,20 @@ SITES = (
     "serve.demux",          # per-request result demux (ctx carries the
                             # single request)
     "sharded.dispatch",     # apply_circuit_sharded's mesh dispatch
+    "checkpoint.save",      # checkpoint commit point (temp files
+                            # written, rename pending) — an injected
+                            # error emulates a crash MID-SAVE; the
+                            # previous checkpoint must stay loadable
+    "checkpoint.load",      # checkpoint read path (load/load_arrays) —
+                            # emulates IO failures; the durable resume
+                            # chain must skip to an older checkpoint
+    "durable.step",         # durable executor, before each sweep-plan
+                            # step (ctx carries the step index)
+    "durable.preempt",      # the durable KILL site: same cut points as
+                            # durable.step, reserved for preemption
+                            # plans so soaks can kill a run at seeded
+                            # boundaries without disturbing step-fault
+                            # rules (docs/RESILIENCE.md §durable)
 )
 
 

@@ -22,6 +22,15 @@ Gauges are the settable point-in-time values the resilience layer needs
 (`serve_breakers_open`: how many program breakers are open RIGHT NOW —
 a counter can only ever grow, docs/RESILIENCE.md).
 
+The durable executor (quest_tpu/resilience/durable.py) records here
+too: counters `durable_steps_run`, `durable_checkpoints_saved`,
+`durable_resumes`, `durable_corrupt_checkpoints_skipped`,
+`durable_sentinel_trips`; gauge `durable_last_checkpoint_step`;
+histogram `durable_checkpoint_s` (per-cut sentinel+gather+write cost —
+the overhead numerator of `bench.py durable`) — a soak's health line
+is "corrupt_skipped and sentinel_trips both zero"
+(docs/RESILIENCE.md §durable).
+
 Histograms keep a bounded reservoir (the most recent `RESERVOIR`
 observations) plus exact count/sum: percentiles are over the recent
 window — the figure a serving dashboard wants — while count/mean stay
@@ -110,6 +119,14 @@ class Histogram:
     @property
     def count(self) -> int:
         return self._count
+
+    @property
+    def sum(self) -> float:
+        """Exact lifetime sum of observations (like `count`): delta
+        reads over (count, sum) let a caller derive time-in-phase
+        without touching slot internals — bench.py's durable overhead
+        fraction reads `durable_checkpoint_s` this way."""
+        return self._sum
 
     def summary(self) -> Dict[str, float]:
         with self._lock:

@@ -767,6 +767,43 @@ def _compiled_traj(circuit, n: int, bucket: int, engine: str,
     return fn
 
 
+def _bucket_for(shots: int, chunk: int = None) -> int:
+    """The compiled bucket size a `shots`-trajectory run dispatches
+    (docs/BATCHING.md): chunk=None caps the implicit whole-run bucket at
+    the largest bucket <= shots (257 shots = one 256-chunk + a padded
+    remainder, not a 512-state launch doubling peak memory); an explicit
+    chunk buckets itself. The ONE home of this rule — run_batched,
+    plan_stats and the durable trajectory executor
+    (resilience/durable.py) all chunk through it, so an interrupted and
+    an uninterrupted run dispatch the identical program sequence."""
+    from quest_tpu.env import batch_bucket
+    per_call = shots if chunk is None else max(1, min(int(chunk), shots))
+    bucket = batch_bucket(per_call)
+    if chunk is None and bucket > shots:
+        smaller = batch_bucket(max(1, bucket // 2))
+        if smaller < bucket:
+            bucket = smaller
+    return bucket
+
+
+def _dispatch_chunk(fn, keys, lo: int, bucket: int):
+    """One bucket-sized dispatch of shots [lo, lo+bucket): slice the
+    key chain, pad the tail chunk by re-running key 0 of the chunk
+    (broadcast — sliced off after), launch, unpad. The ONE home of the
+    pad rule, shared by run_batched and the durable trajectory
+    executor (resilience/durable.py) — their bit-identity pin depends
+    on the two dispatch loops staying byte-equivalent."""
+    kb = keys[lo:lo + bucket]
+    pad = bucket - kb.shape[0]
+    if pad:
+        kb = jnp.concatenate(
+            [kb, jnp.broadcast_to(kb[:1], (pad,) + kb.shape[1:])])
+    planes, draws = fn(kb)
+    if pad:
+        planes, draws = planes[:-pad], draws[:-pad]
+    return planes, draws
+
+
 def program_key(circuit, engine: str = None, interpret: bool = False):
     """(resolved engine name, hashable PROGRAM IDENTITY) of the batched
     trajectory program family `run_batched` would execute for this
@@ -831,38 +868,27 @@ def run_batched(circuit, key, shots: int, *, engine: str = None,
     (values (shots, ...), draws) and no chunk's states outlive its
     reduction — 256 shots at 24 qubits would otherwise materialize
     32 GiB of output planes."""
-    from quest_tpu.env import batch_bucket
-
     n = circuit.num_qubits
     shots = int(shots)
     if shots < 1:
         raise ValueError(f"shots must be >= 1, got {shots}")
     engine = _resolve_engine(engine, n, interpret)
-    per_call = shots if chunk is None else max(1, min(int(chunk), shots))
-    bucket = batch_bucket(per_call)
-    if chunk is None and bucket > shots:
-        # the implicit whole-run bucket would round B up to the next
-        # power of two (257 shots -> 512 live full states: ~2x the
-        # peak memory and a bigger program than the run needs); cap at
-        # the largest bucket that fits and let the LAST chunk pad
-        smaller = batch_bucket(max(1, bucket // 2))
-        if smaller < bucket:
-            bucket = smaller
+    bucket = _bucket_for(shots, chunk)
     fn = _compiled_traj(circuit, n, bucket, engine, interpret)
 
     keys = jax.random.split(key, shots)
+    dispatch = fn
+    if observable is not None:
+        # reduce the padded bucket BEFORE the unpad slice: the
+        # constant-bucket-shaped reduction is the memory contract (no
+        # full planes leave the device), so the observable wraps fn
+        # rather than riding _dispatch_chunk's sliced output
+        def dispatch(kb, fn=fn):
+            planes, draws = fn(kb)
+            return observable(planes), draws
     planes_out, draws_out = [], []
     for lo in range(0, shots, bucket):
-        kb = keys[lo:lo + bucket]
-        pad = bucket - kb.shape[0]
-        if pad:
-            kb = jnp.concatenate(
-                [kb, jnp.broadcast_to(kb[:1], (pad,) + kb.shape[1:])])
-        planes, draws = fn(kb)
-        if observable is not None:
-            planes = observable(planes)
-        if pad:
-            planes, draws = planes[:-pad], draws[:-pad]
+        planes, draws = _dispatch_chunk(dispatch, keys, lo, bucket)
         planes_out.append(planes)
         draws_out.append(draws)
     if len(planes_out) == 1:
@@ -878,15 +904,10 @@ def plan_stats(circuit, shots: int) -> dict:
     point (`hbm_sweeps` here equals the shots=1 plan's; the golden gate
     is scripts/check_batch_golden.py) — plus the channel mix (inlined
     BatchSelStage channels vs XLA-applied ones)."""
-    from quest_tpu.env import batch_bucket
     from quest_tpu.ops import pallas_band as PB
 
     n = circuit.num_qubits
-    bucket = batch_bucket(shots)
-    if bucket > shots:           # mirror run_batched's chunk=None cap
-        smaller = batch_bucket(max(1, bucket // 2))
-        if smaller < bucket:
-            bucket = smaller
+    bucket = _bucket_for(shots)   # run_batched's chunk=None cap rule
     use_kernels = PB.usable(n)
     items, channels = _traj_channels_and_items(circuit, n, use_kernels)
     if use_kernels:

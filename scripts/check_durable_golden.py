@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""CI gate for the durable execution runtime (docs/RESILIENCE.md
+§durable): fails if
+
+  * a preempted-at-a-boundary durable run does NOT resume to the exact
+    uninterrupted amplitudes (sha256 over the final planes — the resume
+    contract is BIT identity, no tolerance), or
+  * checkpoint overhead exceeds 10% of the sweep time, measured from
+    the executor's own `durable_checkpoint_s` histogram over the
+    `bench.py durable` scenario (per-cut sentinel + host gather +
+    atomic write vs the same run's step time — one instrumented run,
+    not a wall-clock A/B difference).
+
+The committed budget lives HERE (the CI gate); the bit-identity pins
+per engine live in tests/test_durable.py — a change that moves either
+must update both, consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+OVERHEAD_BUDGET = 0.10     # fraction of sweep time (measured ~0.03-0.05
+                           # on the CI host at every=64: 2.5x margin)
+
+
+def main() -> int:
+    import bench
+
+    rec = bench._measure_durable()
+    print(json.dumps(rec))
+    ok = True
+    if not rec["durable_preempted"]:
+        print("GATE BROKEN: the seeded durable.preempt plan never "
+              "fired — the scenario no longer exercises resume",
+              file=sys.stderr)
+        ok = False
+    if not rec["durable_resumed_from_checkpoint"]:
+        print("GATE BROKEN: the kill landed before the first stamp — "
+              "the 'resume' leg restarted from op 0 and verified "
+              "nothing about checkpoint restore", file=sys.stderr)
+        ok = False
+    if not rec["durable_resume_bitexact"]:
+        print("REGRESSION: preempted+resumed durable run is NOT "
+              "bit-identical to the uninterrupted run",
+              file=sys.stderr)
+        ok = False
+    if rec["durable_checkpoints"] < 1:
+        print("GATE BROKEN: the scenario stamped no checkpoints — "
+              "nothing was measured", file=sys.stderr)
+        ok = False
+    if rec["durable_overhead_frac"] > OVERHEAD_BUDGET:
+        print(f"REGRESSION: durable checkpoint overhead "
+              f"{rec['durable_overhead_frac']:.3f} > budget "
+              f"{OVERHEAD_BUDGET} of sweep time", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -117,7 +117,11 @@ def test_checkpoint_save_stamps_magic_and_version(tmp_path, rng):
     with open(os.path.join(d, "qureg_meta.json")) as f:
         meta = json.load(f)
     assert meta["magic"] == "quest-checkpoint"
-    assert meta["format_version"] == 2
+    # format 3: per-plane digests stamped at save, verified at load
+    assert meta["format_version"] == 3
+    assert sorted(meta["plane_digests"]) == ["planes[im]", "planes[re]"]
+    for v in meta["plane_digests"].values():
+        assert len(v) == 64 and int(v, 16) >= 0   # sha256 hex
 
 
 def test_checkpoint_truncated_npz_raises_checkpoint_error(tmp_path, rng):
@@ -151,6 +155,10 @@ def test_checkpoint_wrong_register_size_names_the_mismatch(tmp_path, rng):
     with open(meta_path) as f:
         meta = json.load(f)
     meta["num_qubits"] = 4                  # lies about the planes
+    # re-stamp the self-digest: this test emulates HONESTLY-wrong
+    # metadata (a save-side bug), not tampering — tampering is caught
+    # earlier by the meta self-digest (its own test below)
+    meta["meta_digest"] = ckpt._meta_digest(meta)
     with open(meta_path, "w") as f:
         json.dump(meta, f)
     with pytest.raises(ckpt.CheckpointError) as ei:
@@ -212,8 +220,12 @@ def test_checkpoint_pre_field_meta_loads_tolerantly(tmp_path, rng):
         meta = json.load(f)
     del meta["magic"]
     meta["format_version"] = 1
+    # a real format-1 checkpoint predates every integrity field
+    for k in ("plane_digests", "meta_digest"):
+        meta.pop(k, None)
     with open(meta_path, "w") as f:
         json.dump(meta, f)
+    ckpt._legacy_warned = False
     q2 = ckpt.load(d)
     np.testing.assert_array_equal(to_dense(q2), to_dense(q))
 
@@ -239,3 +251,229 @@ def test_sharded_checkpoint_corruption_raises_checkpoint_error(tmp_path,
 def test_checkpoint_error_is_a_quest_error(tmp_path):
     from quest_tpu.validation import QuESTError
     assert issubclass(ckpt.CheckpointError, QuESTError)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10 satellites: format-3 per-plane digests, atomic saves,
+# versioned step checkpoints with keep-last-K retention
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_digest_failure_names_the_plane(tmp_path, rng):
+    """Silent bit rot that keeps the npz WELL-FORMED (the zip CRC can't
+    see it) must fail the per-plane digest and name WHICH plane rotted,
+    with expected/got digests in the message."""
+    import os
+    d, _ = _saved(tmp_path, rng)
+    f = os.path.join(d, "amps.npz")
+    with np.load(f) as z:
+        pristine = {k: z[k].copy() for k in z.files}
+    rotted = {k: v.copy() for k, v in pristine.items()}
+    rotted["planes"][1, 2] += 1.0            # rot one imag amplitude
+    np.savez(f, **rotted)
+    with pytest.raises(ckpt.CheckpointError) as ei:
+        ckpt.load(d)
+    msg = str(ei.value)
+    assert "planes[im]" in msg
+    assert "expected sha256" in msg and "got" in msg
+    # the real plane stays clean: rot it instead and the name flips
+    rotted = {k: v.copy() for k, v in pristine.items()}
+    rotted["planes"][0, 0] += 1.0
+    np.savez(f, **rotted)
+    with pytest.raises(ckpt.CheckpointError, match=r"planes\[re\]"):
+        ckpt.load(d)
+
+
+def test_checkpoint_v2_loads_tolerantly_with_one_warning(tmp_path, rng,
+                                                         capsys):
+    """A format-2 checkpoint (magic+version, no digests — written by
+    the previous release) still loads bit-exactly; the degrade warns
+    ONCE on stderr (the native.py pattern), not per load."""
+    import json
+    import os
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    d = str(tmp_path / "v2")
+    ckpt.save(q, d)
+    meta_path = os.path.join(d, "qureg_meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["plane_digests"]
+    del meta["meta_digest"]        # v2 predates both integrity fields
+    meta["format_version"] = 2
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    ckpt._legacy_warned = False
+    q2 = ckpt.load(d)
+    np.testing.assert_array_equal(to_dense(q2), to_dense(q))
+    first = capsys.readouterr().err
+    assert "format_version 2" in first and "no per-plane checksums" in first
+    ckpt.load(d)
+    assert "format_version" not in capsys.readouterr().err  # warned once
+
+
+def test_v3_meta_with_stripped_digests_refuses_to_load(tmp_path, rng):
+    """A format-3 checkpoint whose integrity fields were stripped or
+    altered is tampered/corrupt, not 'old and tolerable': loading it
+    unverified would silently void the integrity guarantee. Covers all
+    three strip/tamper shapes."""
+    import json
+    import os
+    d, _ = _saved(tmp_path, rng)
+    meta_path = os.path.join(d, "qureg_meta.json")
+    good = open(meta_path).read()
+    # (1) any field edit without re-stamping fails the meta self-digest
+    meta = json.loads(good)
+    del meta["plane_digests"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="self-digest"):
+        ckpt.load(d)
+    # (2) both integrity fields stripped from a v3 meta
+    meta = json.loads(good)
+    del meta["plane_digests"]
+    del meta["meta_digest"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="meta_digest"):
+        ckpt.load(d)
+    # (3) plane_digests stripped but self-digest re-stamped
+    meta = json.loads(good)
+    del meta["plane_digests"]
+    del meta["meta_digest"]
+    meta["meta_digest"] = ckpt._meta_digest(meta)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="plane_digests"):
+        ckpt.load(d)
+
+
+def test_tampered_cursor_fails_the_meta_self_digest(tmp_path, rng):
+    """One flipped digit in the durable cursor (valid JSON, valid
+    planes) must refuse to load — a wrong 'step' resumes to silently
+    wrong amplitudes (the code-review reproduction)."""
+    import json
+    import os
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    root = str(tmp_path / "chain")
+    ckpt.save_step(root, 8, qureg=q, extra={"kind": "state", "step": 8})
+    path = ckpt.step_path(root, 8)
+    meta_path = os.path.join(path, "qureg_meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["extra"]["step"] = 7
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointError, match="self-digest"):
+        ckpt.load_arrays(path)
+
+
+def test_checkpoint_save_is_atomic_under_midsave_crash(tmp_path, rng):
+    """The kill-mid-save pin: an error injected at the commit point
+    (the `checkpoint.save` fault site — temp files written, rename
+    pending) leaves the PREVIOUS checkpoint at the same path loadable
+    and bit-identical."""
+    from quest_tpu.resilience import FaultPlan, faults
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    d = str(tmp_path / "ck")
+    ckpt.save(q, d)
+    before = to_dense(ckpt.load(d))
+    q2 = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                              -v.real, -v.imag)
+    plan = FaultPlan().inject("checkpoint.save", times=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            ckpt.save(q2, d)
+    assert plan.fired() == 1
+    np.testing.assert_array_equal(to_dense(ckpt.load(d)), before)
+    # and with the plan gone the overwrite goes through
+    ckpt.save(q2, d)
+    np.testing.assert_array_equal(to_dense(ckpt.load(d)), -before)
+
+
+def test_save_step_keeps_last_k(tmp_path, rng):
+    """Versioned `ckpt-<step>` checkpoints prune to keep-last-K
+    (QUEST_CHECKPOINT_KEEP default 2; explicit keep= wins)."""
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    root = str(tmp_path / "chain")
+    for step in (2, 4, 6):
+        ckpt.save_step(root, step, qureg=q, extra={"step": step})
+    assert [s for s, _ in ckpt.step_dirs(root)] == [4, 6]  # default keep=2
+    ckpt.save_step(root, 8, qureg=q, keep=1)
+    assert [s for s, _ in ckpt.step_dirs(root)] == [8]
+    assert ckpt.read_extra(ckpt.step_path(root, 8)) is None
+    with pytest.raises(ValueError):
+        ckpt.prune_steps(root, keep=0)
+
+
+def test_step_dirs_ignores_uncommitted_temp_dirs(tmp_path, rng):
+    """Leftover temp dirs from a crashed save (and foreign entries)
+    never enter the resume chain — only committed ckpt-<step> names —
+    and the next save's prune SWEEPS the stale leftovers (a
+    preemptible pod kills mid-save repeatedly; without the sweep the
+    root grows by a full payload per kill). Foreign entries survive."""
+    import os
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    root = str(tmp_path / "chain")
+    ckpt.save_step(root, 3, qureg=q)
+    os.makedirs(os.path.join(root, "ckpt-00000009.tmp-123-abc"))
+    os.makedirs(os.path.join(root, "ckpt-00000002.old-99-dead"))
+    os.makedirs(os.path.join(root, "unrelated"))
+    assert [s for s, _ in ckpt.step_dirs(root)] == [3]
+    ckpt.save_step(root, 5, qureg=q)       # prune sweeps the stale dirs
+    left = sorted(os.listdir(root))
+    assert left == ["ckpt-00000003", "ckpt-00000005", "unrelated"]
+
+
+def test_save_refuses_to_replace_a_non_checkpoint_directory(tmp_path,
+                                                            rng):
+    """save() over an existing NON-checkpoint directory must refuse
+    loudly — the atomic swap replaces the whole target, and silently
+    rmtree'ing a directory of unrelated user files would be data
+    loss (the old merge-write behavior tolerated the call)."""
+    import os
+    v = oracle.random_statevector(3, rng)
+    q = init_state_from_amps(qt.create_qureg(3, dtype=np.complex128),
+                             v.real, v.imag)
+    d = str(tmp_path / "work")
+    os.makedirs(d)
+    with open(os.path.join(d, "precious.txt"), "w") as f:
+        f.write("user data")
+    with pytest.raises(ValueError, match="not a checkpoint"):
+        ckpt.save(q, d)
+    assert os.path.exists(os.path.join(d, "precious.txt"))
+    # an existing EMPTY directory is fine (the old API allowed it)
+    d2 = str(tmp_path / "empty")
+    os.makedirs(d2)
+    ckpt.save(q, d2)
+    np.testing.assert_array_equal(to_dense(ckpt.load(d2)), to_dense(q))
+
+
+def test_save_arrays_roundtrip_and_load_rejects(tmp_path):
+    """save_arrays (the durable trajectory payload) digests and
+    round-trips raw named arrays; `load` refuses the payload loudly."""
+    root = str(tmp_path / "arr")
+    planes = np.arange(24, dtype=np.float32).reshape(2, 12)
+    draws = np.arange(6, dtype=np.int32)
+    ckpt.save_arrays(root, {"planes": planes, "draws": draws},
+                     extra={"kind": "traj"})
+    meta, arrays = ckpt.load_arrays(root)
+    assert meta["extra"] == {"kind": "traj"}
+    np.testing.assert_array_equal(arrays["planes"], planes)
+    np.testing.assert_array_equal(arrays["draws"], draws)
+    with pytest.raises(ckpt.CheckpointError, match="arrays"):
+        ckpt.load(root)
+    # names colliding with the per-plane digest grammar would write a
+    # checkpoint _digest_target can never resolve — rejected at save
+    with pytest.raises(ValueError, match="re"):
+        ckpt.save_arrays(str(tmp_path / "bad"),
+                         {"x[re]": np.arange(4.0)})

@@ -1,0 +1,506 @@
+"""Durable execution runtime tests (ISSUE 10, docs/RESILIENCE.md
+§durable): preemption-tolerant resume pinned BIT-IDENTICAL to the
+uninterrupted run on every engine, corrupt checkpoints skipped loudly
+and never consumed, in-flight sentinels refusing to stamp a corrupt
+state, zero-retrace on the warmed resumed path, and the slow-marked
+chaos soak (K seeded preemptions incl. one mid-save)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import quest_tpu as qt
+from quest_tpu import checkpoint as ckpt
+from quest_tpu import trajectories as T
+from quest_tpu.circuit import Circuit, qft_circuit, random_circuit
+from quest_tpu.resilience import (DurableError, FaultPlan, IntegrityError,
+                                  faults, run_durable,
+                                  run_durable_trajectories)
+from quest_tpu.serve import metrics
+from quest_tpu.state import to_dense
+
+from .helpers import max_mesh_devices
+
+
+import bench
+
+
+def scattered_circuit(n, layers, seed=0):
+    """Rotation layers split by random 2q unitaries on scattered qubit
+    pairs: the cross-band unitaries are XLA passthroughs — launch
+    barriers on the fused engine and exchange work on the sharded one —
+    so the durable plan has many genuine cut points (a plain RCS block
+    at this size fuses into ONE launch and cannot exercise resume).
+    THE one builder home is bench._build_durable_circuit, shared with
+    the `bench.py durable` scenario and scripts/check_durable_golden.py
+    so the tests pin the same circuit shape the gate measures."""
+    return bench._build_durable_circuit(n, layers, seed=seed)
+
+
+def preempt(runner, after, times=1):
+    """Run `runner` under a durable.preempt plan firing after `after`
+    step hits; assert the kill actually landed."""
+    plan = FaultPlan().inject("durable.preempt", after_n=after,
+                              times=times)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            runner()
+    assert plan.fired() == times
+    return plan
+
+
+def amps_of(q):
+    return np.asarray(jax.device_get(q.amps))
+
+
+# ---------------------------------------------------------------------------
+# resume bit-identity, per engine
+# ---------------------------------------------------------------------------
+
+
+def test_resume_bit_identity_banded(tmp_path):
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    assert ckpt.step_dirs(d), "preempted run left no checkpoint"
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+    # eps-sanity vs the ordinary engine (per-step jits need not be
+    # bit-equal to the whole-program jit; the durable contract is
+    # durable-vs-durable exactness)
+    np.testing.assert_allclose(to_dense(out), to_dense(c.apply(q0)),
+                               rtol=1e-4, atol=1e-3)
+    # a completed run consumes its resume chain
+    assert ckpt.step_dirs(d) == []
+
+
+@pytest.mark.slow
+def test_resume_bit_identity_fused_interpret(tmp_path):
+    # slow-marked (~20 s: three interpret-mode Pallas executions of a
+    # 25-layer 10q plan — the PR-4 budget discipline); the CI fast-fail
+    # step runs it unfiltered, and tier-1 keeps the banded/sharded/
+    # trajectory resume pins
+    c = scattered_circuit(10, 25, seed=2)
+    q0 = qt.init_debug_state(qt.create_qureg(10))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=1,
+                      engine="fused", interpret=True)
+    # the fused plan cuts at sweep/passthrough launch boundaries
+    from quest_tpu.resilience.durable import _build_steps
+    steps, _ = _build_steps(c, 10, False, "fused", True, None)
+    assert len(steps) >= 3
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=1, engine="fused",
+                                interpret=True), after=1)
+    out = run_durable(c, q0, d, every=1, engine="fused", interpret=True)
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+    np.testing.assert_array_equal(
+        amps_of(out), amps_of(c.apply_fused(q0, interpret=True)))
+
+
+def test_resume_bit_identity_sharded_2dev(tmp_path):
+    from quest_tpu.parallel import make_amp_mesh
+    if max_mesh_devices(2) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = make_amp_mesh(2)
+    c = scattered_circuit(6, 6)
+    q0 = qt.init_debug_state(qt.create_qureg(6))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2, mesh=mesh)
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, mesh=mesh), after=5)
+    dirs = ckpt.step_dirs(d)
+    assert dirs
+    # the cursor carries the relabel _PermTracker permutation at the cut
+    cursor = ckpt.read_extra(dirs[-1][1])
+    assert cursor["engine"] == "sharded"
+    assert isinstance(cursor["perm"], list) and len(cursor["perm"]) == 6
+    out = run_durable(c, q0, d, every=2, mesh=mesh)
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+    np.testing.assert_allclose(
+        to_dense(out), to_dense(c.apply_sharded_banded(q0, mesh)),
+        atol=1e-5, rtol=0)
+
+
+def test_resume_bit_identity_trajectories(tmp_path):
+    c = Circuit(4)
+    for q in range(4):
+        c.h(q)
+        c.depolarising(q, 0.1)
+    c.damping(0, 0.3)
+    key = jax.random.key(7)
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable_trajectories(c, key, 10, d, every=1,
+                                             chunk=4), after=2)
+    assert ckpt.step_dirs(d)
+    planes, draws = run_durable_trajectories(c, key, 10, d, every=1,
+                                             chunk=4)
+    # the resumed run continues the exact split(key, shots) chain: it
+    # matches run_batched at the same chunking shot-for-shot, draws
+    # included
+    rp, rd = T.run_batched(c, key, 10, chunk=4)
+    np.testing.assert_array_equal(np.asarray(planes), np.asarray(rp))
+    np.testing.assert_array_equal(np.asarray(draws), np.asarray(rd))
+    assert ckpt.step_dirs(d) == []
+
+
+def test_trajectory_resume_rejects_a_different_key(tmp_path):
+    c = Circuit(3)
+    for q in range(3):
+        c.h(q)
+        c.dephasing(q, 0.2)
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable_trajectories(
+        c, jax.random.key(1), 8, d, every=1, chunk=2), after=1)
+    with pytest.raises(DurableError, match="key_fp"):
+        run_durable_trajectories(c, jax.random.key(2), 8, d, every=1,
+                                 chunk=2)
+
+
+def test_density_durable_matches_engine(tmp_path):
+    # |0><0| is a VALID density matrix (init_debug_state's ramp is not
+    # hermitian, so the trace+hermiticity sentinel would — correctly —
+    # reject it as a physical state)
+    c = random_circuit(3, 3, seed=1)
+    q0 = qt.create_density_qureg(3)
+    out = run_durable(c, q0, str(tmp_path / "dm"), every=2,
+                      engine="banded")
+    np.testing.assert_allclose(to_dense(out), to_dense(c.apply(q0)),
+                               atol=1e-4, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# corruption: on disk and in flight
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_checkpoint_skipped_loudly_never_consumed(tmp_path,
+                                                          capsys):
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    dirs = ckpt.step_dirs(d)
+    assert len(dirs) == 2, dirs         # keep-last-K default 2
+    # rot the NEWEST checkpoint in place (well-formed npz, wrong bytes)
+    f = os.path.join(dirs[-1][1], "amps.npz")
+    with np.load(f) as z:
+        arrs = {k: z[k].copy() for k in z.files}
+    arrs["planes"][0, 5] += 0.5
+    np.savez(f, **arrs)
+    skipped0 = metrics.REGISTRY.counter(
+        "durable_corrupt_checkpoints_skipped").value
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    err = capsys.readouterr().err
+    assert "SKIPPING corrupt checkpoint" in err
+    assert "fails its integrity digest" in err
+    assert metrics.REGISTRY.counter(
+        "durable_corrupt_checkpoints_skipped").value == skipped0 + 1
+    # resumed from the OLDER valid checkpoint: still bit-identical
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
+def test_tampered_cursor_is_skipped_never_resumed(tmp_path, capsys):
+    """The code-review reproduction: one flipped digit in a
+    checkpoint's cursor ('step' 8 -> 7, valid JSON, valid planes) must
+    be SKIPPED via the meta self-digest — resuming it would replay one
+    unitary step twice, bit-different from the uninterrupted run with
+    no sentinel able to notice (unitaries preserve the norm)."""
+    import json
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=9)
+    dirs = ckpt.step_dirs(d)
+    meta_path = os.path.join(dirs[-1][1], "qureg_meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["extra"]["step"] = meta["extra"]["step"] - 1
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    assert "SKIPPING corrupt checkpoint" in capsys.readouterr().err
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
+def test_every_checkpoint_corrupt_restarts_from_op0(tmp_path, capsys):
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    for _, path in ckpt.step_dirs(d):
+        with open(os.path.join(path, "amps.npz"), "wb") as f:
+            f.write(b"rotten")
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    assert capsys.readouterr().err.count("SKIPPING corrupt") == 2
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
+def test_injected_load_fault_skips_to_older_checkpoint(tmp_path,
+                                                       capsys):
+    """The checkpoint.load fault site's documented contract: an
+    injected read failure (its default InjectedFault included) makes
+    the resume chain SKIP to an older checkpoint — never take the run
+    down (docs/RESILIENCE.md site catalog)."""
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    assert len(ckpt.step_dirs(d)) == 2
+    plan = FaultPlan().inject("checkpoint.load", times=1)
+    with faults.active(plan):
+        out = run_durable(c, q0, d, every=2, engine="banded")
+    assert plan.fired() == 1
+    assert "SKIPPING corrupt checkpoint" in capsys.readouterr().err
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
+def test_sentinel_trips_on_nan_and_refuses_to_stamp(tmp_path):
+    c = qft_circuit(9)
+    # poison an early op: NaN reaches the state before the 2nd cut
+    c.ops.insert(2, c.ops[0].__class__(
+        "matrix", (1,), operand=np.array([[np.nan, 0], [0, 1]])))
+    c._compiled.clear()
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    d = str(tmp_path / "nan")
+    trips0 = metrics.REGISTRY.counter("durable_sentinel_trips").value
+    with pytest.raises(IntegrityError, match="norm"):
+        run_durable(c, q0, d, every=1, engine="banded")
+    assert metrics.REGISTRY.counter(
+        "durable_sentinel_trips").value == trips0 + 1
+    # whatever was stamped predates the corruption: every checkpoint in
+    # the chain still digests clean and holds finite amplitudes
+    for _, path in ckpt.step_dirs(d):
+        restored = ckpt.load(path)
+        assert np.isfinite(amps_of(restored)).all()
+
+
+def test_sentinel_trips_on_norm_drift(tmp_path):
+    c = Circuit(5).h(0)
+    c.gate(2.0 * np.eye(2), (1,))       # non-unitary: norm x4
+    q0 = qt.init_debug_state(qt.create_qureg(5))
+    with pytest.raises(IntegrityError, match="drift"):
+        run_durable(c, q0, str(tmp_path / "drift"))
+
+
+def test_integrity_off_knob_disables_sentinels(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUEST_INTEGRITY", "0")
+    c = Circuit(5).h(0)
+    c.gate(2.0 * np.eye(2), (1,))
+    q0 = qt.init_debug_state(qt.create_qureg(5))
+    run_durable(c, q0, str(tmp_path / "off"))   # completes, no trip
+
+
+def test_density_sentinel_trips_on_hermiticity_break(tmp_path):
+    """A non-CPTP density evolution (here: a raw non-hermitian plane
+    edit emulated by a sentinel check on a doctored register) trips the
+    trace+hermiticity sentinel."""
+    from quest_tpu.resilience.durable import (_check_integrity,
+                                              _sentinel_values)
+    q = random_circuit(3, 2, seed=3).apply(qt.create_density_qureg(3))
+    info = {"density": True, "n": 6}
+    base = _sentinel_values(q.amps, info)
+    assert base["herm_residual"] <= 1e-5
+    bad = np.asarray(jax.device_get(q.amps)).copy()
+    bad[1, 3] += 1.0                    # breaks rho = rho^H
+    vals = _sentinel_values(jax.numpy.asarray(bad), info)
+    with pytest.raises(IntegrityError, match="herm_residual"):
+        _check_integrity(vals, base, 1e-3, step=1)
+
+
+# ---------------------------------------------------------------------------
+# resume-chain contracts
+# ---------------------------------------------------------------------------
+
+
+def test_resume_under_flipped_knob_raises_typed(tmp_path, monkeypatch):
+    """A keyed-knob flip between save and resume changes the plan the
+    suffix would execute: the cursor's mode key disagrees and the
+    resume fails typed instead of running the wrong program."""
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    monkeypatch.setenv("QUEST_SCHEDULE", "0")
+    with pytest.raises(DurableError, match="mode_key|num_steps"):
+        run_durable(c, q0, d, every=2, engine="banded")
+    monkeypatch.delenv("QUEST_SCHEDULE")
+    out = run_durable(c, q0, d, every=2, engine="banded")   # original cfg
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
+def test_resume_rejects_an_edited_circuit(tmp_path):
+    """Editing a gate OPERAND between save and resume keeps the op
+    count, plan shape and mode key identical — only the cursor's
+    plan_sha (op-stream value fingerprint) can catch it. Resuming
+    anyway would splice two circuits' amplitude prefixes silently."""
+    import dataclasses
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    c2 = qft_circuit(9)
+    for i, op in enumerate(c2.ops):
+        if op.kind == "allones":        # nudge one phase angle
+            c2.ops[i] = dataclasses.replace(
+                op, operand=op.operand * np.exp(0.001j))
+            break
+    c2._compiled.clear()
+    assert len(c2.ops) == len(c.ops)
+    with pytest.raises(DurableError, match="plan_sha"):
+        run_durable(c2, q0, d, every=2, engine="banded")
+
+
+def test_resume_rejects_a_flipped_interpret_flag(tmp_path):
+    """Interpreter-mode and compiled kernels round differently: a
+    resume under a flipped interpret flag would splice the two modes'
+    float streams, bit-different from BOTH uninterrupted runs."""
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    with pytest.raises(DurableError, match="interpret"):
+        run_durable(c, q0, d, every=2, engine="banded", interpret=True)
+
+
+def test_resume_rejects_a_different_initial_state(tmp_path):
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    with pytest.raises(DurableError, match="state_fp"):
+        run_durable(c, qt.create_qureg(9), d, every=2, engine="banded")
+
+
+def test_corrupt_checkpoint_with_shrunken_planes_is_skipped(tmp_path,
+                                                            capsys):
+    """A corrupt rewrite that SHRINKS the stored planes below the
+    digest's plane index must surface as the documented CheckpointError
+    (skipped loudly by the resume chain), never a leaked IndexError."""
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    f = os.path.join(ckpt.step_dirs(d)[-1][1], "amps.npz")
+    np.savez(f, planes=np.zeros((1,), dtype=np.float32))
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    assert "SKIPPING corrupt checkpoint" in capsys.readouterr().err
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
+def test_zero_retrace_on_the_resumed_path(tmp_path, compile_auditor):
+    """One full preempt+resume cycle warms every per-step program and
+    the sentinel reductions (cached on the circuit); a SECOND cycle
+    must retrace nothing — the durable cache-key discipline under the
+    CompileAuditor."""
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    d = str(tmp_path / "warm")
+    preempt(lambda: run_durable(c, q0, d, every=2, engine="banded"),
+            after=7)
+    run_durable(c, q0, d, every=2, engine="banded")
+    d2 = str(tmp_path / "audited")
+    with compile_auditor as aud:
+        preempt(lambda: run_durable(c, q0, d2, every=2,
+                                    engine="banded"), after=7)
+        run_durable(c, q0, d2, every=2, engine="banded")
+    aud.assert_no_retrace("warmed durable preempt+resume cycle")
+
+
+def test_durable_rejects_dynamic_circuits(tmp_path):
+    from quest_tpu.validation import QuESTError
+    c = Circuit(3).h(0)
+    c.measure(0)
+    q0 = qt.init_debug_state(qt.create_qureg(3))
+    with pytest.raises(QuESTError, match="run_durable"):
+        run_durable(c, q0, str(tmp_path / "dyn"))
+
+
+def test_durable_validates_arguments(tmp_path):
+    c = Circuit(3).h(0)
+    q0 = qt.init_debug_state(qt.create_qureg(3))
+    with pytest.raises(ValueError, match="every"):
+        run_durable(c, q0, str(tmp_path / "x"), every=0)
+    with pytest.raises(ValueError, match="mesh"):
+        run_durable(c, q0, str(tmp_path / "x"), engine="sharded")
+    with pytest.raises(ValueError, match="engine"):
+        run_durable(c, q0, str(tmp_path / "x"), engine="warp")
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: K seeded preemptions (incl. one mid-save), one on-disk
+# corruption — the run still completes with the exact uninterrupted
+# amplitudes and never consumes a corrupt checkpoint
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_preempted_run_completes_bit_identical(tmp_path,
+                                                          capsys):
+    rng = np.random.default_rng(20260804)
+    c = scattered_circuit(9, 10, seed=5)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    from quest_tpu.resilience.durable import _build_steps
+    steps, _ = _build_steps(c, 9, False, "banded", False, None)
+    assert len(steps) >= 8
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "soak")
+    kills = 0
+    for round_ in range(12):            # K preemptions + 1 mid-save kill
+        done = ckpt.step_dirs(d)[-1][0] if ckpt.step_dirs(d) else 0
+        remaining = len(steps) - done
+        if remaining <= 1 or kills >= 5:
+            break
+        if kills == 2:
+            # one preemption lands MID-SAVE: the commit-point fault
+            plan = FaultPlan().inject("checkpoint.save", times=1)
+        else:
+            after = int(rng.integers(1, max(2, remaining)))
+            plan = FaultPlan().inject("durable.preempt", after_n=after,
+                                      times=1)
+        with faults.active(plan):
+            try:
+                run_durable(c, q0, d, every=2, engine="banded")
+                break                   # completed despite the plan
+            except faults.InjectedFault:
+                kills += 1
+        if kills == 4 and ckpt.step_dirs(d):
+            # rot the newest checkpoint: the next resume must skip it
+            f = os.path.join(ckpt.step_dirs(d)[-1][1], "amps.npz")
+            with np.load(f) as z:
+                arrs = {k: z[k].copy() for k in z.files}
+            arrs["planes"][1, 1] += 1.0
+            np.savez(f, **arrs)
+    assert kills >= 3, f"soak only killed {kills} times"
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    err = capsys.readouterr().err
+    assert "SKIPPING corrupt checkpoint" in err
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+    assert ckpt.step_dirs(d) == []

@@ -485,6 +485,64 @@ def test_resilience_knobs_registered_with_loud_parsers():
     assert KNOBS["QUEST_FAULT_PLAN"].default is None
 
 
+def test_durable_knob_registry_coverage(tmp_path):
+    """QUEST_DURABLE_EVERY / QUEST_INTEGRITY / QUEST_INTEGRITY_TOL /
+    QUEST_CHECKPOINT_KEEP coverage of the registry rules (ISSUE 10):
+    all four are RUNTIME scope — read host-side at run_durable entry,
+    never inside a compiled path — so a registry read off-jit is clean,
+    the same read on a jit-reachable path fires QL001, and a direct
+    os.environ read fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        def configure_durable():
+            a = knob_value("QUEST_DURABLE_EVERY")
+            b = knob_value("QUEST_INTEGRITY")
+            c = knob_value("QUEST_INTEGRITY_TOL")
+            d = knob_value("QUEST_CHECKPOINT_KEEP")
+            return a, b, c, d
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_INTEGRITY"):
+                return amps * 2
+            return amps
+
+        def bypass():
+            return os.environ.get("QUEST_DURABLE_EVERY")
+    """, name="durableknobs.py")
+    assert not [v for v in vs if v.line in (7, 8, 9, 10)], vs
+    q1 = [v for v in vs if v.rule == "QL001"]
+    assert len(q1) == 1 and q1[0].line == 15, vs
+    assert "scope='runtime'" in q1[0].message, q1
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and q4[0].line == 20, vs
+    assert "bypasses" in q4[0].message, q4
+
+
+def test_durable_knobs_registered_with_loud_parsers():
+    """The durable knobs are registry-backed with malformed samples
+    that REJECT loudly (docs/CONFIG.md parity rides test_docs.py), and
+    their parsers enforce the documented ranges."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_DURABLE_EVERY", "QUEST_INTEGRITY",
+                 "QUEST_INTEGRITY_TOL", "QUEST_CHECKPOINT_KEEP"):
+        k = KNOBS[name]
+        assert k.scope == "runtime" and k.layer == "serve", k
+        assert k.malformed is not None
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    assert KNOBS["QUEST_DURABLE_EVERY"].parse("8") == 8
+    assert KNOBS["QUEST_INTEGRITY"].parse("0") is False
+    assert KNOBS["QUEST_INTEGRITY_TOL"].parse("1e-4") == 1e-4
+    with pytest.raises(ValueError):
+        KNOBS["QUEST_INTEGRITY_TOL"].parse("0")
+    assert KNOBS["QUEST_CHECKPOINT_KEEP"].parse("3") == 3
+    assert KNOBS["QUEST_CHECKPOINT_KEEP"].default == 2
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax
