@@ -135,14 +135,12 @@ def _basis_state(shape, rdt=None):
     return basis_planes(0, n=n, rdt=rdt or jnp.float32, shape=shape)
 
 
-def banded_fits(n: int, bytes_per_real: int = 4) -> bool:
-    """Whether the banded engine's XLA band-dot footprint fits this
-    device. The band dots need ~3x the state in HLO temps even under
-    remat (measured: 24 GB at 30q, six 4 GB dot_general buffers), so on a
-    16 GB v5e the 30q banded compile is a guaranteed OOM that still costs
-    ~20 min of XLA time before failing — skip it up front. Shared by the
-    bench ladder and scripts/tpu_prewarm.py so the measured 4x-state
-    constant lives in one place."""
+def _hbm_limit():
+    """Best-known per-device HBM byte limit: live device stats, the
+    QUEST_HBM_BYTES override, or the recognized-family assumption —
+    None when genuinely unknown. The ONE discovery path shared by the
+    banded OOM gate and the f64 capacity gate (apply.f64_capacity_stats
+    takes the result), so the two cannot disagree about the chip."""
     try:
         lim = (jax.local_devices()[0].memory_stats() or {}).get("bytes_limit")
     except Exception:
@@ -161,9 +159,25 @@ def banded_fits(n: int, bytes_per_real: int = 4) -> bool:
         # 30q banded compile burns ~19 min before its guaranteed OOM.
         kind = str(getattr(jax.devices()[0], "device_kind", "")).lower()
         if "lite" in kind or "v5e" in kind:
-            lim = int(15.75 * 2**30)
+            from quest_tpu.ops.apply import _V5E_HBM_BYTES
+            lim = _V5E_HBM_BYTES    # one constant, shared with the f64
+            # capacity model's fallback (apply.f64_capacity_stats)
             _log(f"device hides HBM stats; assuming {lim/2**30:.2f} GiB "
                  f"for device_kind={kind!r} (override via QUEST_HBM_BYTES)")
+    return lim
+
+
+def banded_fits(n: int, bytes_per_real: int = 4) -> bool:
+    """Whether the banded engine's XLA band-dot footprint fits this
+    device. The band dots need ~3x the state in HLO temps even under
+    remat (measured: 24 GB at 30q, six 4 GB dot_general buffers), so on a
+    16 GB v5e the 30q banded compile is a guaranteed OOM that still costs
+    ~20 min of XLA time before failing — skip it up front. Shared by the
+    bench ladder and scripts/tpu_prewarm.py so the measured 4x-state
+    constant lives in one place. NOTE: this is the f32 XLA-dot model;
+    the f64 limb path is chunk-bounded and gates through
+    apply.f64_capacity_stats instead (_measure_f64_inner)."""
+    lim = _hbm_limit()
     # state (2 planes) + ~3x in temps; f64 planes double every term
     need = 4 * 2 * bytes_per_real * (1 << n)
     if lim is None:
@@ -553,9 +567,22 @@ def _measure_f64(reps: int):
 
 def _measure_f64_inner(reps: int):
     import jax.numpy as jnp
+    from quest_tpu.ops import apply as A
 
-    for n in (26, 24):
-        if not banded_fits(n, bytes_per_real=8):
+    lim = _hbm_limit()
+    for n in (28, 26, 24):
+        # gate through the chunk-bounded limb capacity model, not the
+        # f32 XLA-dot constant: the chunked limb path's working set is
+        # 2x state + ~4x one chunk, which is what routes 28q f64 — the
+        # reference's DEFAULT precision at the chip's capacity point —
+        # onto a 15.75 GiB v5e at all (docs/PRECISION.md; the old
+        # banded_fits(28, 8) gate refused it while the un-chunked form
+        # OOMed, so the question sat unanswerable)
+        cap = A.f64_capacity_stats(n, hbm_bytes=lim)
+        if lim is not None and not cap["fits_hbm"]:
+            _log(f"f64 n={n} skipped: limb peak "
+                 f"{cap['peak_bytes'] / 2**30:.1f} GiB exceeds device "
+                 f"HBM ({lim / 2**30:.1f} GiB)")
             continue
         try:
             circ = _build_circuit(n)
@@ -583,17 +610,89 @@ def _measure_f64_inner(reps: int):
 
 
 def _sweep_metrics(build, n: int):
-    """(hbm_sweeps, per-sweep stage counts) of a bench circuit through
-    Circuit.plan_stats — pure host planning (no compile, no chip), the
-    CPU-assertable metric behind the sweep-fusion layer
-    (docs/SWEEPS.md). Returns (None, None) on any failure so the
-    headline JSON never breaks."""
+    """(hbm_sweeps, per-sweep stage counts, pipeline_* keys) of a bench
+    circuit through ONE Circuit.plan_stats pass — pure host planning
+    (no compile, no chip), the CPU-assertable metrics behind the
+    sweep-fusion layer and the decoupled pipeline (docs/SWEEPS.md).
+    The pipeline dict is None when the legacy driver is active
+    (QUEST_FUSED_PIPELINE=0), so the JSON stays bit-for-bit the old
+    line for the silicon A/B. Returns (None, None, None) on any
+    failure so the headline JSON never breaks."""
     try:
         rec = build(n).plan_stats()["fused"]
-        return rec["hbm_sweeps"], rec["sweep_stages"]
+        pipe = None
+        if "pipeline_in_slots" in rec:
+            pipe = {k: rec[k] for k in ("pipeline_in_slots",
+                                        "pipeline_out_slots",
+                                        "pipeline_overlap_steps")}
+        return rec["hbm_sweeps"], rec["sweep_stages"], pipe
     except Exception:
         _log(f"sweep metrics failed at n={n}:\n{traceback.format_exc()}")
-        return None, None
+        return None, None, None
+
+
+def _measure_rcs(depth: int = 20, reps: int = 3):
+    """Wall seconds per run of the depth-20 30q RCS circuit through the
+    fused engine — the whole-circuit latency target of ROADMAP item 1
+    (2.21 s measured r5 on the in-place slot driver; the decoupled
+    pipeline targets <= 1.5 s). TPU-only (the CPU host cannot hold a
+    30q state); returns (seconds, gate count, compile_s) or Nones so
+    the headline JSON never breaks. The same circuit
+    benchmarks/run.py rcs measures, now emitted as rcs_* keys in the
+    headline line so the BENCH_r*.json trajectory captures the delta
+    without a separate run."""
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        return None, None, None
+    import jax.numpy as jnp
+
+    from quest_tpu.circuit import random_circuit
+    from quest_tpu.state import basis_planes, fused_state_shape
+
+    n = 30
+    try:
+        circ = random_circuit(n, depth, seed=1)
+        t0 = time.perf_counter()
+        fn = circ.compiled_fused(n, density=False, donate=True)
+        amps = basis_planes(0, n=n, rdt=jnp.float32,
+                            shape=fused_state_shape(n))
+        amps = fn(amps)
+        _sync(amps)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            amps = fn(amps)
+        _sync(amps)
+        dt = (time.perf_counter() - t0) / reps
+        _log(f"rcs 30q d{depth}: {dt:.2f} s/run "
+             f"({len(circ.ops) / dt:.1f} gates/s)")
+        return dt, len(circ.ops), compile_s
+    except Exception:
+        _log(f"rcs scenario failed (headline unaffected):\n"
+             f"{traceback.format_exc()}")
+        return None, None, None
+
+
+# Every key the headline JSON line may carry — the schema the trajectory
+# files (BENCH_r*.json) are parsed against. main() asserts the emitted
+# line stays inside it and scripts/check_sweep_golden.py asserts the
+# round's NEW keys (pipeline_*, f64_28q_*, rcs_*) are registered here,
+# so the next chip run lands in the trajectory without hand-editing.
+HEADLINE_JSON_KEYS = frozenset({
+    "metric", "value", "unit", "vs_baseline", "baseline_note", "engine",
+    "compile_s", "hbm_sweeps", "sweep_stages",
+    "pipeline_in_slots", "pipeline_out_slots", "pipeline_overlap_steps",
+    "density_metric", "density_value", "density_unit", "density_compile_s",
+    "f64_metric", "f64_value", "f64_unit", "f64_compile_s",
+    "f64_28q_peak_bytes", "f64_28q_fits_hbm", "f64_28q_chunk_elems",
+    "f64_28q_value", "f64_28q_unit",
+    "chain_metric", "chain_value", "chain_unit", "chain_compile_s",
+    "chain_hbm_sweeps", "chain_sweep_stages",
+    "rcs_metric", "rcs_value", "rcs_unit", "rcs_gates_per_sec",
+    "rcs_compile_s",
+    "traj_metric", "traj_value", "traj_unit", "traj_compile_s", "batch",
+    "states_per_sweep", "traj_hbm_sweeps", "traj_channels",
+    "traj_baseline_value", "traj_baseline_note", "traj_speedup",
+})
 
 
 def _baseline_gates_per_sec(n: int) -> tuple[float, str]:
@@ -1176,9 +1275,10 @@ def main():
     density_ops, density_nd, density_compile_s = _measure_density(reps=3)
     f64_gps, f64_n, f64_compile_s = _measure_f64(reps=2)
     chain_gps, chain_compile_s = _measure_chain(n, reps)
+    rcs_s, rcs_gates, rcs_compile_s = _measure_rcs()
     traj_rec = _measure_trajectories()
-    sweeps, sweep_stages = _sweep_metrics(_build_circuit, n)
-    chain_sweeps, chain_sweep_stages = _sweep_metrics(
+    sweeps, sweep_stages, pipeline_rec = _sweep_metrics(_build_circuit, n)
+    chain_sweeps, chain_sweep_stages, _ = _sweep_metrics(
         _build_chain_circuit, n)
 
     line = {
@@ -1193,6 +1293,8 @@ def main():
     if sweeps is not None:
         line["hbm_sweeps"] = sweeps
         line["sweep_stages"] = sweep_stages
+    if pipeline_rec is not None:
+        line.update(pipeline_rec)
     if density_ops is not None:
         line["density_metric"] = (f"channel+gate ops/sec @ {density_nd}q "
                                   f"density ({platform})")
@@ -1205,6 +1307,26 @@ def main():
         line["f64_value"] = round(f64_gps, 2)
         line["f64_unit"] = "gates/sec"
         line["f64_compile_s"] = round(f64_compile_s, 1)
+    # the f64-at-capacity record (docs/PRECISION.md): the chunk-bounded
+    # limb sizing at 28q is CPU-computable, so it is ALWAYS emitted;
+    # the measured throughput key lands when a chip run reaches 28q
+    try:
+        from quest_tpu.ops import apply as _A
+        f64cap = _A.f64_capacity_stats(28, hbm_bytes=_hbm_limit())
+        line["f64_28q_peak_bytes"] = f64cap["peak_bytes"]
+        line["f64_28q_fits_hbm"] = f64cap["fits_hbm"]
+        line["f64_28q_chunk_elems"] = f64cap["chunk_elems"]
+    except Exception:
+        _log(f"f64 28q capacity record failed:\n{traceback.format_exc()}")
+    if f64_gps is not None and f64_n == 28:
+        line["f64_28q_value"] = round(f64_gps, 2)
+        line["f64_28q_unit"] = "gates/sec"
+    if rcs_s is not None:
+        line["rcs_metric"] = f"RCS depth-20 @ 30q wall-clock ({platform})"
+        line["rcs_value"] = round(rcs_s, 3)
+        line["rcs_unit"] = "s/run"
+        line["rcs_gates_per_sec"] = round(rcs_gates / rcs_s, 1)
+        line["rcs_compile_s"] = round(rcs_compile_s, 1)
     if chain_gps is not None:
         line["chain_metric"] = (f"dependent-chain gates/sec @ {n}q "
                                 f"statevec, fusion-resistant ({platform})")
@@ -1216,7 +1338,15 @@ def main():
             line["chain_sweep_stages"] = chain_sweep_stages
     if traj_rec is not None:
         line.update(traj_rec)
+    # print BEFORE the schema gate: a chip session's measurements must
+    # never be discarded over a bookkeeping miss — the assert still
+    # fails the run loudly for CI
     print(json.dumps(line))
+    unknown = set(line) - HEADLINE_JSON_KEYS
+    assert not unknown, (
+        f"headline JSON emitted unregistered key(s) {sorted(unknown)}: "
+        f"add them to HEADLINE_JSON_KEYS so the trajectory files keep "
+        f"a parseable schema")
 
 
 if __name__ == "__main__":

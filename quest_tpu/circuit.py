@@ -1372,6 +1372,12 @@ class Circuit:
                 "hbm_sweeps": sw["hbm_sweeps"],
                 "sweep_stages": sw["sweep_stages"],
             }
+            # decoupled-pipeline schedule (QUEST_FUSED_PIPELINE, keyed):
+            # pipeline_in_slots/out_slots/overlap_steps, CPU-side like
+            # the sweep counts; {} when the legacy driver is active, so
+            # the knob-off record stays bit-for-bit the old one
+            # (scripts/check_sweep_golden.py gates both)
+            rec["fused"].update(PB.pipeline_stats(swept, n))
             if batch is not None:
                 from quest_tpu.env import batch_bucket
                 rec["batched"] = PB.batched_stats(
@@ -1390,6 +1396,11 @@ class Circuit:
                 "hbm_sweeps": rec["banded"]["full_state_passes"],
                 "kernel_sweeps": 0, "batched_stages": 0,
             }
+        # f64-at-capacity sizing (docs/PRECISION.md): the limb path's
+        # chunk-bounded peak-memory model at this register size — the
+        # record bench.py's f64 ladder gates 28q on, and the CPU-side
+        # answer to "does reference-default precision fit this chip"
+        rec["f64"] = A.f64_capacity_stats(n)
         if devices is not None:
             rec["comm"] = self._comm_plan_stats(n, density, int(devices))
         return rec

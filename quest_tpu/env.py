@@ -269,6 +269,16 @@ _KNOB_LIST = (
          doc="VMEM slot buffers in the manually pipelined Pallas driver "
              "(default: 3); malformed values warn and fall back",
          malformed="9"),
+    Knob("QUEST_FUSED_PIPELINE", _bool01("QUEST_FUSED_PIPELINE"), True,
+         scope="keyed", layer="kernel",
+         doc="decoupled multi-buffer sweep pipeline in the manually "
+             "pipelined Pallas driver: separate in-slot and out-slot "
+             "rings with independent DMA semaphore chains, so the HBM "
+             "read stream, the stage chain and the HBM write stream "
+             "each run a full step ahead (docs/SWEEPS.md): 1/0 "
+             "(default: 1; 0 restores the legacy in-place NBUF slot "
+             "driver for the silicon A/B)",
+         malformed="2", flips=("1", "0")),
     Knob("QUEST_ROWS_EFF_BITS", _int_range("QUEST_ROWS_EFF_BITS", 3), None,
          scope="import_once", layer="kernel",
          doc="log2 block rows per Pallas kernel step (default: auto from "

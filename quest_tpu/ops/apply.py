@@ -433,6 +433,66 @@ def _f64_chunk_elems() -> int:
     return knob_value("QUEST_F64_CHUNK")
 
 
+_LIMB_TEMP_MULT = 4     # measured working-set multiplier of the limb
+# application: six f32 limb slices per limbs() call (x two live calls,
+# g's being negligible) plus the int32 weight-class partials come to
+# ~4x the f64 bytes being contracted. The UN-chunked form materializes
+# this against the whole state — the ~4x working set that OOMed 28q on
+# a 15.75 GiB v5e (scripts/probe_f64.py probe_28q, 2026-08-02); the
+# chunked form pays it per chunk only.
+
+_V5E_HBM_BYTES = int(15.75 * 2 ** 30)   # the recognized-family default
+# (read off the chip's own OOM report, r3) — bench.py's _hbm_limit
+# refines it from live device stats / QUEST_HBM_BYTES when available
+
+
+def f64_capacity_stats(n: int, chunk_elems: int = None,
+                       hbm_bytes: int = None) -> dict:
+    """CPU-side peak-memory model of an f64 limb band pass at register
+    size `n` — the plan_stats()['f64'] record that answers the
+    28q-capacity sizing question WITHOUT a chip (docs/PRECISION.md):
+
+        peak = 2 x state (in + out planes around the donated update)
+             + _LIMB_TEMP_MULT x the f64 bytes one chunk contracts
+
+    chunk_elems defaults to the effective QUEST_F64_CHUNK (0 = chunking
+    off — the un-chunked ~4x-state working set); hbm_bytes to the
+    QUEST_HBM_BYTES override when set (the same knob the bench's OOM
+    gate honors — a non-v5e chip answers for ITS capacity), else the
+    v5e constant the bench assumes when the device hides memory stats.
+    `fits_hbm` is the routing gate bench.py's f64 ladder checks before
+    paying a 28q compile (the un-chunked 28q attempt burned its full
+    compile before the guaranteed OOM)."""
+    state_bytes = 2 * 8 * (1 << n)          # f64 re+im planes
+    if chunk_elems is None:
+        chunk_elems = _f64_chunk_elems()
+    chunk_elems = int(chunk_elems)
+    if chunk_elems and chunk_elems < (1 << n):
+        chunk_bytes = 2 * 8 * chunk_elems   # re+im chunk pair
+    else:
+        chunk_elems = 0                     # effectively un-chunked
+        chunk_bytes = state_bytes
+    temp_bytes = _LIMB_TEMP_MULT * chunk_bytes
+    if hbm_bytes is None:
+        from quest_tpu.env import knob_value
+        hbm_bytes = knob_value("QUEST_HBM_BYTES")   # parses loudly
+        if hbm_bytes is None:
+            hbm_bytes = _V5E_HBM_BYTES
+    peak = 2 * state_bytes + temp_bytes
+    # deliberately NO backend-dependent fields (e.g. the QUEST_F64_MXU
+    # default probes jax.default_backend()): plan_stats must stay pure
+    # host math — callable with a dead tunnel, before backend init
+    return {
+        "n": int(n),
+        "state_bytes": state_bytes,
+        "chunk_elems": chunk_elems,
+        "chunk_temp_bytes": temp_bytes,
+        "peak_bytes": peak,
+        "hbm_bytes": int(hbm_bytes),
+        "fits_hbm": peak <= int(hbm_bytes),
+    }
+
+
 def mode_key():
     """The apply-level trace-mode flags: everything THIS module reads
     from the environment at trace time, derived from the knob registry

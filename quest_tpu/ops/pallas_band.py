@@ -651,15 +651,57 @@ MAX_SWEEP_STAGES = 64   # stages per merged sweep: twice the per-segment
 # the stage chain (the 2^14-row spills of PIPELINED_MAX_BLOCK_ROW_BITS
 # were chain-wide), so the first on-chip run should A/B this against
 # QUEST_SWEEP_FUSION=0 before trusting deep sweeps.
-SWEEP_OPERAND_BYTES = 48 * (1 << 20)  # VMEM operand budget per sweep:
-# 100 MiB scoped limit minus NBUF (3) double-buffered 8 MiB block slots
-# and headroom for stage temporaries. 48 MiB holds ~380 dense 128x128
-# operator pairs — the stage cap binds first on real plans.
+SWEEP_OPERAND_BYTES = 48 * (1 << 20)  # VMEM operand budget per sweep
+# under the LEGACY in-place slot driver: 100 MiB scoped limit minus
+# NBUF (3) 8 MiB block slots and headroom for stage temporaries. 48 MiB
+# holds ~380 dense 128x128 operator pairs — the stage cap binds first
+# on real plans.
+PIPELINE_IN_SLOTS = 2   # decoupled pipeline: VMEM slots per DMA ring.
+PIPELINE_OUT_SLOTS = 2  # 2 in + 2 out = the read stream one full step
+# ahead of compute and the write stream one full step behind, each on
+# its OWN semaphore chain — in(s+1) never waits for out(s+1-nbuf) to
+# drain (the in-place coupling that made nbuf=2 stall a full out-DMA
+# per step: measured 23.8 vs 20.5 ms on the 28q bench).
+PIPELINE_SWEEP_OPERAND_BYTES = 40 * (1 << 20)  # the decoupled rings
+# hold 4 block slots (32 MiB at the 2^13-row cap) where the legacy
+# driver held 3 (24 MiB); the operand budget gives the extra slot back
+# so slots + operands + headroom still fit the 100 MiB scoped limit —
+# the same stage_requirements()-anchored accounting, one more slot.
+
+
+def pipeline_enabled() -> bool:
+    """QUEST_FUSED_PIPELINE knob: '1' (default) runs the decoupled
+    multi-buffer pipeline in the manually pipelined driver; '0' keeps
+    the legacy in-place NBUF slot schedule (the silicon A/B control).
+    Keyed in the registry, so every compiled-program cache key carries
+    it (env.engine_mode_key; flip-audited in tests/test_lint.py)."""
+    from quest_tpu.env import knob_value
+    return knob_value("QUEST_FUSED_PIPELINE")
+
+
+def decoupled_active() -> bool:
+    """Whether compiled segments will run the decoupled pipeline: the
+    manual slot driver is selected AND the pipeline knob is on. The ONE
+    predicate shared by compile_segment (driver pick), sweep_plan's
+    operand budget and pipeline_stats, so the planner, the budget and
+    the introspection can never disagree about the active schedule."""
+    return _driver_override() == "pipelined" and pipeline_enabled()
+
+
+def sweep_operand_budget() -> int:
+    """Effective per-sweep VMEM operand budget for the ACTIVE kernel
+    schedule: the decoupled pipeline's 4 block slots leave
+    PIPELINE_SWEEP_OPERAND_BYTES; the legacy in-place driver (knob off,
+    or the grid driver) keeps the original SWEEP_OPERAND_BYTES —
+    bit-for-bit the old plans when QUEST_FUSED_PIPELINE=0."""
+    if decoupled_active():
+        return PIPELINE_SWEEP_OPERAND_BYTES
+    return SWEEP_OPERAND_BYTES
 
 
 def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
                row_budget: int = None, max_stages: int = MAX_SWEEP_STAGES,
-               operand_bytes: int = SWEEP_OPERAND_BYTES):
+               operand_bytes: int = None):
     """Merge consecutive ("segment", stages, arrays) parts of a
     segment_plan (or a concatenation of several applications' plans)
     into maximal single-launch sweeps, preserving program order.
@@ -671,6 +713,8 @@ def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
     del n
     if row_budget is None:
         row_budget = max_block_row_bits()
+    if operand_bytes is None:
+        operand_bytes = sweep_operand_budget()
     out = []
     cur_scat: set = set()
     cur_floor = 0
@@ -759,6 +803,79 @@ def batched_stats(parts, batch: int, bucket: int = None) -> dict:
         "batched_stages": sum(
             1 for p in parts if p[0] == "segment"
             for st in p[1] if isinstance(st, BatchSelStage)),
+    }
+
+
+def sweep_steps(stages, n: int, batch: int = 1) -> int:
+    """Grid steps one compiled sweep walks (blocks per state x batch)
+    — from segment_geometry, the SAME resolution compile_segment sizes
+    the kernel with, so the CPU-side schedule numbers below cannot
+    drift from the lowered program."""
+    geo = segment_geometry(stages, n)
+    steps = 1
+    for (lo, w) in geo.gaps:
+        steps *= 1 << w
+    return steps * int(batch)
+
+
+def pipeline_stats(parts, n: int, batch: int = 1) -> dict:
+    """CPU-assertable schedule of the decoupled sweep pipeline over a
+    (swept) part list — pipeline_in_slots / pipeline_out_slots /
+    pipeline_overlap_steps, the plan_stats()['fused'] keys
+    scripts/check_sweep_golden.py gates without a chip.
+
+    `pipeline_overlap_steps` is the MINIMUM read-ahead depth across the
+    plan's kernel sweeps: steps the HBM read stream runs ahead of
+    compute (in_slots - 1, clamped by the sweep's step count — a
+    single-block sweep has nothing to read ahead). >= 1 on the
+    headline plan means every launch overlaps the next block's DMA
+    under the current block's stage loop.
+
+    Returns {} when the decoupled pipeline is not the active schedule
+    (QUEST_FUSED_PIPELINE=0 or the grid driver) — the knob-off fused
+    record stays bit-for-bit the legacy one."""
+    if not decoupled_active():
+        return {}
+    overlaps = []
+    for p in parts:
+        if p[0] != "segment":
+            continue
+        steps = sweep_steps(p[1], n, batch)
+        overlaps.append(min(PIPELINE_IN_SLOTS, steps) - 1)
+    return {
+        "pipeline_in_slots": PIPELINE_IN_SLOTS,
+        "pipeline_out_slots": PIPELINE_OUT_SLOTS,
+        "pipeline_overlap_steps": min(overlaps) if overlaps else 0,
+    }
+
+
+def sweep_vmem_bytes(stages, arrays, n: int, batch: int = 1) -> dict:
+    """CPU-assertable VMEM residency of ONE compiled sweep launch:
+    slot buffers (the in/out rings of the decoupled pipeline, or the
+    legacy NBUF in-place slots) + whole-array operand residency. The
+    accounting behind the sweep budgets: `total_bytes <= budget_bytes`
+    must hold for every plannable geometry (unit-tested over
+    adversarial geometries in tests/test_sweeps.py), which is what
+    lets sweep_plan merge on byte budgets instead of compiling to
+    find out."""
+    geo = segment_geometry(stages, n)
+    steps = sweep_steps(stages, n, batch)
+    block_bytes = 2 * geo.rows_eff * LANES * 4          # f32 planes
+    if decoupled_active():
+        slots = (min(PIPELINE_IN_SLOTS, steps)
+                 + min(PIPELINE_OUT_SLOTS, steps))
+    elif _driver_override() == "pipelined":
+        slots = min(NBUF, steps)
+    else:
+        slots = 2                # the grid driver's double buffering
+    operand_bytes = sum(int(a.nbytes) for a in arrays)
+    return {
+        "block_bytes": block_bytes,
+        "slots": slots,
+        "slot_bytes": slots * block_bytes,
+        "operand_bytes": operand_bytes,
+        "total_bytes": slots * block_bytes + operand_bytes,
+        "budget_bytes": VMEM_LIMIT_BYTES,
     }
 
 
@@ -1417,40 +1534,21 @@ def _nbuf_override() -> int:
 NBUF = _nbuf_override()
 
 
-def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
-                      block_shape, nbuf, nbatch=1, batched=None):
-    """Manually pipelined segment driver: the state stays in HBM
-    (memory_space=ANY); the kernel walks the same step space as the grid
-    driver with `nbuf` in-place VMEM slot buffers — DMA step s+1 in and
-    step s-1 out while the stage chain computes step s.
-
-    Measured r4 (scripts/probe_stack.py, docs/KERNELS.md round-4
-    findings): PARITY with the automatic BlockSpec pipeline on the
-    bench step (79.7 vs 79.9 ms) and the best RCS 30q d20 number
-    (2.097 vs 2.153 s) — the default driver on that margin. The hoped
-    second win did NOT materialize: in-place slots halve block-buffer
-    VMEM, but 2^14-row blocks still fail on ~96 MiB of chain-wide
-    register-allocator spills (see PIPELINED_MAX_BLOCK_ROW_BITS), so
-    the row-bit budget stays 13 on both drivers."""
-    mat_refs = rest[:len(stages)]
-    out_hbm = rest[len(stages)]
-    if batched is None:          # legacy callers key batched-ness on B
-        batched = nbatch > 1
-    steps = int(np.prod(grid)) * nbatch
-    nbuf = min(nbuf, steps)
-
+def _step_index(grid, block_shape, batched):
+    """idx_of(step) -> (index tuple, pids, batch id) for the manual
+    slot drivers: the index tuple selecting step's block in the state
+    view, derived from the BLOCK SHAPE exactly like the grid driver's
+    index_map (block entry 1 = a grid axis taking the unraveled step
+    id, anything else rides whole) — one layout convention, not two.
+    A size-1 inner axis also has block 1; the default 0 indexes it,
+    mirroring index_map's zip-shortest behavior. Batched: the step
+    space is (nbatch, *grid) with the batch SLOWEST, so each state's
+    blocks stream back-to-back — the quotient left after dividing out
+    the row grid is the i32 batch index (the drivers pin their loop
+    counters int32, so every derived pid stays 32-bit). Shared by the
+    legacy in-place driver and the decoupled pipeline so the two
+    schedules can never disagree about which block a step touches."""
     def idx_of(step):
-        """Index tuple selecting step's block in the state view,
-        derived from the BLOCK SHAPE exactly like the grid driver's
-        index_map (block entry 1 = a grid axis taking the unraveled
-        step id, anything else rides whole) — one layout convention,
-        not two. A size-1 inner axis also has block 1; the default 0
-        indexes it, mirroring index_map's zip-shortest behavior.
-        Batched: the step space is (nbatch, *grid) with the batch
-        SLOWEST, so each state's blocks stream back-to-back — the
-        quotient left after dividing out the row grid is the i32 batch
-        index (the loop counter is pinned int32 below, so every
-        derived pid stays 32-bit)."""
         pids = []
         rem = step
         for g in reversed(grid):
@@ -1466,7 +1564,35 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
                        else slice(None))
         idx.append(slice(None))              # lane axis
         return tuple(idx), pids, b
+    return idx_of
 
+
+def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
+                      block_shape, nbuf, nbatch=1, batched=None):
+    """LEGACY manually pipelined segment driver (QUEST_FUSED_PIPELINE=0
+    — the silicon A/B control): the state stays in HBM
+    (memory_space=ANY); the kernel walks the same step space as the grid
+    driver with `nbuf` IN-PLACE VMEM slot buffers — DMA step s+1 in and
+    step s-1 out while the stage chain computes step s. In-place slots
+    couple the two DMA directions: in(s+1) may only start once
+    out(s+1-nbuf) drained from the same buffer (the serialization the
+    decoupled driver below removes).
+
+    Measured r4 (scripts/probe_stack.py, docs/KERNELS.md round-4
+    findings): PARITY with the automatic BlockSpec pipeline on the
+    bench step (79.7 vs 79.9 ms) and the best RCS 30q d20 number
+    (2.097 vs 2.153 s) — the default driver on that margin. The hoped
+    second win did NOT materialize: in-place slots halve block-buffer
+    VMEM, but 2^14-row blocks still fail on ~96 MiB of chain-wide
+    register-allocator spills (see PIPELINED_MAX_BLOCK_ROW_BITS), so
+    the row-bit budget stays 13 on both drivers."""
+    mat_refs = rest[:len(stages)]
+    out_hbm = rest[len(stages)]
+    if batched is None:          # legacy callers key batched-ness on B
+        batched = nbatch > 1
+    steps = int(np.prod(grid)) * nbatch
+    nbuf = min(nbuf, steps)
+    idx_of = _step_index(grid, block_shape, batched)
     slot_shape = (1, *block_shape) if batched else block_shape
 
     def body(scratch, in_sems, out_sems):
@@ -1528,6 +1654,132 @@ def _pipelined_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
     )
 
 
+def _decoupled_kernel(in_hbm, *rest, stages, geo: _Geometry, grid,
+                      block_shape, in_slots, out_slots, nbatch=1,
+                      batched=None):
+    """DECOUPLED multi-buffer sweep pipeline (QUEST_FUSED_PIPELINE=1,
+    the default): separate in-slot and out-slot rings, each with its
+    own DMA semaphore chain, so the three streams of a sweep —
+
+        HBM read  ->  per-stage MXU/VPU compute  ->  HBM write
+
+    each run a full step ahead of the next. The legacy driver's
+    in-place slots made one buffer serve as DMA-in target, compute
+    scratch AND DMA-out source, which serializes the two DMA
+    directions: in(s+1) had to wait for out(s+1-nbuf) to drain the
+    same buffer — a stall of a whole out-DMA per step at nbuf=2
+    (measured 23.8 vs 20.5 ms on the 28q bench) and a whole extra
+    block of slack-buffer VMEM at nbuf=3. Here the read ring refills
+    the moment compute has consumed a slot, regardless of where the
+    write stream is:
+
+        warm-up   in(0..in_slots-1) start          read ring fills
+        step s    wait in(s)                       [in sems]
+                  stage chain on in-slot s%I       compute
+                  wait out(s-out_slots) drained    [out sems]
+                  write out-slot s%O; out(s) start
+                  in(s+in_slots) start             ring refill
+        drain     wait the last out_slots out-DMAs
+
+    During the stage loop of step s the DMAs for blocks s+1..s+I-1
+    (started by earlier iterations / the warm-up) and the write-backs
+    of blocks s-O..s-1 are all in flight — stage-level overlap of the
+    next block's DMA under the current block's compute, with neither
+    DMA direction gating the other. The refill for step s+I starts
+    only AFTER the stage chain (its in-slot holds the block compute is
+    reading until then); with in_slots >= 2 the read stream still runs
+    a full step ahead. VMEM cost: in_slots + out_slots block buffers
+    (4 x 8 MiB at the 2^13-row cap) vs the legacy 3 — paid back out of
+    the sweep operand budget (PIPELINE_SWEEP_OPERAND_BYTES), so the
+    total stays inside the 100 MiB scoped limit; sweep_vmem_bytes is
+    the CPU-assertable accounting.
+
+    Bit-identity with the legacy driver holds by construction: the
+    same _step_index walk, the same _apply_stages chain, the same
+    float ops per block — only the buffer/semaphore schedule differs
+    (pinned across the randomized sweep suite in tests/test_sweeps.py).
+
+    The in/out waits sit inside jax.named_scope regions
+    ('quest:dma_in_wait' / 'quest:dma_out_wait' / 'quest:stages') so a
+    chip profile can attribute residual stall time to the read stream,
+    the write stream or the stage chain directly
+    (profiling.sweep_dma_report is the host-side split)."""
+    mat_refs = rest[:len(stages)]
+    out_hbm = rest[len(stages)]
+    if batched is None:
+        batched = nbatch > 1
+    steps = int(np.prod(grid)) * nbatch
+    n_in = min(in_slots, steps)
+    n_out = min(out_slots, steps)
+    idx_of = _step_index(grid, block_shape, batched)
+    slot_shape = (1, *block_shape) if batched else block_shape
+
+    def body(in_scr, out_scr, in_sems, out_sems):
+        def get_in(step, slot):
+            idx, _, _ = idx_of(step)
+            return pltpu.make_async_copy(
+                in_hbm.at[idx], in_scr.at[slot], in_sems.at[slot])
+
+        def get_out(step, slot):
+            idx, _, _ = idx_of(step)
+            return pltpu.make_async_copy(
+                out_scr.at[slot], out_hbm.at[idx], out_sems.at[slot])
+
+        for j in range(n_in):                # fill the read ring
+            get_in(j, j).start()
+
+        def step_body(s, _):
+            # explicit i32 operands: under jax_enable_x64 a Python-int
+            # operand traces as i64, and a mixed-dtype rem fails to
+            # lower (interpret mode) or legalize (Mosaic)
+            islot = jax.lax.rem(s, jnp.int32(n_in))
+            oslot = jax.lax.rem(s, jnp.int32(n_out))
+            with jax.named_scope("quest:dma_in_wait"):
+                get_in(s, islot).wait()
+            _, pids, b = idx_of(s)
+            row_ids = _row_ids(geo, pids)
+            blk = in_scr[islot].reshape(2, geo.rows_eff, LANES)
+            re = blk[0]
+            im = blk[1]
+            with jax.named_scope("quest:stages"):
+                re, im = _apply_stages(re, im, stages, mat_refs, geo,
+                                       row_ids, b if batched else None)
+            # the out slot is free once ITS previous occupant drained —
+            # the only cross-stream ordering left, and it trails compute
+            # by a whole out_slots steps
+            @pl.when(s >= n_out)
+            def _():
+                with jax.named_scope("quest:dma_out_wait"):
+                    get_out(s - n_out, oslot).wait()
+            out_scr[oslot] = jnp.stack([re, im]).reshape(slot_shape)
+            get_out(s, oslot).start()
+
+            # refill the read ring: in-slot s%I was consumed by the
+            # stage chain above, so block s+I may stream in now —
+            # it will be in flight under the NEXT steps' stage loops
+            @pl.when(s + n_in < steps)
+            def _():
+                get_in(s + n_in, islot).start()
+            return jnp.int32(0)
+
+        # int32 bounds pin the loop counter (and everything derived
+        # from it in idx_of) to 32 bits — see _pipelined_kernel
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(steps), step_body,
+                          jnp.int32(0))
+        for j in range(n_out):               # drain the tail out-DMAs
+            s = steps - n_out + j
+            if s >= 0:
+                get_out(s, s % n_out).wait()
+
+    pl.run_scoped(
+        body,
+        in_scr=pltpu.VMEM((n_in, *slot_shape), jnp.float32),
+        out_scr=pltpu.VMEM((n_out, *slot_shape), jnp.float32),
+        in_sems=pltpu.SemaphoreType.DMA((n_in,)),
+        out_sems=pltpu.SemaphoreType.DMA((n_out,)),
+    )
+
+
 def _rows_eff_override():
     """QUEST_ROWS_EFF_BITS block-size experiment knob, parsed ONCE at
     import (mid-process changes are deliberately ignored: the value is
@@ -1585,6 +1837,28 @@ def _driver_override() -> str:
     return v
 
 
+def segment_geometry(stages: Sequence, n: int,
+                     rows_eff_bits: int | None = None) -> _Geometry:
+    """Block geometry of a compiled stage list — the rows_eff
+    resolution + stage_requirements accounting compile_segment sizes
+    its block from, factored out so the CPU-side schedule introspection
+    (pipeline_stats, sweep_vmem_bytes) derives step counts and slot
+    bytes from EXACTLY what the kernel will allocate, never a parallel
+    re-derivation."""
+    global _ROWS_EFF_BITS_EFFECTIVE
+    if rows_eff_bits is None:
+        if _ROWS_EFF_BITS_EFFECTIVE is None:
+            _ROWS_EFF_BITS_EFFECTIVE = _rows_eff_override()
+        rows_eff_bits = _ROWS_EFF_BITS_EFFECTIVE
+    total_row_bits = n - LANE_QUBITS
+    rows_eff_bits = min(rows_eff_bits, total_row_bits)
+    # block geometry from the shared requirements accounting (the same
+    # scat/floor contract sweep_plan merges under)
+    scat_bits, b1_bits = stage_requirements(stages)
+    rows_eff_bits = max(rows_eff_bits, b1_bits + len(scat_bits))
+    return _geometry(n, scat_bits, rows_eff_bits)
+
+
 def compile_segment(stages: Sequence, n: int,
                     rows_eff_bits: int | None = None,
                     interpret: bool = False, batch: int | None = None):
@@ -1599,18 +1873,7 @@ def compile_segment(stages: Sequence, n: int,
     kernel over (2, rows, 128). Block geometry, VMEM residency and the
     stage chain are per-state and unchanged; only BatchSelStage operands
     carry a per-state axis."""
-    global _ROWS_EFF_BITS_EFFECTIVE
-    if rows_eff_bits is None:
-        if _ROWS_EFF_BITS_EFFECTIVE is None:
-            _ROWS_EFF_BITS_EFFECTIVE = _rows_eff_override()
-        rows_eff_bits = _ROWS_EFF_BITS_EFFECTIVE
-    total_row_bits = n - LANE_QUBITS
-    rows_eff_bits = min(rows_eff_bits, total_row_bits)
-    # block geometry from the shared requirements accounting (the same
-    # scat/floor contract sweep_plan merges under)
-    scat_bits, b1_bits = stage_requirements(stages)
-    rows_eff_bits = max(rows_eff_bits, b1_bits + len(scat_bits))
-    geo = _geometry(n, scat_bits, rows_eff_bits)
+    geo = segment_geometry(stages, n, rows_eff_bits)
     dims, blocks = geo.view_dims()
     grid = tuple(1 << w for (lo, w) in geo.gaps)
     grid_axes = [i for i, b in enumerate(blocks) if b == 1]
@@ -1641,10 +1904,21 @@ def compile_segment(stages: Sequence, n: int,
         full_view, full_block, full_grid = view_shape, block_shape, grid
 
     if _driver_override() == "pipelined":
-        kernel = functools.partial(
-            _pipelined_kernel, stages=tuple(stages), geo=geo, grid=grid,
-            block_shape=block_shape, nbuf=NBUF, nbatch=nbatch,
-            batched=batched)
+        if pipeline_enabled():
+            # decoupled multi-buffer pipeline (default): separate
+            # in/out slot rings, independent DMA semaphore chains
+            kernel = functools.partial(
+                _decoupled_kernel, stages=tuple(stages), geo=geo,
+                grid=grid, block_shape=block_shape,
+                in_slots=PIPELINE_IN_SLOTS, out_slots=PIPELINE_OUT_SLOTS,
+                nbatch=nbatch, batched=batched)
+        else:
+            # legacy in-place slot schedule (QUEST_FUSED_PIPELINE=0 —
+            # the silicon A/B control)
+            kernel = functools.partial(
+                _pipelined_kernel, stages=tuple(stages), geo=geo,
+                grid=grid, block_shape=block_shape, nbuf=NBUF,
+                nbatch=nbatch, batched=batched)
         # the state stays in HBM; the kernel DMAs its own blocks through
         # the in-place slot buffers. Operands are whole-array VMEM.
         in_specs = [pl.BlockSpec(memory_space=_MEMSPACE.HBM)]

@@ -195,16 +195,144 @@ def stage_report(n: int = None, reps: int = 5, out=None) -> dict:
     return rec
 
 
+def sweep_dma_report(n: int = None, reps: int = 5, circuit=None,
+                     iters: int = None, out=None) -> dict:
+    """Per-sweep DMA-stream vs compute-time split of a fused plan ON
+    THE ATTACHED BACKEND — the host-side half of the pipeline's stall
+    attribution (ISSUE 11 profiling hook). For each kernel sweep of
+    the plan it measures
+
+      * the full sweep launch (stage chain under the decoupled
+        multi-buffer pipeline), and
+      * ONE stage-free copy kernel — the same slot/semaphore schedule
+        streaming the same state bytes with an empty stage chain: the
+        plan's raw HBM in+out DMA floor —
+
+    and reports per sweep `total_ms`, the shared `dma_ms` floor and
+    `compute_adder_ms = total - dma`. A sweep whose adder is ~0 is
+    DMA-bound (the pipeline hides its compute entirely); a large adder
+    says the MXU chain overruns the stream and is where the residual
+    stall lives. The IN-KERNEL attribution rides the named-scope
+    labels the decoupled driver wraps its DMA waits in
+    ('quest:dma_in_wait' / 'quest:dma_out_wait' / 'quest:stages',
+    pallas_band._decoupled_kernel) — capture with profiling.trace()
+    and the regions land on the chip timeline directly.
+
+    Defaults: the bench headline step (bench._build_circuit) unrolled
+    `iters` = INNER_STEPS applications, n = 30 on TPU / 12 on a CPU
+    host (where kernels run in the Pallas INTERPRETER — the command
+    exercises the path, the times are not chip constants; the report
+    says so loudly, like stage_report).
+
+    CLI: python -m quest_tpu.profiling --sweeps [--n N] [--reps R]"""
+    import sys
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quest_tpu.env import ensure_live_backend
+    from quest_tpu.ops import fusion as F
+    from quest_tpu.ops import pallas_band as PB
+    from quest_tpu.state import basis_planes, fused_state_shape
+
+    out = out or sys.stdout
+    ensure_live_backend()
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if n is None:
+        n = 30 if on_tpu else 12
+    if not PB.usable(n):
+        raise ValueError(f"n={n} is below the kernel tier's minimum")
+    interpret = not on_tpu
+    if circuit is None:
+        import bench
+        circuit = bench._build_circuit(n)
+        if iters is None:
+            iters = bench.INNER_STEPS
+    iters = iters or 1
+    print(f"[sweep_dma_report] backend={platform} n={n} reps={reps} "
+          f"iters={iters} pipeline="
+          f"{'decoupled' if PB.decoupled_active() else 'legacy'}",
+          file=out)
+    if interpret:
+        print("[sweep_dma_report] CAUTION: CPU host — kernels run in "
+              "the Pallas INTERPRETER; the split exercises the path "
+              "but the times are NOT chip constants.", file=out)
+
+    items = F.plan(circuit._planned_flat(n, False), n,
+                   bands=PB.plan_bands(n))
+    parts = PB.maybe_sweep(PB.segment_plan(items, n) * iters, n)
+
+    def time_launch(stages, arrays):
+        fn = PB.compile_segment(list(stages), n, interpret=interpret)
+        arrays = [jnp.asarray(a) for a in arrays]
+        jfn = jax.jit(lambda a: fn(a, arrays), donate_argnums=(0,))
+        amps = basis_planes(0, n=n, rdt=jnp.float32,
+                            shape=fused_state_shape(n))
+        amps = jfn(amps)
+        _ = np.asarray(amps[0, 0, :4])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            amps = jfn(amps)
+        _ = np.asarray(amps[0, 0, :4])
+        ms = (time.perf_counter() - t0) / reps * 1e3
+        del amps                 # one live full state at a time
+        return ms
+
+    # the plan's DMA floor: the identical slot schedule with an empty
+    # stage chain — same state bytes through the same rings. Measured
+    # once (block geometry differences between sweeps move the DMA
+    # stream second-order; the bytes are the whole state either way).
+    dma_ms = time_launch((), ())
+    rec = {"platform": platform, "n": n, "dma_ms": round(dma_ms, 2),
+           "sweeps": []}
+    print(f"[sweep_dma_report] DMA floor (stage-free copy kernel): "
+          f"{dma_ms:.2f} ms", file=out)
+    for i, part in enumerate(parts):
+        if part[0] != "segment":
+            rec["sweeps"].append({"sweep": i, "kind": "xla_passthrough"})
+            print(f"[sweep_dma_report] sweep {i}: XLA passthrough "
+                  f"(not a kernel launch)", file=out)
+            continue
+        ms = time_launch(part[1], part[2])
+        adder = max(0.0, ms - dma_ms)
+        rec["sweeps"].append({
+            "sweep": i, "kind": "kernel", "stages": len(part[1]),
+            "total_ms": round(ms, 2),
+            "compute_adder_ms": round(adder, 2),
+            # interpreter timings are not chip constants: the record
+            # mirrors the printed verdict and refuses a verdict off-chip
+            "dma_bound": None if interpret
+            else bool(adder <= 0.15 * dma_ms),
+        })
+        verdict = "DMA-bound" if adder <= 0.15 * dma_ms else \
+            f"compute overruns stream by {adder:.1f} ms"
+        if interpret:
+            verdict = "n/a (interpreter)"
+        print(f"[sweep_dma_report] sweep {i}: {len(part[1])} stages, "
+              f"{ms:8.2f} ms total, compute adder {adder:6.2f} ms   "
+              f"{verdict}", file=out)
+    return rec
+
+
 def _main():
     import argparse
 
     ap = argparse.ArgumentParser(description=stage_report.__doc__)
     ap.add_argument("--n", type=int, default=None)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--sweeps", action="store_true",
+                    help="per-sweep DMA-vs-compute split "
+                         "(sweep_dma_report) instead of the per-stage "
+                         "cost-model audit")
     args = ap.parse_args()
     from quest_tpu.env import ensure_live_backend
     ensure_live_backend()
-    stage_report(n=args.n, reps=args.reps)
+    if args.sweeps:
+        sweep_dma_report(n=args.n, reps=args.reps)
+    else:
+        stage_report(n=args.n, reps=args.reps)
 
 
 if __name__ == "__main__":

@@ -211,6 +211,68 @@ def test_chunked_limb_wide_band_unbalanced(force_limb, monkeypatch):
     np.testing.assert_allclose(got, base, atol=tol, rtol=0)
 
 
+def test_chunked_limb_narrow_chunk_below_band(force_limb, monkeypatch):
+    """Narrow-chunk floor regression (ISSUE 11): a QUEST_F64_CHUNK
+    SMALLER than the band dimension cannot split the band axis (the
+    contraction needs it whole), so _chunk_grid clamps to one band row
+    per chunk — the documented floor. The wide-band + narrow-chunk
+    combination must still reproduce the un-chunked numerics within
+    the justified 1e-13 envelope (the round-5 red test's bound: the
+    lax.map body reassociates the final f64 combine, ~5e-16 of the
+    state max measured; bit-equality is the wrong claim)."""
+    n = 12
+    w = 5                      # band = 32
+    rng = np.random.default_rng(17)
+    g = np.linalg.qr(rng.normal(size=(32, 32))
+                     + 1j * rng.normal(size=(32, 32)))[0]
+    amps = rng.normal(size=(2, 1 << n))
+    amps /= np.sqrt((amps ** 2).sum())
+    pair = (np.ascontiguousarray(g.real), np.ascontiguousarray(g.imag))
+    for ql in (0, 4, n - w):   # post-heavy, mixed, pre == 1
+        base = np.asarray(apply_band(jnp.asarray(amps), n, pair,
+                                     ql=ql, w=w))
+        # chunk = 16 elements < band = 32: the bound clamps to one
+        # band row — both split axes exhausted
+        monkeypatch.setenv("QUEST_F64_CHUNK", "16")
+        got = np.asarray(apply_band(jnp.asarray(amps), n, pair,
+                                    ql=ql, w=w))
+        monkeypatch.delenv("QUEST_F64_CHUNK")
+        tol = 1e-13 * np.abs(base).max()
+        np.testing.assert_allclose(got, base, atol=tol, rtol=0)
+
+
+def test_f64_capacity_stats_28q(monkeypatch):
+    """The f64-at-capacity sizing record (apply.f64_capacity_stats,
+    surfaced as plan_stats()['f64'] — docs/PRECISION.md): at the
+    default 2^24 chunk a 28q limb pass peaks at 2 x 4 GiB state +
+    1 GiB chunk temps = 9 GiB, UNDER the 15.75 GiB v5e budget — the
+    routing gate that lets bench.py attempt 28q f64 at all — while the
+    un-chunked working set (chunking off) exceeds it, reproducing the
+    measured probe_28q OOM."""
+    from quest_tpu.ops.apply import f64_capacity_stats
+
+    rec = f64_capacity_stats(28)
+    assert rec["state_bytes"] == 2 * 8 * (1 << 28)
+    assert rec["chunk_elems"] == 1 << 24
+    assert rec["peak_bytes"] == (2 * rec["state_bytes"]
+                                 + 4 * 2 * 8 * (1 << 24))
+    assert rec["fits_hbm"], rec
+    # chunking off: the ~4x-state working set that OOMed the chip
+    off = f64_capacity_stats(28, chunk_elems=0)
+    assert off["chunk_elems"] == 0
+    assert not off["fits_hbm"], off
+    # a chunk >= the state is effectively un-chunked too
+    big = f64_capacity_stats(28, chunk_elems=1 << 28)
+    assert big["chunk_elems"] == 0 and not big["fits_hbm"]
+    # the knob threads through (keyed: the record must track it)
+    monkeypatch.setenv("QUEST_F64_CHUNK", "4096")
+    assert f64_capacity_stats(28)["chunk_elems"] == 4096
+    monkeypatch.delenv("QUEST_F64_CHUNK")
+    # plan_stats surfaces the record at the circuit's register size
+    rec2 = random_circuit(10, depth=2, seed=1).plan_stats()["f64"]
+    assert rec2["n"] == 10 and rec2["fits_hbm"]
+
+
 def test_chunk_knob_in_cache_key(force_limb, monkeypatch):
     """QUEST_F64_CHUNK changes the traced program, so it must be part
     of the compiled-program cache key (circuit._engine_mode_key — the

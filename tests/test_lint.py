@@ -399,6 +399,46 @@ def test_comm_knobs_are_keyed_with_flips():
     assert parse("4") == 4
 
 
+def test_fused_pipeline_knob_registry_coverage(tmp_path):
+    """QUEST_FUSED_PIPELINE coverage of the registry rules (ISSUE 11):
+    a registry read (knob_value) on a Pallas-reachable path passes
+    QL001 because the knob is registered KEYED (compile_segment reads
+    it to pick the decoupled vs legacy slot driver); a direct
+    os.environ read of the same knob fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_FUSED_PIPELINE"):
+                return amps
+            return amps * 2
+
+        def configure():
+            return os.environ.get("QUEST_FUSED_PIPELINE")
+    """, name="pipelineknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and "bypasses" in q4[0].message, vs
+
+
+def test_fused_pipeline_knob_is_keyed_with_flips():
+    """The pipeline knob must stay keyed (it selects which kernel
+    driver a compiled segment lowers to — flipping it mid-process must
+    miss every circuit-level cache, the zero-retrace/flip-audit
+    contract of the A/B acceptance) and its parser must reject
+    malformed input loudly."""
+    from quest_tpu.env import KNOBS
+    k = KNOBS["QUEST_FUSED_PIPELINE"]
+    assert k.scope == "keyed" and k.layer == "kernel"
+    assert k.flips == ("1", "0")
+    assert k.default is True
+    with pytest.raises(ValueError):
+        k.parse(k.malformed)
+
+
 def test_serve_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
     serve knobs are RUNTIME scope — read once at ServeEngine

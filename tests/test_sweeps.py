@@ -376,6 +376,206 @@ def test_compiled_fused_cross_iteration_end_to_end():
                                atol=EPS_F32 * scale, rtol=0)
 
 
+# ---------------------------------------------------------------------------
+# decoupled multi-buffer pipeline (QUEST_FUSED_PIPELINE, ISSUE 11):
+# bit-identity vs the legacy in-place driver, schedule introspection,
+# and the slot/VMEM accounting
+# ---------------------------------------------------------------------------
+
+
+def _run_parts_fresh(parts, n: int, amps_planes: np.ndarray) -> np.ndarray:
+    """Execute a part list through a FRESH kernel cache — the knob
+    A/B below flips QUEST_FUSED_PIPELINE between runs, and
+    compile_segment_cached's key deliberately does not carry it (the
+    engines key their caches on engine_mode_key at circuit level), so
+    sharing _SEG_CACHE across the flip would hand back stale drivers."""
+    cache: dict = {}
+    out = jnp.asarray(amps_planes).reshape(2, -1, PB.LANES)
+    for part in parts:
+        fn = PB.compile_segment_cached(cache, tuple(part[1]), n,
+                                       interpret=True)
+        out = fn(out, part[2])
+    return np.asarray(out).reshape(2, -1)
+
+
+@pytest.mark.parametrize("tmpl", [0, 3, 6])
+def test_decoupled_pipeline_bit_identical_f32(tmpl, monkeypatch):
+    """The decoupled rings only reschedule DMA — the same _step_index
+    walk, the same stage chain, the same float ops per block — so the
+    output must be BIT-identical to the legacy in-place driver, not
+    merely close (interpret mode makes the comparison deterministic)."""
+    c = _template_circuit(N, tmpl, 0)
+    rng = np.random.default_rng(50 + tmpl)
+    amps = rng.standard_normal((2, 1 << N)).astype(np.float32)
+    swept = PB.sweep_plan(plan_parts(c) * 2, N)
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    got_new = _run_parts_fresh(swept, N, amps)
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "0")
+    got_old = _run_parts_fresh(swept, N, amps)
+    np.testing.assert_array_equal(got_new, got_old)
+
+
+def test_decoupled_pipeline_bit_identical_f64_limb(monkeypatch):
+    """f64 registers ride the banded fallback inside compiled_fused —
+    the pipeline knob must leave that path untouched bit-for-bit (it
+    only selects Pallas kernel drivers, which f64 never reaches)."""
+    c = _template_circuit(N, 8, 0)
+    rng = np.random.default_rng(60)
+    amps = rng.standard_normal((2, 1 << N)).astype(np.float64)
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    on = np.asarray(c.compiled_fused(N, density=False, donate=False,
+                                     interpret=True)(jnp.asarray(amps)))
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "0")
+    off = np.asarray(c.compiled_fused(N, density=False, donate=False,
+                                      interpret=True)(jnp.asarray(amps)))
+    np.testing.assert_array_equal(on, off)
+
+
+def test_decoupled_pipeline_bit_identical_batched(monkeypatch):
+    """B>1: the batched step space (batch slowest, blocks back-to-back)
+    through the decoupled rings matches the legacy driver shot-for-shot
+    — the batch quotient/idx_of walk is shared, so any divergence would
+    be a slot-schedule bug."""
+    c = _template_circuit(N, 1, 0)
+    rng = np.random.default_rng(61)
+    amps_b = rng.standard_normal((3, 2, 1 << N)).astype(np.float32)
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    on = np.asarray(c.compiled_batched(3, donate=False, interpret=True)(
+        jnp.asarray(amps_b)))
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "0")
+    off = np.asarray(c.compiled_batched(3, donate=False, interpret=True)(
+        jnp.asarray(amps_b)))
+    np.testing.assert_array_equal(on, off)
+
+
+def test_decoupled_pipeline_bit_identical_sharded(monkeypatch):
+    """2-device mesh: per-shard sweeps through the decoupled rings
+    match the legacy driver bit-for-bit (collectives are outside the
+    kernels and identical on both sides)."""
+    from quest_tpu.parallel.mesh import make_amp_mesh
+
+    n = 11
+    mesh = make_amp_mesh(2)
+    c = _template_circuit(n, 9, 0)
+    rng = np.random.default_rng(62)
+    amps = rng.standard_normal((2, 1 << n)).astype(np.float32)
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    on = np.asarray(c.compiled_sharded_fused(
+        n, density=False, mesh=mesh, donate=False, interpret=True)(
+        jnp.asarray(amps)))
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "0")
+    off = np.asarray(c.compiled_sharded_fused(
+        n, density=False, mesh=mesh, donate=False, interpret=True)(
+        jnp.asarray(amps)))
+    np.testing.assert_array_equal(on, off)
+
+
+def test_pipeline_stats_and_knob_off_bit_for_bit(monkeypatch):
+    """plan_stats()['fused'] reports the pipeline schedule CPU-side
+    when the decoupled driver is active, and QUEST_FUSED_PIPELINE=0
+    reproduces the legacy record BIT-FOR-BIT — same keys, same values,
+    no pipeline_* keys (the A/B control cannot drift; the CI gate in
+    scripts/check_sweep_golden.py runs the same comparison at 30q)."""
+    c = bench._build_circuit(16)
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    on = c.plan_stats()["fused"]
+    assert on["pipeline_in_slots"] == PB.PIPELINE_IN_SLOTS
+    assert on["pipeline_out_slots"] == PB.PIPELINE_OUT_SLOTS
+    assert on["pipeline_overlap_steps"] >= 0
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "0")
+    off = c.plan_stats()["fused"]
+    assert not any(k.startswith("pipeline_") for k in off)
+    assert off == {k: v for k, v in on.items()
+                   if not k.startswith("pipeline_")}
+
+
+def test_pipeline_overlap_on_headline_plan(monkeypatch):
+    """The 30q headline plan must schedule read-ahead: every sweep's
+    step count exceeds the in-ring, so pipeline_overlap_steps >= 1 —
+    the next block's DMA streams under the current block's stage loop
+    (mirrors the check_sweep_golden.py gate)."""
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    rec = bench._build_circuit(30).plan_stats()["fused"]
+    assert rec["pipeline_overlap_steps"] >= 1, rec
+
+
+def test_sweep_operand_budget_driver_aware(monkeypatch):
+    """sweep_plan's operand budget pays for the decoupled rings' extra
+    block slot: 40 MiB with the pipeline on, the original 48 MiB with
+    it off — so knob-off plans are the old plans exactly."""
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "1")
+    assert PB.sweep_operand_budget() == PB.PIPELINE_SWEEP_OPERAND_BYTES
+    monkeypatch.setenv("QUEST_FUSED_PIPELINE", "0")
+    assert PB.sweep_operand_budget() == PB.SWEEP_OPERAND_BYTES
+
+
+def test_sweep_vmem_accounting_adversarial(monkeypatch):
+    """The slot/VMEM accounting (sweep_vmem_bytes) never exceeds the
+    100 MiB scoped budget for ANY geometry the planner can produce:
+    max scattered axes, the b1 sublane floor + scattered mix at the
+    row-bit cap, and an operand-heavy sweep AT the operand budget —
+    each under both the decoupled and the legacy schedule. This is the
+    invariant that lets sweep_plan merge on byte budgets instead of
+    compiling to find out."""
+    n = 28      # deep enough that a full high band (scat bits 14..20)
+    # is a REAL geometry — the band must fit under the register top
+
+    def scb_stage(bit, d):
+        return PB.MatStage("scb", d, False, (), (), bit)
+
+    def dense_seg(stages):
+        return [np.zeros((2, max(st.dim, 2), max(st.dim, 2)),
+                         np.float32) for st in stages]
+
+    # 7 scattered axes (a full high band), the worst block geometry
+    worst_scat = [scb_stage(14, 128)]
+    # b1 floor + scattered bits at the row budget
+    b1 = PB.MatStage("b1", 128, False, (), ())
+    mixed = [b1] + [PB.MatStage("sc", 2, False, (), (), 12 + j)
+                    for j in range(PB.max_block_row_bits() - 7)]
+    # operand-heavy: dense 128x128 pairs right up to the operand budget
+    dense = [PB.MatStage("b0", 128, False, (), ())] * 64
+
+    for knob in ("1", "0"):
+        monkeypatch.setenv("QUEST_FUSED_PIPELINE", knob)
+        budget = PB.sweep_operand_budget()
+        for stages in (worst_scat, mixed, dense):
+            arrays = dense_seg(stages)
+            nbytes = sum(a.nbytes for a in arrays)
+            if nbytes > budget:      # sweep_plan would refuse to merge
+                continue             # past the budget; clamp like it
+            rec = PB.sweep_vmem_bytes(stages, arrays, n)
+            assert rec["total_bytes"] <= rec["budget_bytes"], \
+                (knob, len(stages), rec)
+        # the budget itself is sized so slots + a FULL operand budget
+        # still fit the scoped limit (the headroom claim of
+        # docs/SWEEPS.md "VMEM accounting")
+        rec = PB.sweep_vmem_bytes(worst_scat, dense_seg(worst_scat), n)
+        assert rec["slot_bytes"] + budget <= PB.VMEM_LIMIT_BYTES, \
+            (knob, rec, budget)
+
+
+def test_sweep_vmem_matches_planned_geometry():
+    """Every sweep the planner emits for random circuits satisfies the
+    accounting: sweep_steps/sweep_vmem_bytes derive from
+    segment_geometry — the SAME resolution compile_segment uses — so
+    a plan that passes the merge rule can always be compiled."""
+    rng = np.random.default_rng(7)
+    for n in (N, 17):
+        c = Circuit(n)
+        for _ in range(30):
+            q = int(rng.integers(0, n))
+            c.ry(q, float(rng.uniform(0, 2 * np.pi)))
+        for part in PB.sweep_plan(plan_parts(c, n), n):
+            if part[0] != "segment":
+                continue
+            rec = PB.sweep_vmem_bytes(part[1], part[2], n)
+            assert rec["total_bytes"] <= rec["budget_bytes"], rec
+            assert PB.sweep_steps(part[1], n) >= 1
+            assert PB.sweep_steps(part[1], n, batch=4) == \
+                4 * PB.sweep_steps(part[1], n)
+
+
 def test_explain_reports_sweeps(monkeypatch):
     monkeypatch.setenv("QUEST_SWEEP_FUSION", "1")
     c = bench._build_circuit(16)
