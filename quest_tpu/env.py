@@ -171,6 +171,32 @@ def _parse_fault_plan(raw: str):
     return faults.parse_plan(raw)
 
 
+def _parse_tenant_quota(raw: str):
+    # admission.py imports only stdlib + validation (numpy) — the lazy
+    # import cannot cycle back into env.py's module load
+    from quest_tpu.serve.admission import parse_tenant_quota
+    return parse_tenant_quota(raw)
+
+
+def _default_tenant_quota():
+    from quest_tpu.serve.admission import DEFAULT_TENANT_QUOTA
+    return {"default": DEFAULT_TENANT_QUOTA}
+
+
+def _parse_shed_threshold(raw: str) -> float:
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"QUEST_SERVE_SHED_THRESHOLD must be a float, got {raw!r}")
+    if not (0.0 < v <= 1.0):
+        raise ValueError(
+            f"QUEST_SERVE_SHED_THRESHOLD must be in (0, 1] — a fraction "
+            f"of fleet queue capacity (1.0 disables shedding below the "
+            f"hard queue bound), got {v}")
+    return v
+
+
 def _default_f64_mxu() -> bool:
     # on for TPU backends (native f64 dots are software-emulated there —
     # the measured 9 gates/s @ 26q wall, VERDICT r4), off elsewhere
@@ -367,6 +393,36 @@ _KNOB_LIST = (
              "its circuit breaker opens and requests step down the "
              "fused->banded->host degradation ladder (default: 3; "
              "docs/RESILIENCE.md)",
+         malformed="0"),
+    Knob("QUEST_SERVE_REPLICAS",
+         _int_range("QUEST_SERVE_REPLICAS", 1), 2,
+         scope="runtime", layer="serve",
+         doc="ServeEngine replicas a ServeFleet owns (program-key "
+             "affinity routing, fleet-level failover; default: 2; "
+             "docs/SERVING.md §fleet)",
+         malformed="0"),
+    Knob("QUEST_SERVE_TENANT_QUOTA", _parse_tenant_quota,
+         _default_tenant_quota,
+         scope="runtime", layer="serve",
+         doc="per-tenant pending-request quota for ServeFleet "
+             "admission: one integer (every tenant) or "
+             "'tenant=quota,...' with an optional default= entry "
+             "(default: 256; docs/SERVING.md §fleet)",
+         malformed="alice=lots"),
+    Knob("QUEST_SERVE_SHED_THRESHOLD", _parse_shed_threshold, 0.75,
+         scope="runtime", layer="serve",
+         doc="fleet pressure (queued fraction of healthy capacity + "
+             "open-breaker weight) above which the lowest priority "
+             "class load-sheds with typed ShedError (default: 0.75; "
+             "1.0 = shed only at the hard queue bound; "
+             "docs/SERVING.md §fleet)",
+         malformed="0"),
+    Knob("QUEST_SERVE_PRIORITIES",
+         _int_range("QUEST_SERVE_PRIORITIES", 1), 2,
+         scope="runtime", layer="serve",
+         doc="priority classes a ServeFleet accepts (submit priority= "
+             "in [0, N); higher sheds later — default: 2, a free/paying "
+             "pair; docs/SERVING.md §fleet)",
          malformed="0"),
     Knob("QUEST_FAULT_PLAN", _parse_fault_plan, None,
          scope="runtime", layer="serve",

@@ -81,8 +81,13 @@ class IntegrityError(validation.QuESTError):
     at this cut was NOT stamped (docs/RESILIENCE.md §durable)."""
 
 
-def _counter(name: str):
-    return _metrics.REGISTRY.counter(name)
+def _registry_of(registry: Optional[_metrics.Registry]
+                 ) -> _metrics.Registry:
+    return registry if registry is not None else _metrics.REGISTRY
+
+
+def _counter(name: str, registry: Optional[_metrics.Registry] = None):
+    return _registry_of(registry).counter(name)
 
 
 def _ops_sha(ops) -> str:
@@ -306,7 +311,7 @@ def _sentinel_values(amps, info: dict) -> dict:
 
 
 def _check_integrity(vals: dict, baseline: dict, tol: float,
-                     step) -> None:
+                     step, registry=None) -> None:
     for name, got in vals.items():
         ref = float(baseline.get(name, 0.0))
         # relative drift with a floor of 1: registers need not be
@@ -315,7 +320,7 @@ def _check_integrity(vals: dict, baseline: dict, tol: float,
         # unit-scale invariants (norm/trace of normalized states)
         drift = abs(got - ref) / max(1.0, abs(ref))
         if not (drift <= tol):           # NaN-safe: NaN fails the <=
-            _counter("durable_sentinel_trips").inc()
+            _counter("durable_sentinel_trips", registry).inc()
             raise IntegrityError(
                 f"Integrity sentinel tripped at step {step}: {name} = "
                 f"{got!r}, baseline {ref!r}, drift beyond the "
@@ -347,7 +352,7 @@ def _validate_cursor(cursor: dict, want: dict, path: str) -> None:
                 f"directory to restart from op 0)")
 
 
-def _latest_valid(directory: str, kind: str):
+def _latest_valid(directory: str, kind: str, registry=None):
     """Newest checkpoint under `directory` that loads AND digests
     cleanly, scanning newest -> oldest: corrupt or unreadable entries
     are skipped LOUDLY (stderr + counter) in favor of older ones —
@@ -376,7 +381,8 @@ def _latest_valid(directory: str, kind: str):
             # its documented contract is that the resume chain SKIPS to
             # an older checkpoint, so the injected failure must prove
             # the fallback, not take the run down
-            _counter("durable_corrupt_checkpoints_skipped").inc()
+            _counter("durable_corrupt_checkpoints_skipped",
+                     registry).inc()
             print(f"[durable] SKIPPING corrupt checkpoint {path!r} "
                   f"({e}); falling back to the previous one",
                   file=sys.stderr, flush=True)
@@ -403,7 +409,8 @@ def _clear_chain(directory: str) -> None:
 
 def run_durable(circuit, state: Qureg, directory: str, *,
                 every: int = None, engine: str = None, mesh=None,
-                interpret: bool = False, keep: int = None) -> Qureg:
+                interpret: bool = False, keep: int = None,
+                registry: Optional[_metrics.Registry] = None) -> Qureg:
     """Apply `circuit` to `state` durably: execute the engine's own
     launch plan step by step, checkpoint planes + cursor every `every`
     steps (default QUEST_DURABLE_EVERY) under `directory`, and — when a
@@ -422,7 +429,11 @@ def run_durable(circuit, state: Qureg, directory: str, *,
     channels run through the density engines as usual; for trajectory
     unraveling use run_durable_trajectories. Integrity sentinels run at
     checkpoint cadence (QUEST_INTEGRITY / QUEST_INTEGRITY_TOL); a
-    completed run removes its own checkpoint chain."""
+    completed run removes its own checkpoint chain. `registry` redirects
+    the durable_* metrics (default: the process-wide
+    serve.metrics.REGISTRY) — the serve fleet's replicas pass their own
+    registry so a fleet soak's durable tallies ride the same snapshot
+    as its fleet_* metrics."""
     from quest_tpu.env import knob_value
 
     if circuit.num_qubits != state.num_qubits:
@@ -455,7 +466,7 @@ def run_durable(circuit, state: Qureg, directory: str, *,
         "state_fp": _state_fingerprint(state),
     }
     start, baseline = 0, None
-    found = _latest_valid(directory, "state")
+    found = _latest_valid(directory, "state", registry)
     if found is not None:
         meta, arrays, cursor, path = found
         _validate_cursor(cursor, want, path)
@@ -470,7 +481,7 @@ def run_durable(circuit, state: Qureg, directory: str, *,
         amps = _to_layout(planes.astype(state.real_dtype), info)
         start = step
         baseline = cursor.get("baseline")
-        _counter("durable_resumes").inc()
+        _counter("durable_resumes", registry).inc()
     else:
         amps = _to_layout(state.amps, info)
     if baseline is None and integrity:
@@ -481,7 +492,7 @@ def run_durable(circuit, state: Qureg, directory: str, *,
             faults.check("durable.step", step=i, engine=engine)
             faults.check("durable.preempt", step=i, engine=engine)
         amps = steps[i](amps)
-        _counter("durable_steps_run").inc()
+        _counter("durable_steps_run", registry).inc()
         done = i + 1
         if done % every == 0 and done < len(steps):
             # drain the async step queue BEFORE the checkpoint timer:
@@ -492,27 +503,27 @@ def run_durable(circuit, state: Qureg, directory: str, *,
             t0 = _time.perf_counter()
             if integrity:
                 _check_integrity(_sentinel_values(amps, info), baseline,
-                                 tol, done)
+                                 tol, done, registry)
             cursor = dict(want, kind="state", step=done,
                           perm=_cut_perm(info, done), baseline=baseline)
             ckpt.save_step(directory, done,
                            qureg=state.replace_amps(
                                _from_layout(amps, info)),
                            extra=cursor, keep=keep)
-            _counter("durable_checkpoints_saved").inc()
-            _metrics.REGISTRY.gauge("durable_last_checkpoint_step").set(
+            _counter("durable_checkpoints_saved", registry).inc()
+            _registry_of(registry).gauge("durable_last_checkpoint_step").set(
                 done)
             # per-cut cost (sentinel + host gather + atomic write):
             # bench.py's durable scenario derives its overhead fraction
             # from this histogram — one instrumented run instead of a
             # noisy wall-clock A/B difference
-            _metrics.REGISTRY.histogram("durable_checkpoint_s").observe(
+            _registry_of(registry).histogram("durable_checkpoint_s").observe(
                 _time.perf_counter() - t0)
     if integrity:
         # the run's exit gate: a durable run must never RETURN a
         # corrupt state silently either — same sentinel, same budget
         _check_integrity(_sentinel_values(amps, info), baseline, tol,
-                         "final")
+                         "final", registry)
     out = state.replace_amps(_from_layout(amps, info))
     _clear_chain(directory)
     return out
@@ -536,7 +547,8 @@ def _key_fingerprint(key) -> str:
 def run_durable_trajectories(circuit, key, shots: int, directory: str, *,
                              every: int = None, chunk: int = None,
                              engine: str = None, interpret: bool = False,
-                             keep: int = None):
+                             keep: int = None,
+                             registry: Optional[_metrics.Registry] = None):
     """Durable counterpart of trajectories.run_batched: run `shots`
     stochastic trajectories of a noisy Circuit in the SAME bucket-sized
     chunks run_batched would dispatch (trajectories._bucket_for), and
@@ -592,14 +604,14 @@ def run_durable_trajectories(circuit, key, shots: int, directory: str, *,
     planes_acc: list = []
     draws_acc: list = []
     shots_done = 0
-    found = _latest_valid(directory, "traj")
+    found = _latest_valid(directory, "traj", registry)
     if found is not None:
         meta, arrays, cursor, path = found
         _validate_cursor(cursor, want, path)
         shots_done = int(cursor["shots_done"])
         planes_acc.append(np.asarray(arrays["planes"]))
         draws_acc.append(np.asarray(arrays["draws"]))
-        _counter("durable_resumes").inc()
+        _counter("durable_resumes", registry).inc()
 
     chunks_done = 0
     for lo in range(shots_done, shots, bucket):
@@ -611,7 +623,7 @@ def run_durable_trajectories(circuit, key, shots: int, directory: str, *,
         planes, draws = T._dispatch_chunk(fn, keys, lo, bucket)
         planes_acc.append(np.asarray(planes))
         draws_acc.append(np.asarray(draws))
-        _counter("durable_steps_run").inc()
+        _counter("durable_steps_run", registry).inc()
         shots_done = min(lo + bucket, shots)
         chunks_done += 1
         if chunks_done % every == 0 and shots_done < shots:
@@ -625,16 +637,16 @@ def run_durable_trajectories(circuit, key, shots: int, directory: str, *,
                 worst = int(np.argmax(np.abs(norms - 1.0)))
                 _check_integrity(
                     {"norm": float(norms[worst])}, {"norm": 1.0}, tol,
-                    f"shot {worst} (of {shots_done} done)")
+                    f"shot {worst} (of {shots_done} done)", registry)
             cursor = dict(want, kind="traj", shots_done=shots_done)
             ckpt.save_step(directory, shots_done,
                            arrays={"planes": all_planes,
                                    "draws": all_draws},
                            extra=cursor, keep=keep)
-            _counter("durable_checkpoints_saved").inc()
-            _metrics.REGISTRY.gauge("durable_last_checkpoint_step").set(
+            _counter("durable_checkpoints_saved", registry).inc()
+            _registry_of(registry).gauge("durable_last_checkpoint_step").set(
                 shots_done)
-            _metrics.REGISTRY.histogram("durable_checkpoint_s").observe(
+            _registry_of(registry).histogram("durable_checkpoint_s").observe(
                 _time.perf_counter() - t0)
     planes = (planes_acc[0] if len(planes_acc) == 1
               else np.concatenate(planes_acc, axis=0))
@@ -646,6 +658,6 @@ def run_durable_trajectories(circuit, key, shots: int, directory: str, *,
         norms = np.sum(planes.astype(np.float32) ** 2, axis=(1, 2))
         worst = int(np.argmax(np.abs(norms - 1.0)))
         _check_integrity({"norm": float(norms[worst])}, {"norm": 1.0},
-                         tol, f"final (shot {worst})")
+                         tol, f"final (shot {worst})", registry)
     _clear_chain(directory)
     return jnp.asarray(planes), jnp.asarray(draws)

@@ -77,6 +77,20 @@ SITES = (
                             # plans so soaks can kill a run at seeded
                             # boundaries without disturbing step-fault
                             # rules (docs/RESILIENCE.md §durable)
+    "fleet.route",          # ServeFleet routing decision (ctx carries
+                            # program key, chosen replica, tenant,
+                            # priority) — an armed error surfaces in
+                            # the submitter, so soaks can fail routing
+                            # deterministically
+    "fleet.failover",       # fleet-level failover requeue of a dead
+                            # replica's request onto a survivor (ctx:
+                            # replica, target) — an armed error fails
+                            # that request's future typed
+    "fleet.shed",           # the shed decision point: fires when
+                            # pressure crosses the threshold and a
+                            # victim is about to shed (ctx: pressure,
+                            # priority, evict) — soaks can force the
+                            # decision path deterministically
 )
 
 

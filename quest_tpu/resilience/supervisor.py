@@ -58,6 +58,13 @@ class Supervisor:
             return 0.0
         return delay + self._rng.uniform(0.0, self.jitter_frac * delay)
 
+    @property
+    def remaining(self) -> int:
+        """Restarts left in the consecutive-crash budget right now —
+        the per-replica health figure ServeFleet.stats() surfaces so an
+        operator can see which replica is one crash from FAILED."""
+        return max(0, self.max_restarts - self.restarts)
+
     def record_success(self) -> None:
         """A healthy work cycle completed: reset the consecutive-crash
         count so one crash per hour never exhausts a budget meant to

@@ -25,8 +25,13 @@ from quest_tpu.serve.warmup import default_buckets, warmup  # noqa: F401,E402
 
 _LAZY = {
     "ServeEngine": ("quest_tpu.serve.engine", "ServeEngine"),
+    "ServeFleet": ("quest_tpu.serve.fleet", "ServeFleet"),
     "RejectedError": ("quest_tpu.serve.admission", "RejectedError"),
     "DeadlineExceeded": ("quest_tpu.serve.admission", "DeadlineExceeded"),
+    "ShedError": ("quest_tpu.serve.admission", "ShedError"),
+    "TenantQuota": ("quest_tpu.serve.admission", "TenantQuota"),
+    "TenantQuotaExceeded": ("quest_tpu.serve.admission",
+                            "TenantQuotaExceeded"),
     "AdmissionController": ("quest_tpu.serve.admission",
                             "AdmissionController"),
 }

@@ -1,7 +1,8 @@
 """Admission control for the serving engine: loud overflow, deadlines,
-cancellation — the robustness half of `quest_tpu.serve` (docs/SERVING.md).
+cancellation — plus the fleet's tenancy policies (quota + priority
+shed) — the robustness half of `quest_tpu.serve` (docs/SERVING.md).
 
-Contracts (tests/test_serve.py pins each):
+Contracts (tests/test_serve.py and tests/test_fleet.py pin each):
 
   * bounded queue — at most `QUEST_SERVE_MAX_QUEUE` requests may be
     pending across the engine's queues; the overflowing submit raises
@@ -16,12 +17,22 @@ Contracts (tests/test_serve.py pins each):
   * cancellation — `Future.cancel()` succeeds exactly while the request
     is queued (not yet dispatched); the sweep drops cancelled requests
     without charging a launch.
+  * tenant quotas — `TenantQuota` bounds each tenant's PENDING requests
+    across the fleet (`QUEST_SERVE_TENANT_QUOTA`): one tenant's burst
+    can never occupy the whole bounded queue and starve everyone else;
+    the overflowing submit raises `TenantQuotaExceeded` naming the
+    tenant and its quota.
+  * priority shed — under fleet pressure the LOWEST priority class
+    sheds first, with `ShedError` naming the pressure cause; a
+    higher-priority submit may evict a queued lower-priority request
+    (docs/SERVING.md §fleet — the strictly-before-paying-deadlines
+    contract lives in serve/fleet.py, the typed errors live here).
 """
 
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from quest_tpu.validation import QuESTError
 
@@ -35,6 +46,120 @@ class RejectedError(QuESTError):
 class DeadlineExceeded(QuESTError):
     """The request's deadline elapsed before dispatch; it was failed
     without occupying a slot in any launch."""
+
+
+class TenantQuotaExceeded(RejectedError):
+    """The submitting tenant already has its quota's worth of pending
+    requests in the fleet (QUEST_SERVE_TENANT_QUOTA): the request was
+    rejected so one tenant's burst cannot occupy the whole bounded
+    queue. A RejectedError subclass — generic backoff handling keeps
+    working; the message names the tenant and quota."""
+
+
+class ShedError(RejectedError):
+    """The request was LOAD-SHED: fleet pressure (queue depth + open
+    breakers, docs/SERVING.md §fleet) crossed QUEST_SERVE_SHED_THRESHOLD
+    and this request sat in the lowest pending priority class. The
+    message names the pressure cause. A RejectedError subclass —
+    shedding is a rejection, just a prioritized one."""
+
+
+# the quota every tenant gets when QUEST_SERVE_TENANT_QUOTA names no
+# default= entry (and the knob's registered default — env.py reads it
+# from here so the two can never drift)
+DEFAULT_TENANT_QUOTA = 256
+
+
+def parse_tenant_quota(raw: str) -> Dict[str, int]:
+    """Parse a QUEST_SERVE_TENANT_QUOTA spec (the knob's registered
+    parser; raises ValueError on malformed input).
+
+    Grammar: either one integer — the default per-tenant quota for
+    every tenant — or a comma list of `tenant=quota` entries with an
+    optional `default=` entry (absent: DEFAULT_TENANT_QUOTA, so a spec
+    naming only specific tenants still yields a usable table):
+
+        QUEST_SERVE_TENANT_QUOTA="64"
+        QUEST_SERVE_TENANT_QUOTA="alice=16,bob=128,default=64"
+
+    Returns {tenant_or_'default': quota}, always carrying 'default'.
+    Named quotas may be 0 (that tenant is blocked outright); the
+    default must be >= 1 (a fleet that admits nobody is a
+    misconfiguration, not a policy)."""
+    raw = raw.strip()
+    out: Dict[str, int] = {}
+    if "=" not in raw:
+        out["default"] = _quota_int("default", raw)
+        return out
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"QUEST_SERVE_TENANT_QUOTA entry {part!r} is not "
+                f"tenant=quota (or a single default integer)")
+        name, val = (s.strip() for s in part.split("=", 1))
+        if not name:
+            raise ValueError(
+                f"QUEST_SERVE_TENANT_QUOTA entry {part!r} has an empty "
+                f"tenant name")
+        if name in out:
+            raise ValueError(
+                f"QUEST_SERVE_TENANT_QUOTA names tenant {name!r} twice")
+        out[name] = _quota_int(name, val)
+    if out.get("default", 1) < 1:
+        raise ValueError(
+            "QUEST_SERVE_TENANT_QUOTA default quota must be >= 1 (a "
+            "fleet that admits nobody is a misconfiguration); block "
+            "individual tenants with name=0 instead")
+    out.setdefault("default", DEFAULT_TENANT_QUOTA)
+    return out
+
+
+def _quota_int(name: str, raw: str) -> int:
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"QUEST_SERVE_TENANT_QUOTA quota for {name!r} must be an "
+            f"integer, got {raw!r}")
+    if v < 0 or (name == "default" and v < 1):
+        raise ValueError(
+            f"QUEST_SERVE_TENANT_QUOTA quota for {name!r} must be "
+            f">= {1 if name == 'default' else 0}, got {v}")
+    return v
+
+
+class TenantQuota:
+    """Per-tenant pending-request bound (the fleet's admission layer).
+
+    `table` is the parse_tenant_quota dict: named quotas win, the
+    'default' entry covers everyone else. Like AdmissionController this
+    class only DECIDES — the fleet holds the lock and the pending
+    counts; `admit()` raises `TenantQuotaExceeded` when one more
+    request would take `tenant` over its quota."""
+
+    def __init__(self, table: Dict[str, int]):
+        self.table = dict(table)
+        self.table.setdefault("default", DEFAULT_TENANT_QUOTA)
+        if self.table["default"] < 1:
+            raise ValueError(
+                f"tenant-quota default must be >= 1, got "
+                f"{self.table['default']}")
+
+    def quota_of(self, tenant: str) -> int:
+        return self.table.get(tenant, self.table["default"])
+
+    def admit(self, tenant: str, pending: int) -> None:
+        quota = self.quota_of(tenant)
+        if pending + 1 > quota:
+            raise TenantQuotaExceeded(
+                f"Invalid operation: tenant {tenant!r} already has "
+                f"{pending} pending request(s) >= its quota {quota} "
+                f"(QUEST_SERVE_TENANT_QUOTA); the request was rejected "
+                f"so one tenant cannot occupy the whole queue — back "
+                f"off and resubmit (docs/SERVING.md §fleet).")
 
 
 class AdmissionController:

@@ -94,13 +94,13 @@ DEFAULT_LADDER = ("fused", "banded", "host")
 class _Request:
     __slots__ = ("future", "kind", "state", "shots", "key", "observable",
                  "expiry", "submit_t", "states", "started", "dispatched",
-                 "retries")
+                 "retries", "durable_dir", "durable_every")
 
     def __init__(self, kind, state, shots, key, observable, expiry,
-                 submit_t, states):
+                 submit_t, states, durable_dir=None):
         self.future: Future = Future()
-        self.kind = kind                  # 'apply' | 'traj'
-        self.state = state                # numpy planes (apply)
+        self.kind = kind                  # 'apply' | 'traj' | 'durable'
+        self.state = state                # numpy planes (apply/durable)
         self.shots = shots                # int (traj)
         self.key = key                    # jax PRNG key (traj)
         self.observable = observable
@@ -110,6 +110,8 @@ class _Request:
         self.started = False              # future transitioned RUNNING
         self.dispatched = False           # a launch containing it began
         self.retries = 0                  # failed launch attempts ridden
+        self.durable_dir = durable_dir    # checkpoint chain (durable)
+        self.durable_every = None         # per-job checkpoint cadence
 
 
 def traj_dispatch_bucket(total: int, max_batch: int) -> int:
@@ -164,7 +166,9 @@ class ServeEngine:
     `registry` redirects metrics (default: the process-wide one);
     `backoff_base_s`/`breaker_cooldown_s` tune the recovery timings
     (tests zero/shrink them); `ladder` overrides the degradation
-    ladder (docs/RESILIENCE.md)."""
+    ladder (docs/RESILIENCE.md); `name` labels this engine in every
+    fault-site context it fires (`ctx["replica"]`) so fleet soaks can
+    target one replica deterministically (docs/SERVING.md §fleet)."""
 
     def __init__(self, *, max_wait_ms: Optional[float] = None,
                  max_queue: Optional[int] = None,
@@ -176,7 +180,8 @@ class ServeEngine:
                  backoff_base_s: float = 0.05,
                  breaker_threshold: Optional[int] = None,
                  breaker_cooldown_s: float = 0.5,
-                 ladder: Optional[Tuple[str, ...]] = None):
+                 ladder: Optional[Tuple[str, ...]] = None,
+                 name: Optional[str] = None):
         from quest_tpu.env import knob_value
         if max_wait_ms is None:
             max_wait_ms = knob_value("QUEST_SERVE_MAX_WAIT_MS")
@@ -198,6 +203,7 @@ class ServeEngine:
         if bad:
             raise ValueError(f"unknown ladder engine(s) {bad}; the rungs "
                              f"are {list(DEFAULT_LADDER)}")
+        self.name = name
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_batch = int(max_batch)
         self.interpret = bool(interpret)
@@ -253,7 +259,9 @@ class ServeEngine:
     def submit(self, circuit, state=None, shots: Optional[int] = None, *,
                key=None, deadline_s: Optional[float] = None,
                observable: Optional[Callable] = None,
-               density: bool = False) -> Future:
+               density: bool = False,
+               durable_dir: Optional[str] = None,
+               durable_every: Optional[int] = None) -> Future:
         """Enqueue one request; returns a `concurrent.futures.Future`.
 
         Exactly one of `state` / `shots`:
@@ -281,6 +289,22 @@ class ServeEngine:
             resolves to (planes, draws) — or (observable(planes),
             draws).
 
+        `durable_dir` routes a `state=` request through the durable
+        executor at the worker (`resilience.durable.run_durable`,
+        docs/RESILIENCE.md §durable): the circuit runs step-by-step at
+        the engine's own launch boundaries, checkpointing its planes +
+        cursor under `durable_dir` every QUEST_DURABLE_EVERY steps. A
+        worker crash or an injected `durable.preempt` kill mid-job
+        RESUMES the job from its checkpoint chain instead of failing
+        the future (the resume contract makes re-dispatch safe — no
+        double-serve is possible when the retry is bit-identical), and
+        the future resolves to the final planes exactly like a plain
+        apply request. `durable_every` overrides the job's checkpoint
+        cadence (default QUEST_DURABLE_EVERY — size it to the job's
+        failure rate, not its step count). Durable requests never
+        coalesce with batched apply requests and are incompatible with
+        `observable=` (the planes ARE the resume payload).
+
         `deadline_s` is relative: a request still queued when it
         elapses fails with DeadlineExceeded before any launch. Raises
         `RejectedError` when the bounded queue is full, after `close()`
@@ -290,6 +314,20 @@ class ServeEngine:
             raise ValueError(
                 "submit() takes exactly one of state= (apply request) "
                 "or shots= (trajectory request)")
+        if durable_dir is not None:
+            if state is None:
+                raise ValueError(
+                    "durable_dir= requires a state= request: durable "
+                    "trajectory serving is not supported — call "
+                    "resilience.run_durable_trajectories directly "
+                    "(docs/RESILIENCE.md §durable)")
+            if observable is not None:
+                raise ValueError(
+                    "durable_dir= is incompatible with observable=: "
+                    "the full planes are the job's resume payload "
+                    "(docs/RESILIENCE.md §durable)")
+        elif durable_every is not None:
+            raise ValueError("durable_every= requires durable_dir=")
         if observable is not None and not callable(observable):
             # a Pauli-sum spec (expec.PauliSum or a (codes, coeffs)
             # pair) resolves HERE — at admission, so a width mismatch
@@ -314,9 +352,17 @@ class ServeEngine:
             qkey = circuit.program_key(density=density,
                                        interpret=self.interpret,
                                        dtype=state.dtype)
+            if durable_dir is not None:
+                # durable jobs get their own queue family: they run one
+                # at a time through run_durable, never through the
+                # batched launch path, so they must not coalesce with
+                # plain apply requests for the same circuit
+                kind = "durable"
+                qkey = qkey + ("durable",)
             req = _Request(kind, state, None, None, observable,
                            self._admission.expiry_of(deadline_s, now),
-                           now, 1)
+                           now, 1, durable_dir=durable_dir)
+            req.durable_every = durable_every
             engine_name = None
         else:
             from quest_tpu import trajectories as T
@@ -384,6 +430,35 @@ class ServeEngine:
             self._cond.notify_all()
         self.registry.counter("serve_requests_submitted").inc()
         return req.future
+
+    def reap_cancelled(self) -> int:
+        """Drop CANCELLED requests from the queues NOW, fixing the
+        pending accounting (thread-safe). The worker's own sweep does
+        this at its next wake; the fleet's shed eviction calls this
+        synchronously so the evicted slot is reusable by the evicting
+        submit — otherwise, at the hard queue bound, the victim would
+        shed while the evictor still saw a full queue and was rejected
+        (losing both). Cancelled futures are already resolved, so
+        nothing here needs the outside-the-lock completion path; the
+        cancel tally happens here so the worker's later sweep cannot
+        double-count."""
+        dropped = 0
+        with self._cond:
+            for qkey in list(self._queues):
+                q = self._queues[qkey]
+                live = [r for r in q.requests
+                        if not r.future.cancelled()]
+                n = len(q.requests) - len(live)
+                if n:
+                    q.requests = deque(live)
+                    q.pending_states = sum(r.states for r in live)
+                    self._pending -= n
+                    dropped += n
+                    self.registry.counter(
+                        "serve_requests_cancelled").inc(n)
+                if not q.requests:
+                    del self._queues[qkey]
+        return dropped
 
     def drain(self, timeout_s: Optional[float] = None) -> None:
         """Flush every queued request NOW (partial buckets included)
@@ -453,9 +528,11 @@ class ServeEngine:
         """Hot-path fault hook: call sites guard with `if _F.ACTIVE:` so
         an empty plan costs one module-attribute read. A firing site is
         tallied before the error propagates into whichever recovery
-        path owns that site."""
+        path owns that site. Every context carries `replica` (this
+        engine's `name`, None standalone), so a fleet-scoped plan can
+        `match` one replica's sites deterministically."""
         try:
-            _F.check(site, **ctx)
+            _F.check(site, replica=self.name, **ctx)
         except BaseException:
             self.registry.counter("serve_faults_injected").inc()
             raise
@@ -518,16 +595,21 @@ class ServeEngine:
         request that never reached dispatch (it will be retried
         bit-identically), collect the rest for typed failure outside
         the lock (their launch outcome is unknown — retrying could
-        double-serve). Resets the in-flight accounting."""
+        double-serve). DURABLE requests requeue even after dispatch:
+        run_durable's resume contract makes the retry land on the
+        checkpoint chain and finish bit-identical to an uninterrupted
+        run, so re-dispatch can never double-serve (docs/RESILIENCE.md
+        §durable). Resets the in-flight accounting."""
         doomed: List[Tuple[_Request, BaseException]] = []
         for q, reqs in self._active:
             retry = []
             for r in reqs:
                 if r.future.done():
                     continue
-                if r.dispatched:
+                if r.dispatched and r.kind != "durable":
                     doomed.append((r, exc))
                 else:
+                    r.dispatched = False
                     retry.append(r)
             if retry:
                 self._requeue_locked(q, retry)
@@ -635,8 +717,10 @@ class ServeEngine:
                     self._active_failed = []
                     self._cond.notify_all()
             for q, reqs in batches:
-                self._dispatch(q, reqs)   # never raises: failures are
-                #                           split/isolated/typed inside
+                # raises ONLY for an exhausted durable resume loop
+                # (deliberate escalation into the supervised restart);
+                # every other failure is split/isolated/typed inside
+                self._dispatch(q, reqs)
                 with self._cond:
                     self._inflight -= 1
                     self._active.remove((q, reqs))
@@ -756,7 +840,88 @@ class ServeEngine:
         reqs = self._start(reqs)
         if not reqs:
             return
+        if q.kind == "durable":
+            # durable jobs bypass the splitter: each runs alone through
+            # run_durable with its own bounded resume-retry loop, and an
+            # exhausted loop RAISES (the one dispatch path that does) to
+            # escalate into the supervised-restart machinery — the
+            # request stays in the _active ledger and requeues
+            self._dispatch_durable(q, reqs)
+            return
         self._dispatch_split(q, reqs, depth=0)
+
+    # in-place resume attempts per durable dispatch before the failure
+    # escalates to a worker crash (supervised restart -> FAILED ->
+    # fleet failover, docs/SERVING.md §fleet); each attempt re-enters
+    # run_durable, which resumes from the newest checkpoint
+    DURABLE_RETRY_CAP = 3
+
+    def _dispatch_durable(self, q: _Queue, reqs: List[_Request]) -> None:
+        """Run each durable request through the durable executor
+        (docs/RESILIENCE.md §durable). Failure ladder, cheapest first:
+
+          * typed job errors (DurableError / IntegrityError /
+            CheckpointError / OSError / ValueError / TypeError) fail
+            ONLY that request's future — retrying a cursor mismatch, a
+            tripped sentinel, or an unwritable durable_dir would fail
+            identically, and escalating one would crash-loop EVERY
+            replica in turn (one tenant's bad path must never become a
+            fleet-wide outage; a genuinely transient IO blip is served
+            by resubmitting — the chain resumes);
+          * anything else (an injected `durable.preempt` kill, a device
+            fault) retries IN PLACE up to DURABLE_RETRY_CAP attempts —
+            run_durable resumes from the checkpoint chain, so a retry
+            is a resume, not a re-run;
+          * an exhausted retry loop RAISES, escalating to the
+            supervised-restart path: the request requeues (durable
+            requests are resume-safe after dispatch, _recover_locked)
+            and, once this replica's restart budget is gone, fails over
+            to a fleet survivor that resumes the same chain.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from quest_tpu.checkpoint import CheckpointError
+        from quest_tpu.resilience.durable import (DurableError,
+                                                  IntegrityError,
+                                                  run_durable)
+        from quest_tpu.state import Qureg
+
+        t_pop = time.monotonic()
+        for r in reqs:
+            if r.future.done():
+                continue
+            r.dispatched = True
+            attempts = 0
+            while True:
+                try:
+                    if _F.ACTIVE:
+                        self._fault("serve.dispatch", reqs=[r],
+                                    durable=True)
+                    reg = Qureg(amps=jnp.asarray(r.state),
+                                num_qubits=q.circuit.num_qubits,
+                                is_density=q.density)
+                    out = run_durable(q.circuit, reg, r.durable_dir,
+                                      every=r.durable_every,
+                                      interpret=self.interpret,
+                                      registry=self.registry)
+                    self._record_batch([r], 1.0, t_pop)
+                    self.registry.counter("serve_durable_jobs").inc()
+                    self._finish_one(r, np.asarray(
+                        jax.device_get(out.amps)))
+                    break
+                except BaseException as e:  # noqa: BLE001 - laddered
+                    self.registry.counter("serve_launch_failures").inc()
+                    if isinstance(e, (DurableError, IntegrityError,
+                                      CheckpointError, OSError,
+                                      ValueError, TypeError)):
+                        self._fail_request(r, e)
+                        break
+                    attempts += 1
+                    if attempts >= self.DURABLE_RETRY_CAP:
+                        raise
+                    self.registry.counter(
+                        "serve_durable_inplace_resumes").inc()
 
     def _dispatch_split(self, q: _Queue, reqs: List[_Request],
                         depth: int) -> None:

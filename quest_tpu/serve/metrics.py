@@ -35,6 +35,14 @@ Histograms keep a bounded reservoir (the most recent `RESERVOIR`
 observations) plus exact count/sum: percentiles are over the recent
 window — the figure a serving dashboard wants — while count/mean stay
 exact for the whole process lifetime.
+
+`Registry.scrape()` renders the same metrics as Prometheus text-format
+exposition (histograms as summaries), and
+`python -m quest_tpu.serve.metrics --port 9464` serves it at /metrics
+for a real scraper; `parse_scrape` round-trips the text back into the
+snapshot schema (scripts/serve_stats.py accepts either). The fleet
+layer (docs/SERVING.md §fleet) records its fleet_/tenant_/shed_ series
+here too.
 """
 
 from __future__ import annotations
@@ -188,6 +196,41 @@ class Registry:
                            for n, h in sorted(histograms.items())},
         }
 
+    def scrape(self) -> str:
+        """Prometheus text-format exposition (format 0.0.4) of every
+        metric in this registry — what `python -m quest_tpu.serve.metrics
+        --port` serves at /metrics for a real scraper. Counters and
+        gauges render as themselves; histograms render as SUMMARIES
+        (quantile series over the bounded recent window plus exact
+        lifetime `_sum`/`_count`), because the reservoir keeps raw
+        recent observations, not cumulative buckets. `parse_scrape`
+        round-trips this text back into the snapshot() schema
+        (scripts/serve_stats.py accepts either)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        lines = []
+        for n, c in sorted(counters.items()):
+            n = _prom_name(n)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {c.value}")
+        for n, g in sorted(gauges.items()):
+            n = _prom_name(n)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_prom_value(g.value)}")
+        for n, h in sorted(histograms.items()):
+            s = h.summary()
+            n = _prom_name(n)
+            lines.append(f"# TYPE {n} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                           ("0.99", "p99")):
+                lines.append(f'{n}{{quantile="{q}"}} '
+                             f"{_prom_value(s[key])}")
+            lines.append(f"{n}_sum {_prom_value(h.sum)}")
+            lines.append(f"{n}_count {h.count}")
+        return "\n".join(lines) + "\n"
+
 
 # the process-wide default registry: ServeEngine records here unless
 # given its own; the compile-cache listener (precision.py) always does
@@ -197,3 +240,194 @@ REGISTRY = Registry()
 def snapshot(registry: Optional[Registry] = None) -> dict:
     """Snapshot of `registry` (default: the process-wide REGISTRY)."""
     return (registry or REGISTRY).snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text format: name/value rendering + the scrape parser
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    """A valid Prometheus metric name: [a-zA-Z_:][a-zA-Z0-9_:]*. Our
+    metric names already conform; tenant-derived names sanitize any
+    other byte to '_' so a hostile tenant label cannot corrupt the
+    exposition."""
+    out = "".join(ch if (ch.isascii() and (ch.isalnum() or ch in "_:"))
+                  else "_" for ch in name)
+    if not out or not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def _prom_value(v: float) -> str:
+    """repr keeps full float precision; integers render bare (the
+    format accepts both, and bare ints keep counter lines exact)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def parse_scrape(text: str) -> dict:
+    """Parse Prometheus text-format exposition (as produced by
+    `Registry.scrape()`) back into the `snapshot()` schema —
+    scripts/serve_stats.py renders scraped input through this, so a
+    dashboard dump and a live /metrics response print identically.
+    Summaries map back to histograms (mean derived from _sum/_count);
+    unknown or untyped series parse as gauges. Raises ValueError on a
+    line that is neither a comment nor `name[{labels}] value`."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    summaries: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        # name[{labels}] value [timestamp]
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels, rest = rest.split("}", 1)
+        else:
+            name, _, rest = line.partition(" ")
+            labels = ""
+        fields = rest.split()
+        if not name or not fields:
+            raise ValueError(
+                f"scrape line {lineno} is not Prometheus text format: "
+                f"{line!r}")
+        try:
+            value = float(fields[0])
+        except ValueError:
+            raise ValueError(
+                f"scrape line {lineno} has a non-numeric value: "
+                f"{line!r}")
+        name = name.strip()
+        base = name
+        for suffix in ("_sum", "_count"):
+            if name.endswith(suffix) and types.get(name[:-len(suffix)]) \
+                    in ("summary", "histogram"):
+                base = name[:-len(suffix)]
+        kind = types.get(base, types.get(name))
+        if kind in ("summary", "histogram"):
+            h = summaries.setdefault(
+                base, {"count": 0, "mean": 0.0, "p50": 0.0,
+                       "p95": 0.0, "p99": 0.0, "_sum": 0.0})
+            if name.endswith("_sum"):
+                h["_sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = int(value)
+            else:
+                q = dict(part.split("=", 1) for part in labels.split(",")
+                         if "=" in part).get("quantile", "").strip('"')
+                key = {"0.5": "p50", "0.95": "p95", "0.99": "p99"}.get(q)
+                if key:
+                    h[key] = value
+        elif kind == "counter":
+            counters[name] = int(value)
+        else:
+            gauges[name] = value
+    histograms = {}
+    for name, h in summaries.items():
+        total = h.pop("_sum")
+        h["mean"] = total / h["count"] if h["count"] else 0.0
+        histograms[name] = h
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
+
+
+# ---------------------------------------------------------------------------
+# the scrape endpoint: python -m quest_tpu.serve.metrics --port 9464
+# ---------------------------------------------------------------------------
+
+
+def serve_scrape(registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+    """An HTTP server exposing `registry` (default: the process-wide
+    REGISTRY) at /metrics in Prometheus text format. Returns the
+    ThreadingHTTPServer — callers run `serve_forever()` (the __main__
+    below does) or drive it from a daemon thread and `shutdown()` when
+    done (tests scrape a real GET this way). port=0 binds an ephemeral
+    port, readable from `server.server_address`."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else REGISTRY
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):                      # noqa: N802 - http.server API
+            if self.path.split("?")[0] not in ("/", "/metrics"):
+                self.send_error(404, "only /metrics is served")
+                return
+            body = reg.scrape().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):          # quiet: scrapes are periodic
+            pass
+
+    return ThreadingHTTPServer((host, port), _Handler)
+
+
+def _main(argv) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m quest_tpu.serve.metrics",
+        description="Serve the process-wide metrics registry at "
+                    "/metrics in Prometheus text format "
+                    "(docs/SERVING.md §fleet).")
+    ap.add_argument("--port", type=int, required=True,
+                    help="TCP port to listen on (0 = ephemeral)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a tiny in-process serve workload first so "
+                         "the scrape shows real series (imports jax)")
+    args = ap.parse_args(argv)
+    if args.demo:
+        # lazy: the module itself must stay stdlib-only at import time,
+        # and the demo must work from ANY install location (no
+        # repo-relative script paths)
+        import numpy as np
+
+        from quest_tpu.circuit import Circuit
+        from quest_tpu.serve.engine import ServeEngine
+        from quest_tpu.serve.warmup import warmup
+
+        n = 6
+        c = Circuit(n)
+        for q in range(n):
+            c.h(q)
+        c.cnot(0, 1).rz(2, 0.25)
+        rng = np.random.default_rng(0)
+        states = rng.standard_normal((32, 2, 1 << n)).astype(np.float32)
+        states /= np.sqrt((states ** 2).sum(axis=(1, 2), keepdims=True))
+        with ServeEngine(max_wait_ms=5, max_batch=8,
+                         registry=REGISTRY) as eng:
+            warmup(eng, [c], buckets=[8])
+            for f in [eng.submit(c, state=s) for s in states]:
+                f.result(timeout=300)
+    srv = serve_scrape(REGISTRY, host=args.host, port=args.port)
+    host, port = srv.server_address[:2]
+    print(f"serving /metrics on http://{host}:{port}/metrics "
+          f"(Ctrl-C to stop)", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
+if __name__ == "__main__":                     # pragma: no cover - CLI
+    import sys
+    raise SystemExit(_main(sys.argv[1:]))

@@ -36,6 +36,12 @@ def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
     """Pre-compile every (circuit, bucket) program the engine can
     dispatch for a declared workload.
 
+    `engine` is a ServeEngine OR a ServeFleet (docs/SERVING.md §fleet):
+    compiled programs cache on the Circuit instance process-wide, so
+    one warm pass covers every replica of a fleet — this function only
+    reads the engine-shaped attributes (max_batch, interpret,
+    traj_engine, state), which the fleet exposes identically.
+
     `circuits`: the Circuit objects (the SAME objects later submitted —
     compiled programs cache on the instance). `kind` declares which
     program family the workload will request: 'apply' (state= submits),

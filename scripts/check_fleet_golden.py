@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""CI gate for the serve fleet (docs/SERVING.md §fleet): fails if
+
+  * FAILOVER loses a future — with a seeded plan killing one replica
+    past its restart budget mid-stream, every submitted future must
+    resolve (the dead replica's undispatched requests re-served by the
+    survivor; `fleet_failover_unresolved` must be 0 and the failover
+    path must actually fire), or
+  * SHED hits the wrong class — under overload with two priority
+    classes, 100% of shed rejections must land on the lower class
+    (`fleet_shed_lowest_only`), or
+  * a DURABLE job through serve does not resume — the seeded
+    `durable.preempt` kill must fire mid-checkpoint-chain, the job
+    must resume from the chain (not restart hollow from op 0), and the
+    final amplitudes must hash bit-identical to an uninterrupted
+    `run_durable` (`fleet_durable_resume_bitexact`).
+
+The committed contracts live HERE (the CI gate) next to the
+sweep/batch/expec/comm/durable gates; the per-path pins live in
+tests/test_fleet.py — a change that moves either must update both,
+consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import bench
+
+    rec = bench._measure_fleet()
+    print(json.dumps(rec))
+    ok = True
+    if rec["fleet_failovers"] < 1:
+        print("GATE BROKEN: the seeded replica kill never caused a "
+              "fleet failover — the scenario no longer exercises the "
+              "requeue path", file=sys.stderr)
+        ok = False
+    if rec["fleet_failover_unresolved"] != 0:
+        print(f"REGRESSION: {rec['fleet_failover_unresolved']} "
+              f"future(s) left unresolved after a replica death — the "
+              f"failover contract lost requests", file=sys.stderr)
+        ok = False
+    if rec["fleet_shed_requests"] < 1:
+        print("GATE BROKEN: the overload leg shed nothing — pressure "
+              "never crossed the threshold and the shed contract went "
+              "unexercised", file=sys.stderr)
+        ok = False
+    if not rec["fleet_shed_lowest_only"]:
+        print(f"REGRESSION: sheds hit the higher priority class "
+              f"({rec['fleet_shed_p1']} class-1 sheds vs "
+              f"{rec['fleet_shed_p0']} class-0) — the "
+              f"lowest-class-first contract broke", file=sys.stderr)
+        ok = False
+    if not rec["fleet_durable_preempted"]:
+        print("GATE BROKEN: the seeded durable.preempt plan never "
+              "fired — the durable leg no longer exercises resume",
+              file=sys.stderr)
+        ok = False
+    if rec["fleet_durable_resumed"] < 1:
+        print("GATE BROKEN: the kill landed before the first stamp — "
+              "the durable 'resume' restarted from op 0 and verified "
+              "nothing about checkpoint restore", file=sys.stderr)
+        ok = False
+    if not rec["fleet_durable_resume_bitexact"]:
+        print("REGRESSION: the preempted durable-through-serve job is "
+              "NOT bit-identical to the uninterrupted run",
+              file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2,9 +2,13 @@
 
 Reads one `metrics.snapshot()` dict — the stable JSON schema
 {"counters": {...}, "histograms": {name: {count, mean, p50, p95, p99}}}
-— and renders it as two aligned tables. Sources, in order:
+— OR a Prometheus text-format scrape (what `Registry.scrape()` /
+`python -m quest_tpu.serve.metrics --port` emit: the input is parsed
+as JSON first, then as scrape text) — and renders aligned tables.
+Sources, in order:
 
     python scripts/serve_stats.py snapshot.json    # a dumped snapshot
+    curl -s localhost:9464/metrics | python scripts/serve_stats.py -
     some-producer | python scripts/serve_stats.py -  # JSON on stdin
     python scripts/serve_stats.py --demo           # run a tiny in-process
                                                    # serve workload and
@@ -16,7 +20,9 @@ requests through, and prints what a serving dashboard would scrape —
 see docs/SERVING.md for the metric meanings.
 
 Latency histograms (`*_s` suffix) render in milliseconds; occupancy
-and other unitless histograms render as-is.
+and other unitless histograms render as-is. Fleet runs (ServeFleet,
+docs/SERVING.md §fleet) get their own fleet/tenant section whenever
+any fleet_/tenant_/shed_ series is present.
 """
 
 import json
@@ -45,6 +51,16 @@ _RESILIENCE = ("serve_worker_restarts", "serve_faults_injected",
                "serve_breaker_probes", "serve_breaker_closes",
                "serve_breakers_open")
 
+# the fleet/tenant metrics (docs/SERVING.md §fleet) get their own
+# section whenever any fleet_/tenant_/shed_ series is present: routing
+# health, failover activity and shed pressure are the figures a fleet
+# operator reads first
+_FLEET = ("fleet_replicas", "fleet_replicas_healthy", "fleet_pressure",
+          "fleet_requests_routed", "fleet_affinity_hits",
+          "fleet_affinity_spills", "fleet_failovers",
+          "fleet_requeued_requests", "fleet_durable_jobs",
+          "shed_requests", "shed_evictions", "tenant_quota_rejections")
+
 
 def render(snap: dict, out=sys.stdout) -> None:
     counters = snap.get("counters", {})
@@ -67,6 +83,18 @@ def render(snap: dict, out=sys.stdout) -> None:
               file=out)
         for n in _RESILIENCE:
             print(f"  {n:<{w}}  {vals.get(n, 0):g}", file=out)
+        fleet_present = any(n.startswith(("fleet_", "tenant_", "shed_"))
+                            for n in vals)
+        if fleet_present:
+            w = max(len(n) for n in _FLEET)
+            print("fleet/tenant (docs/SERVING.md §fleet)", file=out)
+            for n in _FLEET:
+                print(f"  {n:<{w}}  {vals.get(n, 0):g}", file=out)
+            extras = sorted(n for n in vals
+                            if n.startswith(("shed_requests_p",
+                                             "tenant_pending_")))
+            for n in extras:
+                print(f"  {n:<{w}}  {vals[n]:g}", file=out)
     if histograms:
         w = max(len(n) for n in histograms)
         unit = "ms for *_s"
@@ -105,15 +133,27 @@ def _demo_snapshot() -> dict:
     return reg.snapshot()
 
 
+def _load_snapshot(text: str) -> dict:
+    """JSON snapshot or Prometheus scrape text — both render the same.
+    JSON is tried first (every snapshot starts with '{'); anything else
+    goes through metrics.parse_scrape, which raises loudly on input
+    that is neither."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        from quest_tpu.serve import metrics
+        return metrics.parse_scrape(text)
+
+
 def main(argv) -> int:
     if argv and argv[0] == "--demo":
         render(_demo_snapshot())
         return 0
     if not argv or argv[0] == "-":
-        snap = json.load(sys.stdin)
+        snap = _load_snapshot(sys.stdin.read())
     else:
         with open(argv[0]) as f:
-            snap = json.load(f)
+            snap = _load_snapshot(f.read())
     render(snap)
     return 0
 

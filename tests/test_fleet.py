@@ -1,0 +1,924 @@
+"""Serve fleet: multi-replica routing with failover, tenant admission +
+priority load-shedding, and durable-backed long jobs (ISSUE 12).
+
+Pins the three fleet contracts end-to-end (docs/SERVING.md §fleet):
+program-key affinity routing with spill-to-least-loaded; fleet-level
+failover that re-serves a FAILED replica's undispatched requests on
+survivors (dispatched-at-death still fails typed — no double-serve —
+except durable jobs, which RESUME from their checkpoint chain, in
+place, across a supervised restart, or on a failover replica,
+bit-identical to an uninterrupted run); tenant quotas + priority
+shedding where 100% of sheds land on the lowest pending class until it
+is exhausted. Satellites ride along: the fleet fault sites
+(fleet.route/failover/shed) with the zero-cost pin, the Prometheus
+scrape endpoint (`Registry.scrape()`, `python -m quest_tpu.serve.metrics
+--port`), scripts/serve_stats.py's fleet section + scrape-format input,
+and the QUEST_SERVE_{REPLICAS,TENANT_QUOTA,SHED_THRESHOLD,PRIORITIES}
+knobs. The slow-marked chaos soak drives a 200-request mixed
+multi-tenant stream through a replica kill and a durable preemption —
+every future resolves, bounded drain is the hang detector.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import bench
+from quest_tpu.circuit import Circuit
+from quest_tpu.resilience import FaultPlan, faults, run_durable
+from quest_tpu.serve import (RejectedError, ServeFleet, ShedError,
+                             TenantQuotaExceeded, metrics, warmup)
+
+pytestmark = pytest.mark.dtype_agnostic
+
+N = 6
+
+
+def _circuit_a(n: int = N) -> Circuit:
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    return c.cnot(0, 1).rz(2, 0.25).cz(1, 3).rx(0, 0.5)
+
+
+def _circuit_b(n: int = N) -> Circuit:
+    c = Circuit(n).h(0)
+    for q in range(n - 1):
+        c.cnot(q, q + 1)
+    return c.t(1).ry(3, 0.7)
+
+
+def _noisy_circuit(n: int = 4) -> Circuit:
+    c = Circuit(n).h(0).cnot(0, 1)
+    c.depolarising(0, 0.1).damping(1, 0.2)
+    return c.ry(2, 0.3).dephasing(2, 0.15)
+
+
+def _random_states(b: int, n: int = N, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    s = rng.standard_normal((b, 2, 1 << n)).astype(np.float32)
+    return s / np.sqrt((s ** 2).sum(axis=(1, 2), keepdims=True))
+
+
+def _fleet(**kw):
+    kw.setdefault("registry", metrics.Registry())
+    kw.setdefault("backoff_base_s", 0.0)     # tests never sleep restarts
+    return ServeFleet(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    before = faults.current()
+    yield
+    faults.install(before)
+
+
+# ---------------------------------------------------------------------------
+# routing: affinity, spill, demux parity
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_results_match_single_engine_library_calls():
+    """Demux parity through the fleet: a mixed 2-circuit stream over 2
+    replicas resolves every future to the library result (engine-parity
+    eps across bucket programs)."""
+    ca, cb = _circuit_a(), _circuit_b()
+    states = _random_states(16, seed=3)
+    fa = ca.compiled_batched(1, donate=False)
+    fb = cb.compiled_batched(1, donate=False)
+    want = [np.asarray((fa if i % 2 == 0 else fb)(states[i][None]))[0]
+            for i in range(16)]
+    with _fleet(replicas=2, max_wait_ms=2, max_batch=8) as fl:
+        futs = [fl.submit(ca if i % 2 == 0 else cb, state=states[i])
+                for i in range(16)]
+        fl.drain(timeout_s=300)
+        got = [np.asarray(f.result(timeout=60)) for f in futs]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_affinity_routes_same_program_to_one_replica():
+    """Uncongested requests for one program land on ONE replica (the
+    affinity map), tallied as affinity hits."""
+    c = _circuit_a()
+    states = _random_states(6, seed=5)
+    reg = metrics.Registry()
+    with _fleet(replicas=3, max_wait_ms=2, max_batch=8,
+                registry=reg) as fl:
+        for s in states:
+            fl.submit(c, state=s).result(timeout=120)
+    snap = reg.snapshot()["counters"]
+    assert snap["fleet_requests_routed"] == 6
+    # first submit pins the map; the rest hit it (each waits for its
+    # result, so the affinity replica is never congested)
+    assert snap["fleet_affinity_hits"] == 5
+    assert snap.get("fleet_affinity_spills", 0) == 0
+
+
+def test_spill_to_least_loaded_on_affinity_overload():
+    """When the affinity replica's backlog runs a full launch deeper
+    than the least-loaded one, requests SPILL instead of queueing
+    behind the hot spot."""
+    c = _circuit_a()
+    states = _random_states(12, seed=7)
+    reg = metrics.Registry()
+    # nothing dispatches (max_wait huge, max_batch > stream), so the
+    # affinity replica's queue builds until the spill bound (max_batch
+    # over least-loaded) trips
+    with _fleet(replicas=2, max_wait_ms=600_000, max_batch=4,
+                registry=reg) as fl:
+        futs = [fl.submit(c, state=s) for s in states]
+        snap = reg.snapshot()["counters"]
+        fl.drain(timeout_s=300)
+        for f in futs:
+            f.result(timeout=60)
+    assert snap["fleet_affinity_spills"] >= 1, snap
+    assert snap["fleet_requests_routed"] == 12
+
+
+def test_warmup_accepts_a_fleet():
+    """serve.warmup duck-types over the fleet (compiled programs cache
+    on the Circuit instance, so one warm pass warms every replica)."""
+    c = _circuit_a()
+    with _fleet(replicas=2, max_batch=8) as fl:
+        report = warmup(fl, [c], buckets=[4])
+        assert report["programs"]
+        out = fl.submit(c, state=_random_states(1, seed=9)[0]).result(
+            timeout=120)
+    assert np.asarray(out).shape == (2, 1 << N)
+
+
+# ---------------------------------------------------------------------------
+# failover: the fleet-level _active-ledger contract
+# ---------------------------------------------------------------------------
+
+
+def test_failed_replica_requeues_undispatched_onto_survivor():
+    """THE failover acceptance gate: a replica dies past its restart
+    budget with queued-but-undispatched requests; every future resolves
+    with a correct result, re-served by the survivor."""
+    c = _circuit_a()
+    states = _random_states(8, seed=11)
+    fn = c.compiled_batched(1, donate=False)
+    want = [np.asarray(fn(s[None]))[0] for s in states]
+    plan = FaultPlan().inject(
+        "serve.worker_loop", error=RuntimeError("chip gone"),
+        match=lambda ctx: (ctx.get("replica") == "r0"
+                           and ctx["phase"] == "popped"))
+    reg = metrics.Registry()
+    with faults.active(plan):
+        with _fleet(replicas=2, max_wait_ms=600_000, max_batch=8,
+                    restart_max=1, registry=reg) as fl:
+            futs = [fl.submit(c, state=s) for s in states]
+            fl.drain(timeout_s=300)
+            got = [np.asarray(f.result(timeout=60)) for f in futs]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+    snap = reg.snapshot()
+    assert snap["counters"]["fleet_failovers"] >= 1
+    assert snap["counters"]["serve_requests_served"] == 8
+    assert snap["gauges"]["fleet_replicas_healthy"] == 1.0
+
+
+def test_failover_rebuilds_affinity_off_the_dead_replica():
+    """After a replica dies, its affinity pins are dropped and the
+    requeued requests re-route (and re-pin) on survivors."""
+    c = _circuit_a()
+    states = _random_states(4, seed=13)
+    plan = FaultPlan().inject(
+        "serve.worker_loop", error=RuntimeError("gone"),
+        match=lambda ctx: (ctx.get("replica") == "r0"
+                           and ctx["phase"] == "popped"))
+    with faults.active(plan):
+        with _fleet(replicas=2, max_wait_ms=600_000, max_batch=8,
+                    restart_max=0) as fl:
+            futs = [fl.submit(c, state=s) for s in states]
+            fl.drain(timeout_s=300)
+            for f in futs:
+                f.result(timeout=60)
+            assert all(v != 0 for v in fl._affinity.values())
+            # survivors keep serving: the fleet degrades to
+            # single-engine behavior, not to a hang (drain forces the
+            # flush — this fleet's wait window is deliberately huge)
+            f = fl.submit(c, state=states[0])
+            fl.drain(timeout_s=300)
+            assert np.asarray(f.result(timeout=60)).shape == (2, 1 << N)
+
+
+def test_all_replicas_failed_resolves_everything_typed():
+    """No survivors => every future resolves typed, submit rejects
+    naming the cause, drain returns — never a hang."""
+    c = _circuit_a()
+    states = _random_states(4, seed=17)
+    plan = FaultPlan().inject(
+        "serve.worker_loop", error=RuntimeError("total outage"),
+        match=lambda ctx: ctx["phase"] == "popped")
+    with faults.active(plan):
+        fl = _fleet(replicas=2, max_wait_ms=600_000, max_batch=8,
+                    restart_max=0)
+        try:
+            futs = [fl.submit(c, state=s) for s in states]
+            fl.drain(timeout_s=300)
+            for f in futs:
+                with pytest.raises(RejectedError):
+                    f.result(timeout=60)
+            assert fl.state == "failed"
+            with pytest.raises(RejectedError, match="FAILED"):
+                fl.submit(c, state=states[0])
+        finally:
+            fl.close(timeout_s=60)
+
+
+def test_request_error_propagates_typed_without_requeue():
+    """A healthy replica's per-request failure (demux error) reaches
+    the fleet future typed — the fleet only requeues replica-death
+    rejections, never ordinary request errors."""
+    c = _circuit_a()
+    states = _random_states(2, seed=19)
+
+    def bad_observable(planes_b):
+        raise ValueError("observable shape mismatch")
+
+    reg = metrics.Registry()
+    with _fleet(replicas=2, max_wait_ms=2, max_batch=8,
+                registry=reg) as fl:
+        fbad = fl.submit(c, state=states[0], observable=bad_observable)
+        fgood = fl.submit(c, state=states[1])
+        fl.drain(timeout_s=120)
+    with pytest.raises(ValueError, match="observable shape"):
+        fbad.result(timeout=60)
+    assert np.asarray(fgood.result(timeout=60)).shape == (2, 1 << N)
+    assert reg.counter("fleet_failovers").value == 0
+
+
+# ---------------------------------------------------------------------------
+# tenant admission + priority shed
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_quota_bounds_pending_and_releases_on_completion():
+    c = _circuit_a()
+    states = _random_states(8, seed=23)
+    with _fleet(replicas=2, max_wait_ms=600_000, max_batch=64,
+                tenant_quota={"default": 64, "greedy": 2}) as fl:
+        f1 = fl.submit(c, state=states[0], tenant="greedy")
+        f2 = fl.submit(c, state=states[1], tenant="greedy")
+        with pytest.raises(TenantQuotaExceeded, match="greedy"):
+            fl.submit(c, state=states[2], tenant="greedy")
+        # other tenants are untouched by one tenant's quota
+        f3 = fl.submit(c, state=states[3], tenant="polite")
+        fl.drain(timeout_s=300)
+        for f in (f1, f2, f3):
+            f.result(timeout=60)
+        # completion released the quota: greedy can submit again (the
+        # wait window is huge, so drain forces the flush)
+        f4 = fl.submit(c, state=states[4], tenant="greedy")
+        fl.submit(c, state=states[5], tenant="greedy")
+        fl.drain(timeout_s=300)
+        f4.result(timeout=60)
+
+
+def test_tenant_quota_parser_grammar():
+    from quest_tpu.serve.admission import (DEFAULT_TENANT_QUOTA,
+                                           parse_tenant_quota)
+    assert parse_tenant_quota("64") == {"default": 64}
+    assert parse_tenant_quota("alice=16,bob=0,default=8") == {
+        "alice": 16, "bob": 0, "default": 8}
+    # a spec naming only specific tenants still yields a usable table
+    # (regression: TenantQuota requires a default, so this used to
+    # crash ServeFleet construction)
+    assert parse_tenant_quota("alice=16,bob=128") == {
+        "alice": 16, "bob": 128, "default": DEFAULT_TENANT_QUOTA}
+    for bad in ("alice=lots", "=4", "alice=4,alice=5", "default=0",
+                "0"):
+        with pytest.raises(ValueError):
+            parse_tenant_quota(bad)
+    # the registered knob parser IS parse_tenant_quota
+    from quest_tpu.env import KNOBS
+    k = KNOBS["QUEST_SERVE_TENANT_QUOTA"]
+    assert k.parse("32") == {"default": 32}
+    with pytest.raises(ValueError):
+        k.parse(k.malformed)
+
+
+def _shed_fleet(reg, **kw):
+    """A fleet whose queues BUILD (nothing dispatches before drain):
+    max_wait is huge and max_batch exceeds anything a test submits, so
+    pressure provably crosses the threshold while victims are still
+    evictable."""
+    kw.setdefault("replicas", 2)
+    kw.setdefault("max_wait_ms", 600_000)
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("max_batch", 1024)
+    kw.setdefault("shed_threshold", 0.5)
+    kw.setdefault("priorities", 2)
+    return _fleet(registry=reg, **kw)
+
+
+def test_shed_hits_only_the_lowest_class_until_exhausted():
+    """THE shed acceptance gate: under overload, 100% of sheds land on
+    class 0 while any class-0 request is pending — incoming class-0
+    sheds itself, incoming class-1 EVICTS a queued class-0 victim; each
+    shed carries a typed ShedError naming the pressure cause."""
+    c = _circuit_a()
+    states = _random_states(32, seed=29)
+    reg = metrics.Registry()
+    with _shed_fleet(reg) as fl:
+        low, low_shed = [], 0
+        for i in range(12):
+            try:
+                low.append(fl.submit(c, state=states[i], tenant="free",
+                                     priority=0))
+            except ShedError as e:
+                assert "pressure" in str(e)
+                low_shed += 1
+        assert low_shed >= 1              # pressure crossed mid-stream
+        # paying burst smaller than the queued free backlog: every one
+        # admitted by evicting a class-0 victim
+        high = [fl.submit(c, state=states[20 + i], tenant="paying",
+                          priority=1) for i in range(4)]
+        evicted = [f for f in low
+                   if f.done() and isinstance(f.exception(), ShedError)]
+        assert len(evicted) == 4
+        for f in evicted:
+            assert "pressure" in str(f.exception())
+        fl.drain(timeout_s=300)
+        for f in high:                    # every paying request served
+            assert np.asarray(f.result(timeout=60)).shape == (2, 1 << N)
+    snap = reg.snapshot()["counters"]
+    assert snap["shed_requests"] == low_shed + 4
+    assert snap["shed_requests_p0"] == snap["shed_requests"]
+    assert snap.get("shed_requests_p1", 0) == 0
+    assert snap["shed_evictions"] == 4
+
+
+def test_shed_reaches_higher_class_only_after_lowest_exhausted():
+    """The exhaustion edge: when everything pending is class 1, an
+    incoming class-1 request is itself the lowest class and sheds."""
+    c = _circuit_a()
+    states = _random_states(20, seed=31)
+    reg = metrics.Registry()
+    with _shed_fleet(reg) as fl:
+        kept = []
+        shed_p1 = 0
+        for i in range(14):
+            try:
+                kept.append(fl.submit(c, state=states[i], priority=1))
+            except ShedError:
+                shed_p1 += 1
+        assert shed_p1 >= 1
+        fl.drain(timeout_s=300)
+        for f in kept:
+            f.result(timeout=60)
+    snap = reg.snapshot()["counters"]
+    assert snap["shed_requests_p1"] == shed_p1
+    assert snap.get("shed_requests_p0", 0) == 0
+
+
+def test_eviction_frees_the_slot_at_the_hard_queue_bound():
+    """Regression: cancel-while-queued only decrements the engine's
+    pending count at the worker's NEXT sweep — at the hard queue bound
+    (shed_threshold=1.0) the evicting high-priority submit used to see
+    a still-full queue and get rejected AFTER its victim was already
+    shed, losing both. The shed path now reaps the cancelled victim
+    synchronously, so the evictor provably takes its slot."""
+    c = _circuit_a()
+    states = _random_states(12, seed=53)
+    reg = metrics.Registry()
+    with _fleet(replicas=2, max_wait_ms=600_000, max_queue=4,
+                max_batch=1024, shed_threshold=1.0, priorities=2,
+                registry=reg) as fl:
+        low = []
+        for i in range(8):                # fill both queues to the bound
+            low.append(fl.submit(c, state=states[i], priority=0))
+        with pytest.raises(RejectedError):
+            fl.submit(c, state=states[8], priority=0)
+        # the high-priority submit evicts a victim and takes its slot —
+        # it must be ADMITTED, not queue-full-rejected
+        f_hi = fl.submit(c, state=states[9], priority=1)
+        evicted = [f for f in low
+                   if f.done() and isinstance(f.exception(), ShedError)]
+        assert len(evicted) == 1
+        fl.drain(timeout_s=300)
+        assert np.asarray(f_hi.result(timeout=60)).shape == (2, 1 << N)
+    assert reg.counter("shed_evictions").value == 1
+
+
+def test_priority_validates_against_the_knob():
+    c = _circuit_a()
+    with _fleet(replicas=1, priorities=2) as fl:
+        with pytest.raises(ValueError, match="priority"):
+            fl.submit(c, state=_random_states(1)[0], priority=2)
+        with pytest.raises(ValueError, match="priority"):
+            fl.submit(c, state=_random_states(1)[0], priority=-1)
+
+
+# ---------------------------------------------------------------------------
+# durable long jobs through serve
+# ---------------------------------------------------------------------------
+
+ND = 8     # sub-kernel-tier: the durable auto-resolution rides banded
+           # on CPU, no interpret flag needed
+
+
+def _durable_setup(tmp_path, layers=4):
+    circ = bench._build_durable_circuit(ND, layers=layers)
+    import quest_tpu as qt
+    q0 = qt.init_debug_state(qt.create_qureg(ND))
+    s0 = np.asarray(jax.device_get(q0.amps))
+    ref = run_durable(circ, q0, str(tmp_path / "ref"), every=2)
+    ref_hash = hashlib.sha256(
+        np.asarray(jax.device_get(ref.amps)).tobytes()).hexdigest()
+    return circ, s0, ref_hash
+
+
+def _sha(planes) -> str:
+    return hashlib.sha256(np.asarray(planes).tobytes()).hexdigest()
+
+
+def test_durable_job_through_fleet_matches_direct_run(tmp_path):
+    circ, s0, ref_hash = _durable_setup(tmp_path)
+    reg = metrics.Registry()
+    with _fleet(replicas=2, max_wait_ms=2, registry=reg) as fl:
+        out = fl.submit(circ, state=s0,
+                        durable_dir=str(tmp_path / "job"),
+                        durable_every=2).result(timeout=600)
+    assert _sha(out) == ref_hash
+    assert reg.counter("fleet_durable_jobs").value == 1
+    assert reg.counter("serve_durable_jobs").value == 1
+    # a completed job consumed its chain
+    from quest_tpu import checkpoint as ckpt
+    assert not ckpt.step_dirs(str(tmp_path / "job"))
+
+
+def test_durable_preempt_mid_chain_resumes_in_place(tmp_path):
+    """An injected durable.preempt kill mid-checkpoint-chain RESUMES
+    the job (same replica, in-place retry) instead of failing the
+    future — bit-identical to the uninterrupted run."""
+    circ, s0, ref_hash = _durable_setup(tmp_path)
+    reg = metrics.Registry()
+    plan = FaultPlan().inject("durable.preempt", after_n=5, times=1)
+    with faults.active(plan):
+        with _fleet(replicas=2, max_wait_ms=2, registry=reg) as fl:
+            out = fl.submit(circ, state=s0,
+                            durable_dir=str(tmp_path / "job"),
+                            durable_every=2).result(timeout=600)
+    assert plan.fired("durable.preempt") == 1
+    assert _sha(out) == ref_hash
+    snap = reg.snapshot()["counters"]
+    assert snap["durable_resumes"] >= 1          # a stamp was consumed
+    assert snap["serve_durable_inplace_resumes"] >= 1
+
+
+def test_durable_worker_crash_requeues_and_resumes_same_engine(tmp_path):
+    """The supervised-restart rung of the durable escalation ladder:
+    exhausted in-place retries crash the worker; the request survives
+    in the _active ledger (durable requests are resume-safe past
+    dispatch), requeues, and the restarted worker finishes the job from
+    its chain."""
+    circ, s0, ref_hash = _durable_setup(tmp_path)
+    from quest_tpu.serve.engine import ServeEngine
+    reg = metrics.Registry()
+    # one preempt stamps nothing extra; the dispatch faults then burn
+    # the in-place retry cap, escalating to a worker crash
+    plan = FaultPlan()
+    plan.inject("durable.preempt", after_n=5, times=1)
+    plan.inject("serve.dispatch", error=RuntimeError("transient"),
+                match=lambda ctx: ctx.get("durable"), after_n=1,
+                times=ServeEngine.DURABLE_RETRY_CAP - 1)
+    with faults.active(plan):
+        with ServeEngine(max_wait_ms=2, registry=reg,
+                         backoff_base_s=0.0) as eng:
+            out = eng.submit(circ, state=s0,
+                             durable_dir=str(tmp_path / "job"),
+                             durable_every=2).result(timeout=600)
+    assert _sha(out) == ref_hash
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_worker_restarts"] >= 1
+    assert snap["durable_resumes"] >= 1
+
+
+def test_durable_failover_resumes_on_survivor_replica(tmp_path):
+    """THE durable failover gate: the replica holding a mid-chain job
+    dies past its restart budget; the survivor picks the job up and
+    RESUMES from the checkpoint chain — bit-identical, provably from a
+    stamp (durable_resumes), not a hollow restart."""
+    circ, s0, ref_hash = _durable_setup(tmp_path)
+    reg = metrics.Registry()
+    plan = FaultPlan()
+    plan.inject("durable.preempt", after_n=5, times=1)
+    # every further durable attempt ON r0 fails: in-place retries burn
+    # out, the worker crash-loops past its budget, r0 goes FAILED, the
+    # fleet requeues onto r1 — which resumes the SAME chain
+    plan.inject("serve.dispatch", error=RuntimeError("replica dying"),
+                match=lambda ctx: (ctx.get("replica") == "r0"
+                                   and ctx.get("durable")),
+                after_n=1)
+    with faults.active(plan):
+        with _fleet(replicas=2, max_wait_ms=2, restart_max=1,
+                    registry=reg) as fl:
+            out = fl.submit(circ, state=s0,
+                            durable_dir=str(tmp_path / "job"),
+                            durable_every=2).result(timeout=600)
+    assert _sha(out) == ref_hash
+    snap = reg.snapshot()["counters"]
+    assert snap["fleet_failovers"] >= 1
+    assert snap["durable_resumes"] >= 1
+
+
+def test_bad_durable_dir_fails_typed_not_fleetwide(tmp_path):
+    """Regression (review): a tenant's unwritable durable_dir is a
+    TYPED per-request failure — it used to escalate through worker
+    crashes and failover until EVERY replica was FAILED (one bad path
+    = fleet-wide outage)."""
+    circ = bench._build_durable_circuit(ND, layers=2)
+    import quest_tpu as qt
+    q0 = qt.init_debug_state(qt.create_qureg(ND))
+    s0 = np.asarray(jax.device_get(q0.amps))
+    blocker = tmp_path / "a_file"
+    blocker.write_text("not a directory")
+    reg = metrics.Registry()
+    with _fleet(replicas=2, max_wait_ms=2, restart_max=1,
+                registry=reg) as fl:
+        f = fl.submit(circ, state=s0,
+                      durable_dir=str(blocker / "nested"),
+                      durable_every=1)
+        with pytest.raises(OSError):
+            f.result(timeout=300)
+        assert fl.state == "running"
+        # other tenants are untouched
+        out = fl.submit(_circuit_a(), state=_random_states(1)[0])
+        fl.drain(timeout_s=300)
+        assert np.asarray(out.result(timeout=60)).shape == (2, 1 << N)
+    assert reg.counter("serve_worker_restarts").value == 0
+    assert reg.counter("fleet_failovers").value == 0
+
+
+def test_outer_cancel_while_queued_propagates_to_the_replica():
+    """Regression (review): cancelling the fleet-returned future while
+    the request is queued cancels the inner request too — it never
+    launches, never charges the tenant's quota, and is never re-served
+    by a failover."""
+    c = _circuit_a()
+    states = _random_states(2, seed=59)
+    reg = metrics.Registry()
+    with _fleet(replicas=2, max_wait_ms=600_000, max_batch=64,
+                tenant_quota={"default": 1}, registry=reg) as fl:
+        f = fl.submit(c, state=states[0], tenant="t")
+        assert f.cancel()
+        # the quota slot released immediately: the same tenant (quota
+        # 1) can submit again
+        f2 = fl.submit(c, state=states[1], tenant="t")
+        fl.drain(timeout_s=300)
+        assert np.asarray(f2.result(timeout=60)).shape == (2, 1 << N)
+    snap = reg.snapshot()["counters"]
+    assert snap["serve_requests_served"] == 1        # only f2 launched
+    assert snap["serve_requests_cancelled"] >= 1
+
+
+def test_durable_submit_validation():
+    c = _circuit_a()
+    with _fleet(replicas=1) as fl:
+        with pytest.raises(ValueError, match="durable"):
+            fl.submit(c, shots=4, durable_dir="/tmp/x")
+        with pytest.raises(ValueError, match="observable"):
+            fl.submit(c, state=_random_states(1)[0],
+                      durable_dir="/tmp/x", observable=lambda p: p)
+        with pytest.raises(ValueError, match="durable_every"):
+            fl.submit(c, state=_random_states(1)[0], durable_every=2)
+
+
+# ---------------------------------------------------------------------------
+# fleet fault sites: catalog, firing, zero-cost pin
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_sites_are_in_the_catalog():
+    for site in ("fleet.route", "fleet.failover", "fleet.shed"):
+        assert site in faults.SITES
+    # QUEST_FAULT_PLAN grammar reaches them
+    plan = faults.parse_plan("fleet.route:times=1;fleet.shed:after=5")
+    assert not plan.empty
+
+
+def test_fleet_route_site_fires_typed_in_the_submitter():
+    c = _circuit_a()
+    reg = metrics.Registry()
+    plan = FaultPlan().inject("fleet.route", times=1)
+    with faults.active(plan):
+        with _fleet(replicas=2, registry=reg) as fl:
+            with pytest.raises(faults.InjectedFault):
+                fl.submit(c, state=_random_states(1)[0])
+            # the plan is exhausted: the same submit now routes
+            fl.submit(c, state=_random_states(1)[0]).result(timeout=120)
+    assert plan.fired("fleet.route") == 1
+    assert reg.counter("serve_faults_injected").value == 1
+    # the failed submit left no ledger residue
+    assert not fl._pending
+
+
+def test_fleet_failover_site_fails_the_requeue_typed():
+    """An armed fleet.failover site fails the requeueing request's
+    future typed instead of hanging it — the soak's handle on the
+    failover path itself."""
+    c = _circuit_a()
+    states = _random_states(2, seed=47)
+    plan = FaultPlan()
+    plan.inject("serve.worker_loop", error=RuntimeError("gone"),
+                match=lambda ctx: (ctx.get("replica") == "r0"
+                                   and ctx["phase"] == "popped"))
+    plan.inject("fleet.failover", error=RuntimeError("failover blocked"))
+    with faults.active(plan):
+        with _fleet(replicas=2, max_wait_ms=600_000, max_batch=8,
+                    restart_max=0) as fl:
+            futs = [fl.submit(c, state=s) for s in states]
+            fl.drain(timeout_s=300)
+            for f in futs:
+                with pytest.raises(RuntimeError, match="failover blocked"):
+                    f.result(timeout=60)
+    assert plan.fired("fleet.failover") == len(states)
+
+
+def test_fleet_shed_site_fires_on_the_shed_decision():
+    c = _circuit_a()
+    states = _random_states(12, seed=37)
+    reg = metrics.Registry()
+    plan = FaultPlan().inject("fleet.shed", error=RuntimeError("forced"),
+                              times=1)
+    with faults.active(plan):
+        with _shed_fleet(reg) as fl:
+            fired = 0
+            for i in range(12):
+                try:
+                    fl.submit(c, state=states[i], priority=0)
+                except RuntimeError:
+                    fired += 1
+                except ShedError:
+                    pass
+            assert fired == 1             # the decision point is armed
+            fl.drain(timeout_s=300)
+    assert plan.fired("fleet.shed") == 1
+
+
+def test_empty_plan_keeps_fleet_sites_zero_cost(compile_auditor):
+    """The zero-cost pin, fleet edition: a warmed fleet stream under an
+    empty plan — and under fleet sites armed-but-silent — retraces
+    NOTHING (every fleet check is host-side, behind the one ACTIVE
+    flag)."""
+    ca, cb = _circuit_a(), _circuit_b()
+    states = _random_states(16, seed=41)
+    with _fleet(replicas=2, max_wait_ms=10_000, max_batch=4) as fl:
+        warmup(fl, [ca, cb], buckets=[4])
+
+        def stream():
+            futs = [fl.submit(ca if i % 2 == 0 else cb,
+                              state=states[i]) for i in range(16)]
+            fl.drain(timeout_s=300)
+            for f in futs:
+                f.result(timeout=300)
+
+        stream()                          # warm the demux ops
+        with faults.active(FaultPlan()):
+            with compile_auditor as aud:
+                stream()
+        aud.assert_no_retrace("warmed fleet stream, empty fault plan")
+        armed = FaultPlan()
+        for site in ("fleet.route", "fleet.failover", "fleet.shed",
+                     "serve.dispatch"):
+            armed.inject(site, after_n=10 ** 9)
+        with faults.active(armed):
+            assert faults.ACTIVE
+            with compile_auditor as aud2:
+                stream()
+        aud2.assert_no_retrace("warmed fleet stream, armed-silent plan")
+
+
+# ---------------------------------------------------------------------------
+# scrape endpoint + serve_stats
+# ---------------------------------------------------------------------------
+
+
+def _prom_line_ok(line: str) -> bool:
+    import re
+    if not line or line.startswith("#"):
+        return True
+    m = re.match(r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+                 r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+                 r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+                 r'-?[0-9.eE+-]+(nan|inf)?$', line)
+    return m is not None
+
+
+def test_scrape_is_valid_prometheus_text_and_round_trips():
+    """Acceptance: metrics.Registry.scrape() output parses as valid
+    Prometheus text format, and parse_scrape round-trips it back to
+    the snapshot values."""
+    reg = metrics.Registry()
+    reg.counter("fleet_requests_routed").inc(7)
+    reg.gauge("fleet_pressure").set(0.375)
+    h = reg.histogram("serve_e2e_latency_s")
+    for i in range(100):
+        h.observe(i / 1000)
+    text = reg.scrape()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _prom_line_ok(line), f"invalid exposition line: {line!r}"
+    # every metric family carries a TYPE line
+    assert "# TYPE fleet_requests_routed counter" in text
+    assert "# TYPE fleet_pressure gauge" in text
+    assert "# TYPE serve_e2e_latency_s summary" in text
+    back = metrics.parse_scrape(text)
+    snap = reg.snapshot()
+    assert back["counters"] == snap["counters"]
+    assert back["gauges"] == snap["gauges"]
+    got_h = back["histograms"]["serve_e2e_latency_s"]
+    want_h = snap["histograms"]["serve_e2e_latency_s"]
+    assert got_h["count"] == want_h["count"]
+    for k in ("mean", "p50", "p95", "p99"):
+        assert got_h[k] == pytest.approx(want_h[k])
+
+
+def test_scrape_endpoint_serves_real_http():
+    """`python -m quest_tpu.serve.metrics --port` serves /metrics: a
+    real GET against the ThreadingHTTPServer returns the exposition
+    with the Prometheus content type; other paths 404."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    reg = metrics.Registry()
+    reg.counter("fleet_failovers").inc(2)
+    srv = metrics.serve_scrape(reg, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address[:2]
+        resp = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+        assert "fleet_failovers 2" in body
+        assert metrics.parse_scrape(body)["counters"][
+            "fleet_failovers"] == 2
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{host}:{port}/nope", timeout=10)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _load_serve_stats():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_stats", os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts", "serve_stats.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_stats_renders_fleet_section_and_accepts_scrape():
+    import io
+    mod = _load_serve_stats()
+    snap = {"counters": {"fleet_requests_routed": 9,
+                         "shed_requests": 2, "shed_requests_p0": 2,
+                         "tenant_quota_rejections": 1},
+            "gauges": {"fleet_replicas": 2.0,
+                       "fleet_replicas_healthy": 1.0},
+            "histograms": {}}
+    buf = io.StringIO()
+    mod.render(snap, out=buf)
+    text = buf.getvalue()
+    assert "fleet/tenant" in text
+    assert "fleet_replicas_healthy" in text
+    assert "shed_requests_p0" in text        # per-class extras rendered
+    # scrape-format input parses to the same tables
+    reg = metrics.Registry()
+    reg.counter("fleet_requests_routed").inc(9)
+    parsed = mod._load_snapshot(reg.scrape())
+    assert parsed["counters"]["fleet_requests_routed"] == 9
+    # a non-fleet snapshot renders WITHOUT the fleet section
+    buf2 = io.StringIO()
+    mod.render({"counters": {"serve_requests_served": 1}, "gauges": {},
+                "histograms": {}}, out=buf2)
+    assert "fleet/tenant" not in buf2.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_knobs_registered_runtime_scope():
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_SERVE_REPLICAS", "QUEST_SERVE_TENANT_QUOTA",
+                 "QUEST_SERVE_SHED_THRESHOLD", "QUEST_SERVE_PRIORITIES"):
+        k = KNOBS[name]
+        assert k.scope == "runtime" and k.layer == "serve", k
+        assert k.malformed is not None
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    assert KNOBS["QUEST_SERVE_REPLICAS"].parse("4") == 4
+    assert KNOBS["QUEST_SERVE_SHED_THRESHOLD"].parse("0.9") == 0.9
+    assert KNOBS["QUEST_SERVE_PRIORITIES"].parse("3") == 3
+
+
+def test_fleet_knobs_configure_fleet(monkeypatch):
+    monkeypatch.setenv("QUEST_SERVE_REPLICAS", "3")
+    monkeypatch.setenv("QUEST_SERVE_SHED_THRESHOLD", "0.9")
+    monkeypatch.setenv("QUEST_SERVE_PRIORITIES", "4")
+    monkeypatch.setenv("QUEST_SERVE_TENANT_QUOTA", "alice=1,default=9")
+    with _fleet(max_wait_ms=2) as fl:
+        assert fl.replicas == 3
+        assert fl.shed_threshold == 0.9
+        assert fl.priorities == 4
+        assert fl.tenant_quota.quota_of("alice") == 1
+        assert fl.tenant_quota.quota_of("bob") == 9
+
+
+def test_fleet_stats_surfaces_replica_health():
+    with _fleet(replicas=2, restart_max=3) as fl:
+        st = fl.stats()
+        assert len(st["replicas"]) == 2
+        for r in st["replicas"]:
+            assert r["state"] == "running"
+            assert r["restarts_remaining"] == 3
+        assert st["pressure"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (CI's slow lane): the ISSUE-12 acceptance scenario
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_kill_replica_and_preempt_durable(tmp_path):
+    """THE fleet chaos soak: a seeded fault plan over a 200-request
+    mixed multi-tenant stream (apply + trajectory + one durable long
+    job) — one replica killed past its restart budget mid-stream, the
+    durable job preempted mid-checkpoint-chain. EVERY future resolves
+    as served or typed error (bounded drain is the hang detector), and
+    the durable job's amplitudes land bit-identical to an uninterrupted
+    run."""
+    ca, cb, cn = _circuit_a(), _circuit_b(), _noisy_circuit()
+    circ_d, s0, ref_hash = _durable_setup(tmp_path, layers=4)
+    states = _random_states(200, seed=43)
+    tenants = ("alice", "bob", "carol")
+    plan = FaultPlan()
+    # r1 dies for good partway through the stream (its restart budget
+    # is 2: three popped-phase crashes exhaust it)
+    plan.inject("serve.worker_loop", error=RuntimeError("replica lost"),
+                match=lambda ctx: (ctx.get("replica") == "r1"
+                                   and ctx["phase"] == "popped"),
+                after_n=20)
+    # the durable job is killed once mid-chain
+    plan.inject("durable.preempt", after_n=5, times=1)
+    # background noise on every replica
+    plan.inject("serve.dispatch", every_n=31, times=4,
+                match=lambda ctx: not ctx.get("durable"))
+    plan.inject("serve.demux", p=0.02, seed=7)
+    reg = metrics.Registry()
+    with faults.active(plan):
+        fl = _fleet(replicas=3, max_wait_ms=2, max_batch=8,
+                    restart_max=2, breaker_threshold=3,
+                    breaker_cooldown_s=0.05, registry=reg)
+        try:
+            futs = []
+            fd = None
+            for i in range(200):
+                try:
+                    if i == 10:
+                        fd = fl.submit(circ_d, state=s0,
+                                       durable_dir=str(tmp_path / "j"),
+                                       durable_every=2, tenant="alice",
+                                       priority=1)
+                        futs.append(fd)
+                    elif i % 7 == 6:
+                        futs.append(fl.submit(
+                            cn, shots=1 + i % 4, key=jax.random.key(i),
+                            tenant=tenants[i % 3]))
+                    else:
+                        futs.append(fl.submit(
+                            ca if i % 2 == 0 else cb, state=states[i],
+                            tenant=tenants[i % 3], priority=i % 2))
+                except RejectedError:
+                    pass                  # shed/FAILED mid-stream is legal
+            fl.drain(timeout_s=600)       # TimeoutError here == hung
+            resolved = sum(1 for f in futs if f.done())
+            assert resolved == len(futs)
+            # the durable long job survived the chaos bit-identically
+            assert fd is not None and fd.done()
+            assert _sha(fd.result(timeout=60)) == ref_hash
+            assert fl.state in ("running", "failed")
+        finally:
+            fl.close(timeout_s=120)
+    snap = reg.snapshot()["counters"]
+    assert plan.fired("durable.preempt") == 1
+    assert snap.get("serve_faults_injected", 0) > 0, snap
+    assert snap.get("durable_resumes", 0) >= 1, snap

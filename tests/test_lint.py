@@ -583,6 +583,66 @@ def test_durable_knobs_registered_with_loud_parsers():
     assert KNOBS["QUEST_CHECKPOINT_KEEP"].default == 2
 
 
+def test_fleet_knob_registry_coverage(tmp_path):
+    """QUEST_SERVE_{REPLICAS,TENANT_QUOTA,SHED_THRESHOLD,PRIORITIES}
+    coverage of the registry rules (ISSUE 12): all four are RUNTIME
+    scope — read once at ServeFleet construction, never inside a
+    compiled path — so a registry read off-jit is clean, the same read
+    on a jit-reachable path fires QL001, and a direct os.environ read
+    fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        def configure_fleet():
+            a = knob_value("QUEST_SERVE_REPLICAS")
+            b = knob_value("QUEST_SERVE_TENANT_QUOTA")
+            c = knob_value("QUEST_SERVE_SHED_THRESHOLD")
+            d = knob_value("QUEST_SERVE_PRIORITIES")
+            return a, b, c, d
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_SERVE_REPLICAS") > 1:
+                return amps * 2
+            return amps
+
+        def bypass():
+            return os.environ.get("QUEST_SERVE_SHED_THRESHOLD")
+    """, name="fleetknobs.py")
+    assert not [v for v in vs if v.line in (7, 8, 9, 10)], vs
+    q1 = [v for v in vs if v.rule == "QL001"]
+    assert len(q1) == 1 and q1[0].line == 15, vs
+    assert "scope='runtime'" in q1[0].message, q1
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and q4[0].line == 20, vs
+    assert "bypasses" in q4[0].message, q4
+
+
+def test_fleet_knobs_registered_with_loud_parsers():
+    """The fleet knobs are registry-backed with malformed samples that
+    REJECT loudly (docs/CONFIG.md parity rides test_docs.py), and their
+    parsers enforce the documented ranges."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_SERVE_REPLICAS", "QUEST_SERVE_TENANT_QUOTA",
+                 "QUEST_SERVE_SHED_THRESHOLD", "QUEST_SERVE_PRIORITIES"):
+        k = KNOBS[name]
+        assert k.scope == "runtime" and k.layer == "serve", k
+        assert k.malformed is not None
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    assert KNOBS["QUEST_SERVE_REPLICAS"].default == 2
+    assert KNOBS["QUEST_SERVE_TENANT_QUOTA"].parse(
+        "alice=4,default=16") == {"alice": 4, "default": 16}
+    # the default is a callable (each read gets a fresh dict — a shared
+    # mutable default could be corrupted by one caller for all)
+    assert callable(KNOBS["QUEST_SERVE_TENANT_QUOTA"].default)
+    with pytest.raises(ValueError):
+        KNOBS["QUEST_SERVE_SHED_THRESHOLD"].parse("1.5")
+    assert KNOBS["QUEST_SERVE_PRIORITIES"].default == 2
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax

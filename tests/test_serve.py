@@ -642,7 +642,11 @@ def test_serve_knobs_registered_runtime_scope():
     names = {n for n in KNOBS if n.startswith("QUEST_SERVE_")}
     assert names == {"QUEST_SERVE_MAX_WAIT_MS", "QUEST_SERVE_MAX_QUEUE",
                      "QUEST_SERVE_MAX_BATCH", "QUEST_SERVE_RESTART_MAX",
-                     "QUEST_SERVE_BREAKER_THRESHOLD"}
+                     "QUEST_SERVE_BREAKER_THRESHOLD",
+                     # the fleet layer (ISSUE 12, docs/SERVING.md §fleet)
+                     "QUEST_SERVE_REPLICAS", "QUEST_SERVE_TENANT_QUOTA",
+                     "QUEST_SERVE_SHED_THRESHOLD",
+                     "QUEST_SERVE_PRIORITIES"}
     for n in names:
         k = KNOBS[n]
         assert k.scope == "runtime" and k.layer == "serve", k
