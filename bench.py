@@ -1359,7 +1359,7 @@ _MULTICHIP_WORKER = r'''
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-import json, sys
+import json, os, sys
 import numpy as np
 sys.path.insert(0, %(repo)r)
 from jax.sharding import Mesh
@@ -1378,26 +1378,46 @@ scenarios = {
     "headline": (bench._build_circuit(14), 14),
     "deepglobal": (bench._build_deep_global_circuit(6, 6), 6),
 }
+# topology knob passthrough (scripts/tpu_pod_bench.sh exports it on a
+# real pod); the dryrun default prices the hosts=2 model so the
+# trajectory always carries a DCI column
+topology_spec = os.environ.get("QUEST_COMM_TOPOLOGY", "hosts=2")
 out = {"metric": "multichip comm plan (8-device dryrun mesh)",
-       "unit": "bytes/device"}
+       "unit": "bytes/device",
+       "topology": topology_spec}
 for name, (c, n) in scenarios.items():
     for engine in ("banded", "pergate"):
-        rec = sharded_schedule(c.ops, n, False, mesh, engine=engine)
-        # the plan->predict->assert contract, INSIDE the bench: a comm
-        # trajectory whose planned and lowered schedules disagree is a
-        # predictor drift, not a measurement
-        assert rec["comm_matches_hlo"], (name, engine, rec)
-        pre = f"{name}_{engine}_"
-        out[pre + "comm_exchanges"] = rec["comm_exchanges"]
-        out[pre + "comm_bytes"] = rec["comm_bytes"]
-        out[pre + "comm_collectives"] = (rec["collective_exchanges"]
-                                         + rec["all_reduces"])
-        out[pre + "comm_strategy"] = rec["comm_strategy"]
-# headline trajectory keys for MULTICHIP_r*.json (banded = the pod path)
+        for tag, spec in (("", "0"), ("hier_", topology_spec)):
+            os.environ["QUEST_COMM_TOPOLOGY"] = spec
+            rec = sharded_schedule(c.ops, n, False, mesh, engine=engine)
+            # the plan->predict->assert contract, INSIDE the bench: a
+            # comm trajectory whose planned and lowered schedules
+            # disagree is a predictor drift, not a measurement — and
+            # the ICI/DCI split must tile the asserted total exactly
+            assert rec["comm_matches_hlo"], (name, engine, tag, rec)
+            pre = f"{name}_{engine}_{tag}"
+            out[pre + "comm_exchanges"] = rec["comm_exchanges"]
+            out[pre + "comm_bytes"] = rec["comm_bytes"]
+            out[pre + "comm_collectives"] = (rec["collective_exchanges"]
+                                             + rec["all_reduces"])
+            out[pre + "comm_strategy"] = rec["comm_strategy"]
+            if tag:
+                out[pre + "comm_ici_bytes"] = rec["comm_ici_bytes"]
+                out[pre + "comm_dci_bytes"] = rec["comm_dci_bytes"]
+                out[pre + "comm_dci_exchanges"] = \
+                    rec["comm_dci_exchanges"]
+                out[pre + "topology"] = rec["comm_topology"]
+# headline trajectory keys for MULTICHIP_r*.json (banded = the pod
+# path; the flat record keeps the PR-8 columns comparable, the hier_
+# record carries the topology round's DCI split)
 out["value"] = out["deepglobal_banded_comm_bytes"]
 out["comm_exchanges"] = out["deepglobal_banded_comm_exchanges"]
 out["comm_bytes"] = out["deepglobal_banded_comm_bytes"]
 out["comm_collectives"] = out["deepglobal_banded_comm_collectives"]
+out["comm_ici_bytes"] = out["deepglobal_banded_hier_comm_ici_bytes"]
+out["comm_dci_bytes"] = out["deepglobal_banded_hier_comm_dci_bytes"]
+out["comm_dci_exchanges"] = \
+    out["deepglobal_banded_hier_comm_dci_exchanges"]
 print(json.dumps(out))
 '''
 

@@ -522,6 +522,293 @@ def save_step(root: str, step: int, *, qureg: Qureg = None, arrays=None,
 
 
 # ---------------------------------------------------------------------------
+# gang-consistent multi-host step checkpoints (two-phase commit)
+# ---------------------------------------------------------------------------
+#
+# The durable executor on a MULTI-HOST mesh (2-process gloo in tests, a
+# real pod slice in production) cannot use save_step: no single host
+# holds the full planes, and H independent per-host checkpoints could
+# commit on some hosts and not others — a resume would then splice two
+# different cuts. The gang protocol below writes ONE checkpoint per
+# cursor step, committed ALL-OR-NOTHING, with NO collectives in the
+# protocol itself (a host killed mid-save must never hang the
+# survivors in a barrier — the reason this is hand-rolled instead of
+# riding orbax's coordination-service save, whose internal barriers
+# would deadlock exactly the mid-save-kill case the tests pin;
+# docs/RESILIENCE.md §gang-consistent durable):
+#
+#   PREPARE  each host atomically writes its addressable slice
+#            (shard-<p>.npz) plus its own digested meta (meta-<p>.json,
+#            carrying the cursor) into the SHARED tmp dir, then stamps
+#            prepared-<p>. The checkpoint.save fault site fires before
+#            the stamp — an injected mid-save crash leaves the gang
+#            unprepared forever.
+#   COMMIT   whichever host completes the prepared set LAST renames the
+#            tmp dir to ckpt-<step> — one atomic syscall; the rename
+#            race between simultaneous completers is benign (one wins,
+#            the loser sees the committed target). A missing stamp
+#            means NO host ever commits: all hosts stamp or none do.
+#
+# Validity is a GANG property computed identically on every host:
+# load_step_gang verifies EVERY shard's digests (not just its own), so
+# corruption anywhere makes all hosts skip to the same older
+# checkpoint — hosts can never resume from different cuts without a
+# coordinator. Requires a shared filesystem across hosts (GCS/NFS on a
+# pod; /tmp in the gloo tests), like every multi-host checkpointer.
+
+
+def _gang_shard_meta(qureg: Qureg, process_index: int,
+                     process_count: int, extra) -> Tuple[dict, dict]:
+    """(meta, arrays) of THIS host's contiguous slice of the sharded
+    plane pair. The slice bounds ride the meta so load can reassemble
+    without knowing the sharding that wrote it."""
+    shards = sorted(qureg.amps.addressable_shards,
+                    key=lambda s: s.index[-1].start or 0)
+    lo = shards[0].index[-1].start or 0
+    nxt = lo
+    datas = []
+    for s in shards:
+        start = s.index[-1].start or 0
+        if start != nxt:
+            raise CheckpointError(
+                f"gang checkpointing requires a contiguous per-host "
+                f"slice (1-D amplitude meshes); got shard at column "
+                f"{start}, expected {nxt}")
+        data = np.asarray(jax.device_get(s.data))
+        datas.append(data)
+        nxt = start + data.shape[-1]
+    block = np.concatenate(datas, axis=-1)
+    meta = dict(_meta(qureg))
+    meta.update({
+        "payload": "gang-shard",
+        "process_index": process_index,
+        "process_count": process_count,
+        "slice_lo": int(lo),
+        "slice_hi": int(lo + block.shape[-1]),
+    })
+    if extra is not None:
+        meta["extra"] = extra
+    return meta, {"planes": block}
+
+
+def save_step_gang(root: str, step: int, *, qureg: Qureg, extra=None,
+                   keep: int = None) -> Optional[str]:
+    """Gang-consistent versioned checkpoint `root/ckpt-<step>` of a
+    multi-host sharded register: every participating process calls this
+    with the same arguments; each writes only its addressable slice
+    into the SHARED tmp dir, stamps prepared-<p>, and whichever host
+    completes the stamp set commits with one atomic rename — all hosts
+    stamp or none do, and no step of the protocol waits on another
+    host (docs/RESILIENCE.md §gang-consistent durable).
+
+    Returns the committed path when THIS host performed the commit,
+    None otherwise (the commit may land on any host; it is
+    all-or-nothing either way). A retry of the same step — a resumed
+    run replaying to the same cut after a mid-save kill — reuses the
+    tmp dir: execution is deterministic from the shared resume point,
+    so a surviving stale shard is bit-identical to what the retry
+    would rewrite, and a peer committing mid-rewrite is benign (the
+    writes below tolerate the tmp dir vanishing into a committed
+    target). Single-process meshes fall through to the plain atomic
+    save_step."""
+    p = jax.process_index()
+    nproc = jax.process_count()
+    if nproc == 1:
+        return save_step(root, step, qureg=qureg, extra=extra, keep=keep)
+    path = step_path(root, step)
+    tmp = f"{path}.tmp-gang"
+    meta, arrays = _gang_shard_meta(qureg, p, nproc, extra)
+    meta["plane_digests"] = _plane_digests(arrays)
+    meta["meta_digest"] = _meta_digest(meta)
+    tag = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+
+    def _put(name, write):
+        """Atomically publish tmp/<name>: write to a dotfile sibling,
+        rename into place. A committed target with NO tmp beside it
+        means a peer already took this very step (deterministic-replay
+        retry race) — checked before makedirs, which would otherwise
+        resurrect the renamed-away tmp dir and strand a stray copy
+        holding only this host's files. ENOENT mid-write means the tmp
+        vanished under us (a peer committed, or finished the run and
+        cleared the chain); either way THIS host's contribution is
+        moot and skipping is benign (the checkpoint is all-or-nothing
+        regardless)."""
+        try:
+            if not os.path.isdir(tmp) and os.path.isdir(path):
+                return False
+            os.makedirs(tmp, exist_ok=True)
+            scratch = os.path.join(tmp, f".{name}-{tag}")
+            write(scratch)
+            os.rename(scratch, os.path.join(tmp, name))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def _write_npz(dst):
+        with open(dst, "wb") as f:
+            np.savez(f, **arrays)
+
+    def _write_meta(dst):
+        with open(dst, "w") as f:
+            json.dump(meta, f)
+
+    def _write_stamp(dst):
+        with open(dst, "w") as f:
+            f.write("ok")
+
+    if not _put(f"shard-{p}.npz", _write_npz) \
+            or not _put(f"meta-{p}.json", _write_meta):
+        return None          # a peer committed this very step already
+    # the mid-save crash point: firing here (AFTER the payload, BEFORE
+    # the stamp) emulates a host killed mid-save — its stamp never
+    # appears, so NO host ever commits this step (all-or-nothing)
+    if faults.ACTIVE:
+        faults.check("checkpoint.save", directory=path, tmp=tmp,
+                     process=p)
+    if not _put(f"prepared-{p}", _write_stamp):
+        return None
+    committed = None
+    if all(os.path.exists(os.path.join(tmp, f"prepared-{q}"))
+           for q in range(nproc)):
+        # this host completed the set: commit. Two completers may race
+        # here — exactly one rename succeeds; the loser's tmp is GONE
+        # (the winner renamed it away, and may even have finished the
+        # run and consumed the chain already), which is success by
+        # proxy, not an error. A same-step leftover target (an earlier
+        # chain generation whose commit was later skipped corrupt)
+        # still holds tmp in place: clear it and retry once.
+        for attempt in range(2):
+            try:
+                os.rename(tmp, path)
+                committed = path
+                break
+            except OSError:
+                if not os.path.isdir(tmp):
+                    break            # a peer took the commit
+                if os.path.isdir(path) and attempt == 0:
+                    shutil.rmtree(path, ignore_errors=True)
+                    continue
+                raise
+    if committed:
+        # keep-last-K over COMMITTED checkpoints only. prune_steps'
+        # stale sweep is deliberately skipped here: a live gang tmp
+        # belongs to every host at once, and a fast host sweeping
+        # while a slow one still writes would tear the save —
+        # uncommitted leftovers are reclaimed at resume/completion
+        # instead (durable.py), when no save can be in flight.
+        if keep is None:
+            from quest_tpu.env import knob_value
+            keep = knob_value("QUEST_CHECKPOINT_KEEP")
+        for _, old in step_dirs(root)[:-max(int(keep), 1)]:
+            shutil.rmtree(old, ignore_errors=True)
+    return committed
+
+
+def load_step_gang(path: str, *, kind_extra: str = None):
+    """(metas, planes) of a gang checkpoint committed by
+    save_step_gang: `metas` is the per-process meta list (cursors
+    verified IDENTICAL across hosts), `planes` the reassembled full
+    (2, 2^n) array. EVERY shard's digests verify on EVERY host — gang
+    validity must be a pure function of the shared directory, or two
+    hosts could resume from different cuts. Raises CheckpointError on
+    any missing/corrupt/mismatched piece."""
+    if faults.ACTIVE:
+        faults.check("checkpoint.load", directory=path)
+    meta0_path = os.path.join(path, "meta-0.json")
+    if not os.path.exists(meta0_path):
+        raise CheckpointError(
+            f"Invalid checkpoint: {path!r} holds no gang meta "
+            f"(meta-0.json) — not a gang checkpoint directory")
+    metas = []
+    try:
+        with open(meta0_path) as f:
+            meta0 = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"Invalid checkpoint: {meta0_path!r} is corrupt or "
+            f"truncated ({e})") from e
+    if not isinstance(meta0, dict) \
+            or _meta_digest(meta0) != meta0.get("meta_digest"):
+        # verify the self-digest BEFORE touching any field: a
+        # corrupt-but-parseable meta must surface as the one documented
+        # error the resume chain skips, never a leaked KeyError
+        raise CheckpointError(
+            f"Invalid checkpoint: {meta0_path!r} fails its meta "
+            f"self-digest — altered after save; refusing to load")
+    nproc = meta0.get("process_count")
+    if not isinstance(nproc, int) or nproc < 1:
+        raise CheckpointError(
+            f"Invalid checkpoint: {meta0_path!r} carries no valid "
+            f"process_count")
+    nq = meta0.get("num_qubits")
+    dens = meta0.get("is_density")
+    if not isinstance(nq, int) or not isinstance(dens, bool) \
+            or not 0 < nq < 64:
+        raise CheckpointError(
+            f"Invalid checkpoint: {meta0_path!r} carries no valid "
+            f"num_qubits/is_density")
+    total = 1 << (2 * nq if dens else nq)
+    planes = None
+    extra0 = None
+    for q in range(nproc):
+        mpath = os.path.join(path, f"meta-{q}.json")
+        spath = os.path.join(path, f"shard-{q}.npz")
+        try:
+            with open(mpath) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointError(
+                f"Invalid checkpoint: {mpath!r} is missing or corrupt "
+                f"({e})") from e
+        md = meta.get("meta_digest")
+        if md is None or _meta_digest(meta) != md:
+            raise CheckpointError(
+                f"Invalid checkpoint: {mpath!r} fails its meta "
+                f"self-digest — cursor altered after save; refusing "
+                f"to load")
+        try:
+            with np.load(spath) as data:
+                block = data["planes"]
+        except Exception as e:
+            raise CheckpointError(
+                f"Invalid checkpoint: shard file {spath!r} is missing, "
+                f"corrupt or truncated ({type(e).__name__}: {e})") from e
+        for name, expect in sorted(meta.get("plane_digests",
+                                            {}).items()):
+            target = _digest_target(name, {"planes": block})
+            if target is None or _digest(np.asarray(target)) != expect:
+                raise CheckpointError(
+                    f"Invalid checkpoint: plane {name!r} of {spath!r} "
+                    f"fails its integrity digest — refusing to restore")
+        lo, hi = meta["slice_lo"], meta["slice_hi"]
+        if block.shape[-1] != hi - lo or hi > total:
+            raise CheckpointError(
+                f"Invalid checkpoint: shard {q} of {path!r} declares "
+                f"slice [{lo}, {hi}) but holds {block.shape[-1]} "
+                f"columns of a {total}-amp register")
+        if planes is None:
+            planes = np.zeros(block.shape[:-1] + (total,),
+                              dtype=block.dtype)
+        planes[..., lo:hi] = block
+        ex = meta.get("extra")
+        if q == 0:
+            extra0 = ex
+        elif ex != extra0:
+            raise CheckpointError(
+                f"Invalid checkpoint: gang cursors disagree between "
+                f"process 0 and {q} under {path!r} — a torn save; "
+                f"refusing to load")
+        metas.append(meta)
+    if kind_extra is not None:
+        cur = extra0 if isinstance(extra0, dict) else {}
+        if cur.get("kind") != kind_extra:
+            raise CheckpointError(
+                f"Invalid checkpoint: {path!r} carries no "
+                f"{kind_extra!r} durable cursor")
+    return metas, planes
+
+
+# ---------------------------------------------------------------------------
 # sharded checkpoints (orbax): per-device files, no host gather
 # ---------------------------------------------------------------------------
 

@@ -472,13 +472,23 @@ def _comm_plan_line(rec: dict) -> str:
     the engine and tests/test_comm.py would be red."""
     verdict = ("matches" if rec.get("comm_matches_hlo")
                else "MISMATCH vs")
-    return (f"  comm plan: {rec.get('comm_strategy', '?')} "
+    line = (f"  comm plan: {rec.get('comm_strategy', '?')} "
             f"(QUEST_COMM_PLAN={1 if rec.get('comm_plan_enabled') else 0})"
             f": {rec.get('comm_exchanges', 0)} exchange(s) = "
             f"{rec.get('comm_collective_permutes', 0)} collective-"
             f"permute(s) + {rec.get('comm_all_to_alls', 0)} "
             f"all-to-all(s), {_human_bytes(rec.get('comm_bytes', 0))} "
             f"ICI per device planned [{verdict} lowered StableHLO]")
+    topo = rec.get("comm_topology") or {}
+    if topo.get("hosts", 1) > 1:
+        line += (f"\n  topology: {topo['hosts']} host(s), "
+                 f"{rec.get('comm_dci_exchanges', 0)} DCI-crossing "
+                 f"exchange(s), "
+                 f"{_human_bytes(rec.get('comm_dci_bytes', 0))} DCI + "
+                 f"{_human_bytes(rec.get('comm_ici_bytes', 0))} ICI "
+                 f"per device (weights ici={topo['ici_weight']}, "
+                 f"dci={topo['dci_weight']})")
+    return line
 
 
 class Circuit:
@@ -1432,13 +1442,18 @@ class Circuit:
         if items is None:
             items = F.plan(flat_r, n, bands=bands)
         rdt = precision.real_dtype_of(precision.get_default_dtype())
-        rec = C.comm_stats(C.predict_exchanges_items(items, local_n),
+        topo = C.topology(devices)
+        ici_b = topo.ici_bits(devices) if topo.hierarchical else None
+        rec = C.comm_stats(C.predict_exchanges_items(items, local_n,
+                                                     ici_b),
                            num_devices=devices,
-                           bytes_per_real=np.dtype(rdt).itemsize)
+                           bytes_per_real=np.dtype(rdt).itemsize,
+                           topo=topo)
         rec.update({
             "devices": devices,
             "comm_strategy": cinfo.get("strategy", "plain"),
             "comm_plan_enabled": C.plan_enabled(),
+            "comm_topology": topo.describe(devices),
             "relabel_events": sum(1 for op in flat_r
                                   if op.kind == "relabel"),
         })

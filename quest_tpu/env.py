@@ -152,6 +152,70 @@ def _parse_exchange_slices(raw: str) -> int:
     return v
 
 
+def _parse_comm_topology(raw: str):
+    """QUEST_COMM_TOPOLOGY grammar: '0' (flat — reproduce the PR-8
+    planner bit-for-bit) or 'hosts=H[,ici=X][,dci=Y]' — devices grouped
+    into H hosts (contiguous, matching jax's host-major device order),
+    intra-host links weighted X (default 1) and cross-host links Y
+    (default 4). Returns 0 or a (hosts, ici, dci) tuple; comm.topology()
+    turns it into the Topology the planner prices with."""
+    if raw == "0":
+        return 0
+    hosts, ici, dci = None, 1.0, 4.0
+    for part in raw.split(","):
+        if "=" not in part:
+            raise ValueError(
+                f"QUEST_COMM_TOPOLOGY must be '0' or "
+                f"'hosts=H[,ici=X][,dci=Y]', got {raw!r}")
+        key, val = part.split("=", 1)
+        key = key.strip()
+        try:
+            if key == "hosts":
+                hosts = int(val)
+            elif key in ("ici", "dci"):
+                v = float(val)
+                if not (v > 0):
+                    raise ValueError
+                if key == "ici":
+                    ici = v
+                else:
+                    dci = v
+            else:
+                raise KeyError(key)
+        except KeyError:
+            raise ValueError(
+                f"unknown QUEST_COMM_TOPOLOGY key {key!r} in {raw!r} "
+                f"(known: hosts, ici, dci)")
+        except ValueError:
+            raise ValueError(
+                f"QUEST_COMM_TOPOLOGY {key}= must be a positive "
+                f"{'integer' if key == 'hosts' else 'number'}, "
+                f"got {val!r}")
+    if hosts is None:
+        raise ValueError(
+            f"QUEST_COMM_TOPOLOGY must name hosts= (got {raw!r})")
+    if hosts < 1 or hosts & (hosts - 1):
+        raise ValueError(
+            f"QUEST_COMM_TOPOLOGY hosts must be a power of two >= 1 "
+            f"(device counts are powers of two, so any other host count "
+            f"cannot group them evenly), got {hosts}")
+    return (hosts, ici, dci)
+
+
+def _parse_dci_slices(raw: str) -> int:
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"QUEST_EXCHANGE_SLICES_DCI must be an integer, got {raw!r}")
+    if v < 0 or v > 1024 or (v and v & (v - 1)):
+        raise ValueError(
+            f"QUEST_EXCHANGE_SLICES_DCI must be 0 (follow "
+            f"QUEST_EXCHANGE_SLICES) or a power of two in [1, 1024], "
+            f"got {v}")
+    return v
+
+
 def _parse_pos_float(name: str) -> Callable[[str], float]:
     def parse(raw: str) -> float:
         try:
@@ -273,6 +337,24 @@ _KNOB_LIST = (
              "on real ICI (default: 1; power of two; NOT "
              "silicon-validated — A/B vs 1 on first chip run)",
          malformed="3", flips=("1", "4")),
+    Knob("QUEST_EXCHANGE_SLICES_DCI", _parse_dci_slices, 0,
+         scope="keyed", layer="planner",
+         doc="collective-permute slices for pair exchanges that CROSS "
+             "the host boundary (DCI links under QUEST_COMM_TOPOLOGY); "
+             "0 (default) follows QUEST_EXCHANGE_SLICES — slower links "
+             "want finer slicing so transfer overlaps compute longer "
+             "(power of two; NOT silicon-validated — A/B on first "
+             "multi-host run, scripts/ab_silicon.py)",
+         malformed="3", flips=("0", "4")),
+    Knob("QUEST_COMM_TOPOLOGY", _parse_comm_topology, None,
+         scope="keyed", layer="planner",
+         doc="hierarchical interconnect model for the comm planner "
+             "(docs/DISTRIBUTED.md §topology): 'hosts=H[,ici=X][,dci=Y]' "
+             "groups the mesh into H hosts with per-link cost weights "
+             "(defaults ici=1, dci=4); 0 forces the flat single-tier "
+             "model (bit-for-bit the PR-8 planner); unset auto-derives "
+             "host grouping from jax.devices() process ids",
+         malformed="hosts=three", flips=("0", "hosts=2")),
     Knob("QUEST_BATCH_BUCKET",
          _parse_choice("QUEST_BATCH_BUCKET", ("pow2", "off")), "pow2",
          scope="keyed", layer="planner",

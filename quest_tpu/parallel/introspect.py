@@ -186,21 +186,30 @@ def parse_collectives(stablehlo_text: str, num_devices: int = None) -> dict:
 
 
 def _merge_comm(rec: dict, predicted, cinfo: dict, D: int,
-                bytes_per_real: int) -> None:
+                bytes_per_real: int, topo=None) -> None:
     """Fold the comm planner's PREDICTED schedule into a sharded-
     schedule record and flag whether it matches XLA's lowered collective
     accounting — the plan->predict->assert contract (tests/test_comm.py
-    and bench.py multichip assert comm_matches_hlo)."""
+    and bench.py multichip assert comm_matches_hlo). Under a
+    hierarchical topology the record additionally splits the predicted
+    bytes into comm_ici_bytes/comm_dci_bytes (summing EXACTLY to the
+    HLO-asserted total — XLA's lowered text cannot see hosts, so the
+    split is the planner's, the total is the contract's)."""
     from quest_tpu.parallel import comm as C
+    if topo is None:
+        topo = C.topology(D)
     rec.update(C.comm_stats(predicted, num_devices=D,
-                            bytes_per_real=bytes_per_real))
+                            bytes_per_real=bytes_per_real, topo=topo))
     rec["comm_strategy"] = cinfo.get("strategy", "plain")
     rec["comm_plan_enabled"] = C.plan_enabled()
+    rec["comm_topology"] = topo.describe(D)
     rec["comm_matches_hlo"] = (
         rec["comm_collective_permutes"] == rec["collective_permutes"]
         and rec["comm_all_to_alls"] == rec["all_to_alls"]
         and rec["comm_exchanges"] == rec["collective_exchanges"]
-        and rec["comm_bytes"] == rec["ici_bytes_per_device"])
+        and rec["comm_bytes"] == rec["ici_bytes_per_device"]
+        and rec["comm_ici_bytes"] + rec["comm_dci_bytes"]
+        == rec["comm_bytes"])
 
 
 def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
@@ -245,6 +254,9 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
 
     from quest_tpu.parallel import comm as C
 
+    topo = C.topology(D)
+    ici_b = topo.ici_bits(D) if topo.hierarchical else None
+
     if engine == "pergate":
         # the per-gate engine runs one pass per op — band-plan stats
         # would describe passes it never executes. The op list comes
@@ -260,8 +272,8 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
             1 for op in gate_ops if max(op.targets) < local_n)
         rec["global_ops"] = len(gate_ops) - rec["local_ops"]
         rec["relabel_events"] = len(chosen) - len(gate_ops)
-        predicted = C.predict_exchanges_flat(chosen, local_n)
-        _merge_comm(rec, predicted, cinfo, D, bytes_per_real)
+        predicted = C.predict_exchanges_flat(chosen, local_n, ici_b)
+        _merge_comm(rec, predicted, cinfo, D, bytes_per_real, topo)
     else:
         # band layout AND op-list rewrite PER ENGINE, via the engines'
         # own helpers (S.engine_flat is the ONE home of the rewrite
@@ -289,8 +301,8 @@ def sharded_schedule(ops: Sequence, n: int, density: bool, mesh,
         items = cinfo.get("items")
         if items is None:
             items = F.plan(flat_r, n, bands=bands)
-        _merge_comm(rec, C.predict_exchanges_items(items, local_n),
-                    cinfo, D, bytes_per_real)
+        _merge_comm(rec, C.predict_exchanges_items(items, local_n, ici_b),
+                    cinfo, D, bytes_per_real, topo)
         rec["local_band_passes"] = sum(
             1 for it in items
             if isinstance(it, F.BandOp) and it.ql < local_n)
@@ -387,6 +399,8 @@ def sharded_measured_schedule(ops: Sequence, n: int, density: bool, mesh,
     # feedback applies its inner gates unconditionally (blended by the
     # outcome predicate), so they price at face value
     from quest_tpu.parallel import comm as C
+    topo = C.topology(D)
+    ici_b = topo.ici_bits(D) if topo.hierarchical else None
     predicted = []
     pred_psums = 0
     for el in program:
@@ -396,12 +410,12 @@ def sharded_measured_schedule(ops: Sequence, n: int, density: bool, mesh,
                 pred_psums += 1
             else:
                 for gop in op.operand[0]:
-                    predicted += C.gateop_exchanges(gop, local_n)
+                    predicted += C.gateop_exchanges(gop, local_n, ici_b)
         else:
-            predicted += C.predict_exchanges_items(el[1], local_n)
+            predicted += C.predict_exchanges_items(el[1], local_n, ici_b)
     _merge_comm(rec, predicted,
                 {"strategy": "relabel" if relabel else "plain"},
-                D, bytes_per_real)
+                D, bytes_per_real, topo)
     rec["comm_all_reduces"] = pred_psums
     rec["comm_matches_hlo"] = (rec["comm_matches_hlo"]
                                and pred_psums == rec["all_reduces"])

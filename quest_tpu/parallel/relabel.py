@@ -327,7 +327,8 @@ def _schedule_cost(ops_list: Sequence, n: int, local_n: int) -> float:
 
 
 def plan_full_relabels(flat: Sequence, n: int, local_n: int,
-                       min_saved_chunks: float = 2.0) -> List:
+                       min_saved_chunks: float = 2.0,
+                       topo=None) -> List:
     """Layer-amortized relabeling for the FUSED sharded engine: rewrite
     `flat` so that stretches of global-qubit matrix work run LOCALLY
     between whole-register relabel events, each ONE all-to-all
@@ -356,7 +357,18 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
     half-chunk swap-dance, which is cheaper than a whole-register
     exchange. Emits kind='relabel' GateOps whose operand is the tuple
     of local slots receiving device bits (slot[j] <-> device bit j);
-    the trailing restore costs at most two events + free local swaps."""
+    the trailing restore costs at most two events + free local swaps.
+
+    `topo` (a comm.Topology, default flat) activates the hot-qubit
+    victim rule on hierarchical meshes: the Belady victim SET is
+    unchanged, but its assignment to device bits reverses so the
+    occupant with the SOONEST next matrix-target use lands on the
+    lowest device bit — intra-host ICI under the contiguous host
+    grouping — and the coldest absorb the DCI bits, keeping the qubits
+    the upcoming window touches most a cheap exchange away
+    (docs/DISTRIBUTED.md §topology). The flat default keeps the
+    original farthest-first order bit-for-bit."""
+    hot = topo is not None and getattr(topo, "hierarchical", False)
     g = n - local_n
     if g == 0 or g > local_n:
         # a full relabel swaps all g device bits with g DISTINCT local
@@ -425,7 +437,11 @@ def plan_full_relabels(flat: Sequence, n: int, local_n: int,
                 and any(perm[t] >= local_n for t in op.targets)):
             victims, fires = plan_event(i)
             if fires:
-                tr.emit_relabel(victims)
+                # victims arrive farthest-use first; the hot-qubit rule
+                # reverses the bit assignment (soonest reuse -> lowest
+                # = ICI device bit) without changing the victim set
+                tr.emit_relabel(list(reversed(victims)) if hot
+                                else victims)
         out.append(dataclasses.replace(
             op, targets=tuple(perm[t] for t in op.targets),
             controls=tuple(perm[c] for c in op.controls)))

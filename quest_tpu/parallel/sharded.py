@@ -92,13 +92,17 @@ def _mask_blend(new, old, local_n, loc_c, loc_s, pred):
 
 def _sliced_ppermute(block, D, gbit):
     """One pair exchange of `block` ((2, x) planes), split into
-    QUEST_EXCHANGE_SLICES independent collective-permutes
-    (comm.effective_slices is the shared clamp, so the predicted and
-    lowered collective counts agree at any knob value). Slicing lets the
-    compiler overlap transfer with the consuming compute on real ICI —
+    QUEST_EXCHANGE_SLICES independent collective-permutes — or
+    QUEST_EXCHANGE_SLICES_DCI when device bit `gbit` crosses the host
+    boundary of the QUEST_COMM_TOPOLOGY model (comm.effective_slices is
+    the shared clamp and comm.Topology.link_of the shared classifier,
+    so the predicted and lowered collective counts agree at any knob
+    value and per link class). Slicing lets the compiler overlap
+    transfer with the consuming compute on real ICI/DCI —
     structure-verifiable on the CPU mesh; wall-clock A/B deferred to
     first chip run (docs/DISTRIBUTED.md)."""
-    s = C.effective_slices(block.shape[-1])
+    s = C.effective_slices(block.shape[-1],
+                           C.topology(D).link_of(gbit, D))
     if s == 1:
         return lax.ppermute(block, AMP_AXIS, _pair_perm(D, gbit))
     xs = block.reshape(2, s, -1)
@@ -202,7 +206,8 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
                 dre * im + die * re + ore * rim + oie * rre,
             ])
 
-        s = C.effective_slices(chunk.shape[-1])
+        s = C.effective_slices(chunk.shape[-1],
+                               C.topology(D).link_of(gbit, D))
         if s == 1:
             recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
             new = combine(chunk, recv)

@@ -49,6 +49,7 @@ stays stdlib-only (env.py's knob parser imports it).
 from __future__ import annotations
 
 import hashlib
+import os
 import sys
 import time as _time
 from typing import List, Optional, Tuple
@@ -123,6 +124,31 @@ def _state_fingerprint(state: Qureg) -> str:
     else:
         payload = np.asarray(jax.device_get(amps[:, :4096]))
     h.update(memoryview(np.ascontiguousarray(payload)).cast("B"))
+    return h.hexdigest()[:32]
+
+
+_GANG_FP_FNS: dict = {}
+
+
+def _state_fingerprint_gang(state: Qureg) -> str:
+    """Fingerprint of a MULTI-HOST register: the gang cursor must be
+    IDENTICAL on every host (load_step_gang rejects torn saves), so the
+    per-host byte hash above cannot ride it — no host can read its
+    peers' shards without a gather. Instead: shape/dtype plus three
+    replicated global reductions (sum, sum of squares, max magnitude),
+    computed by the SAME SPMD program on every host and therefore
+    bit-equal across them; a different initial state or dtype still
+    fails typed at resume."""
+    amps = state.amps
+    key = (tuple(amps.shape), str(amps.dtype))
+    fn = _GANG_FP_FNS.get(key)
+    if fn is None:
+        def f(a):
+            return (jnp.sum(a), jnp.sum(a * a), jnp.max(jnp.abs(a)))
+        fn = _GANG_FP_FNS[key] = jax.jit(f)
+    vals = [float(v) for v in fn(amps)]
+    h = hashlib.sha256()
+    h.update(repr((key, vals)).encode())
     return h.hexdigest()[:32]
 
 
@@ -261,8 +287,20 @@ def _to_layout(amps, info: dict):
         return jnp.asarray(amps).reshape(2, -1, PB.LANES)
     if info["layout"] == "sharded":
         from quest_tpu.parallel.mesh import amp_sharding
+        sharding = amp_sharding(info["mesh"])
+        if jax.process_count() > 1:
+            # multi-host: the caller's register is already a global
+            # array (pass it through); a resume's reassembled host
+            # planes must enter via make_array_from_callback — a
+            # device_put cannot target non-addressable devices
+            if isinstance(amps, jax.Array) \
+                    and not amps.is_fully_addressable:
+                return amps.reshape(2, -1)
+            arr = np.asarray(amps).reshape(2, -1)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
         return jax.device_put(jnp.asarray(amps).reshape(2, -1),
-                              amp_sharding(info["mesh"]))
+                              sharding)
     return jnp.asarray(amps).reshape(2, -1)
 
 
@@ -391,6 +429,35 @@ def _latest_valid(directory: str, kind: str, registry=None):
     return None
 
 
+def _latest_valid_gang(directory: str, kind: str, registry=None):
+    """Gang counterpart of _latest_valid: newest COMMITTED gang
+    checkpoint whose every shard digests cleanly and whose per-host
+    cursors agree. Validity is a pure function of the shared directory
+    (load_step_gang verifies ALL shards on every host), so every host
+    independently lands on the SAME checkpoint — a mid-save kill left
+    its step uncommitted, and corruption anywhere skips the whole gang
+    to the same older cut. Returns (cursor, planes, path) or None."""
+    for step, path in reversed(ckpt.step_dirs(directory)):
+        try:
+            metas, planes = ckpt.load_step_gang(path, kind_extra=kind)
+            cursor = metas[0].get("extra")
+            cut = cursor.get("step")
+            if int(cut) != step:
+                raise ckpt.CheckpointError(
+                    f"Invalid checkpoint: {path!r} carries cursor cut "
+                    f"{cut!r}, directory name says {step}")
+        except (ckpt.CheckpointError, OSError,
+                faults.InjectedFault) as e:
+            _counter("durable_corrupt_checkpoints_skipped",
+                     registry).inc()
+            print(f"[durable] SKIPPING corrupt gang checkpoint "
+                  f"{path!r} ({e}); falling back to the previous one",
+                  file=sys.stderr, flush=True)
+            continue
+        return cursor, planes, path
+    return None
+
+
 def _clear_chain(directory: str) -> None:
     """A COMPLETED run consumes its resume chain: the checkpoints exist
     to finish this run, and leaving them would make a later run over
@@ -425,7 +492,16 @@ def run_durable(circuit, state: Qureg, directory: str, *,
     engine: None auto-resolves like apply_fused (Pallas kernels on the
     kernel tier at f32, banded XLA otherwise); 'fused' / 'banded' pin
     it; mesh= selects the sharded banded engine (its relabel
-    permutation rides the cursor and is re-verified at resume). Noise
+    permutation rides the cursor and is re-verified at resume). On a
+    MULTI-HOST mesh (jax.process_count() > 1) checkpointing is
+    GANG-CONSISTENT: every cursor step writes one shared checkpoint
+    through checkpoint.save_step_gang's two-phase commit — each host
+    stamps its shard, the last stamp commits atomically, a host killed
+    mid-save leaves the step uncommitted on EVERY host — and resume
+    validity is a pure function of the shared directory, so all hosts
+    independently resume the same cut, bit-identical to an
+    uninterrupted run (tests/test_gang.py; docs/RESILIENCE.md
+    §gang-consistent durable). Noise
     channels run through the density engines as usual; for trajectory
     unraveling use run_durable_trajectories. Integrity sentinels run at
     checkpoint cadence (QUEST_INTEGRITY / QUEST_INTEGRITY_TOL); a
@@ -451,6 +527,11 @@ def run_durable(circuit, state: Qureg, directory: str, *,
                                mesh)
     integrity = knob_value("QUEST_INTEGRITY")
     tol = knob_value("QUEST_INTEGRITY_TOL")
+    # multi-host gang mode: one gang-consistent checkpoint per cursor
+    # step (two-phase commit across the mesh's processes — all hosts
+    # stamp or none do, checkpoint.save_step_gang), cursor fields
+    # computed so they are bit-equal on every host
+    gang = mesh is not None and jax.process_count() > 1
 
     want = {
         "engine": engine,
@@ -463,16 +544,23 @@ def run_durable(circuit, state: Qureg, directory: str, *,
         "mode_key": info["mode_key"],
         "circuit_ops": info["circuit_ops"],
         "plan_sha": _ops_sha(circuit.ops),
-        "state_fp": _state_fingerprint(state),
+        "state_fp": (_state_fingerprint_gang(state) if gang
+                     else _state_fingerprint(state)),
     }
     start, baseline = 0, None
-    found = _latest_valid(directory, "state", registry)
+    if gang:
+        found = _latest_valid_gang(directory, "state", registry)
+    else:
+        found = _latest_valid(directory, "state", registry)
     if found is not None:
-        meta, arrays, cursor, path = found
+        if gang:
+            cursor, planes, path = found
+        else:
+            meta, arrays, cursor, path = found
+            planes = arrays["planes"]
         _validate_cursor(cursor, want, path)
         step = int(cursor["step"])
         _validate_cursor(cursor, {"perm": _cut_perm(info, step)}, path)
-        planes = arrays["planes"]
         if planes.shape != state.amps.shape:
             raise DurableError(
                 f"Invalid durable resume: checkpoint {path!r} holds "
@@ -498,21 +586,42 @@ def run_durable(circuit, state: Qureg, directory: str, *,
             # drain the async step queue BEFORE the checkpoint timer:
             # the first sync point would otherwise absorb the pending
             # steps' compute into the measured checkpoint cost
-            from quest_tpu.env import sync_array
-            sync_array(amps)
+            if gang:
+                # sync_array's tiny host slice is not addressable on
+                # every host of a multi-controller mesh
+                jax.block_until_ready(amps)
+            else:
+                from quest_tpu.env import sync_array
+                sync_array(amps)
             t0 = _time.perf_counter()
             if integrity:
                 _check_integrity(_sentinel_values(amps, info), baseline,
                                  tol, done, registry)
             cursor = dict(want, kind="state", step=done,
                           perm=_cut_perm(info, done), baseline=baseline)
-            ckpt.save_step(directory, done,
-                           qureg=state.replace_amps(
-                               _from_layout(amps, info)),
-                           extra=cursor, keep=keep)
-            _counter("durable_checkpoints_saved", registry).inc()
-            _registry_of(registry).gauge("durable_last_checkpoint_step").set(
-                done)
+            stamped = True
+            if gang:
+                committed = ckpt.save_step_gang(
+                    directory, done,
+                    qureg=state.replace_amps(_from_layout(amps, info)),
+                    extra=cursor, keep=keep)
+                # the commit may land on any host; count a saved
+                # checkpoint only when the committed dir is actually
+                # observable — a gang save a killed peer never stamped
+                # must not advance the metric (a slower peer
+                # committing later is counted by THAT host)
+                stamped = (committed is not None
+                           or os.path.isdir(ckpt.step_path(directory,
+                                                           done)))
+            else:
+                ckpt.save_step(directory, done,
+                               qureg=state.replace_amps(
+                                   _from_layout(amps, info)),
+                               extra=cursor, keep=keep)
+            if stamped:
+                _counter("durable_checkpoints_saved", registry).inc()
+                _registry_of(registry).gauge(
+                    "durable_last_checkpoint_step").set(done)
             # per-cut cost (sentinel + host gather + atomic write):
             # bench.py's durable scenario derives its overhead fraction
             # from this histogram — one instrumented run instead of a

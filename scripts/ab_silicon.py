@@ -149,6 +149,8 @@ elif mode == "sharded":
     dt = (time.perf_counter() - t0) / reps
     out(mode=mode, n=n, devices=2,
         slices=os.environ.get("QUEST_EXCHANGE_SLICES", "1"),
+        dci_slices=os.environ.get("QUEST_EXCHANGE_SLICES_DCI", "0"),
+        topology=os.environ.get("QUEST_COMM_TOPOLOGY", ""),
         ms_per_application=round(dt * 1e3, 2))
 else:
     raise SystemExit(f"unknown mode {mode!r}")
@@ -230,6 +232,21 @@ def main():
         v: run("sharded", ns, env={**env2, "QUEST_EXCHANGE_SLICES": v},
                reps=reps, interpret=interpret)
         for v in ("1", "4")}
+
+    # 6. the DCI leg (ISSUE 13 satellite): under a hosts=2 topology a
+    # 2-dev mesh's every exchange crosses the host boundary, so
+    # QUEST_EXCHANGE_SLICES_DCI alone governs the slicing — A/B finer
+    # DCI slicing against the unsliced baseline above. On a single-host
+    # chip pair this measures the knob's overhead floor; on a real
+    # multi-host slice it measures the overlap win (docs/DISTRIBUTED.md
+    # §topology).
+    report["exchange_slices_dci"] = {
+        v: run("sharded", ns,
+               env={**env2, "QUEST_EXCHANGE_SLICES": "1",
+                    "QUEST_EXCHANGE_SLICES_DCI": v,
+                    "QUEST_COMM_TOPOLOGY": "hosts=2"},
+               reps=reps, interpret=interpret)
+        for v in ("0", "4")}
 
     print("[ab-silicon] " + json.dumps(report), flush=True)
     print(json.dumps(report, indent=1))

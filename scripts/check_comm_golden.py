@@ -12,11 +12,17 @@ Gates (8-device shard geometry, f64 registers):
   * banded engine: planned bytes no worse than BOTH its pre-lazy
     baseline (the plain composed schedule) and its layer-amortized
     relabel incumbent — the planner can only ever improve it;
-  * absolute ceilings on the chosen plan (6 all-to-alls / 672 B).
+  * absolute ceilings on the chosen plan (6 all-to-alls / 672 B);
+  * TOPOLOGY (docs/DISTRIBUTED.md §topology): under the hosts=2 model
+    the hierarchical plan's DCI bytes must sit >= 2x below the flat
+    plan's DCI share (the cluster-coalescing headline: 384 -> 192 B),
+    and with the topology FLAT the chosen plan must be byte-identical
+    to the pre-topology goldens (6 events / 672 B EXACTLY — the
+    knob-off bit-for-bit contract).
 
 The goldens live HERE (the CI gate) and are mirrored by the tier-1
-assertions in tests/test_comm.py; a planner change that moves either
-must update both, consciously.
+assertions in tests/test_comm.py + tests/test_topology.py; a planner
+change that moves either must update both, consciously.
 """
 
 import json
@@ -24,12 +30,16 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the flat goldens must not move under a user's ambient topology knob
+os.environ.pop("QUEST_COMM_TOPOLOGY", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 DEEPGLOBAL_GOLDEN_EXCHANGES = 6
 DEEPGLOBAL_GOLDEN_BYTES = 672       # f64, 8 devices
+DEEPGLOBAL_FLAT_DCI_BYTES = 384     # the 6 a2as' cross-host share, hosts=2
+DEEPGLOBAL_HIER_DCI_CEILING = 192   # >= 2x below flat (measured exactly 2x)
 N, DEPTH, DEVICES = 6, 6, 8
 BPR = 8                              # f64 planes
 
@@ -50,10 +60,13 @@ def main() -> int:
         return C.comm_stats(C.predict_exchanges_flat(lst, local_n),
                             num_devices=DEVICES, bytes_per_real=BPR)
 
-    def stats_items(lst):
+    def stats_items(lst, topo=None):
         items = F.plan(lst, N, bands=S._shard_bands(N, local_n))
-        return C.comm_stats(C.predict_exchanges_items(items, local_n),
-                            num_devices=DEVICES, bytes_per_real=BPR)
+        ib = topo.ici_bits(DEVICES) if (topo and topo.hierarchical) \
+            else None
+        return C.comm_stats(C.predict_exchanges_items(items, local_n, ib),
+                            num_devices=DEVICES, bytes_per_real=BPR,
+                            topo=topo)
 
     pg_info: dict = {}
     pg = stats_flat(S.pergate_flat(c.ops, N, False, local_n,
@@ -66,6 +79,24 @@ def main() -> int:
     bd_relabel = stats_items(R.plan_full_relabels(
         list(F.maybe_schedule(flat, N)), N, local_n))
 
+    # topology gate: price the deep-global circuit under the hosts=2
+    # hierarchical model — the flat planner's chosen plan (its DCI
+    # share re-priced) vs the hierarchical planner's choice — and
+    # verify the FLAT choice is byte-identical to the pre-topology
+    # goldens (the knob-off contract: QUEST_COMM_TOPOLOGY=0 plans
+    # bit-for-bit like PR 8)
+    topo2 = C.Topology(hosts=2)
+    flat_sched = list(F.maybe_schedule(flat, N))
+    bands = S._shard_bands(N, local_n)
+    flat_plan, flat_info = C.choose_plan(flat_sched, N, local_n,
+                                         engine="banded", bands=bands,
+                                         topo=C.FLAT)
+    hier_plan, hier_info = C.choose_plan(flat_sched, N, local_n,
+                                         engine="banded", bands=bands,
+                                         topo=topo2)
+    flat_h = stats_items(flat_plan, topo2)    # flat plan, hier pricing
+    hier_h = stats_items(hier_plan, topo2)
+
     rec = {
         "pergate_bytes": pg["comm_bytes"],
         "pergate_exchanges": pg["comm_exchanges"],
@@ -76,9 +107,39 @@ def main() -> int:
         "banded_strategy": bd_info.get("strategy"),
         "banded_plain_bytes": bd_plain["comm_bytes"],
         "banded_relabel_bytes": bd_relabel["comm_bytes"],
+        "flat_dci_bytes": flat_h["comm_dci_bytes"],
+        "hier_dci_bytes": hier_h["comm_dci_bytes"],
+        "hier_dci_exchanges": hier_h["comm_dci_exchanges"],
+        "hier_strategy": hier_info.get("strategy"),
     }
     print(json.dumps(rec))
     ok = True
+    flat_b = stats_items(flat_plan)
+    if (flat_b["comm_bytes"] != DEEPGLOBAL_GOLDEN_BYTES
+            or flat_b["comm_exchanges"] != DEEPGLOBAL_GOLDEN_EXCHANGES):
+        print(f"REGRESSION: flat-topology plan "
+              f"{flat_b['comm_exchanges']} events / "
+              f"{flat_b['comm_bytes']} B not IDENTICAL to the "
+              f"pre-topology goldens "
+              f"({DEEPGLOBAL_GOLDEN_EXCHANGES} / "
+              f"{DEEPGLOBAL_GOLDEN_BYTES}) — the knob-off bit-for-bit "
+              f"contract is broken", file=sys.stderr)
+        ok = False
+    if flat_h["comm_dci_bytes"] != DEEPGLOBAL_FLAT_DCI_BYTES:
+        print(f"REGRESSION: flat plan's hosts=2 DCI share "
+              f"{flat_h['comm_dci_bytes']} != golden "
+              f"{DEEPGLOBAL_FLAT_DCI_BYTES}", file=sys.stderr)
+        ok = False
+    if 2 * hier_h["comm_dci_bytes"] > flat_h["comm_dci_bytes"]:
+        print(f"REGRESSION: hierarchical DCI bytes "
+              f"{hier_h['comm_dci_bytes']} not >= 2x below the flat "
+              f"plan's {flat_h['comm_dci_bytes']}", file=sys.stderr)
+        ok = False
+    if hier_h["comm_dci_bytes"] > DEEPGLOBAL_HIER_DCI_CEILING:
+        print(f"REGRESSION: hierarchical DCI bytes "
+              f"{hier_h['comm_dci_bytes']} > ceiling "
+              f"{DEEPGLOBAL_HIER_DCI_CEILING}", file=sys.stderr)
+        ok = False
     if 2 * pg["comm_bytes"] > pg_lazy["comm_bytes"]:
         print(f"REGRESSION: per-gate planned bytes {pg['comm_bytes']} "
               f"not >=2x below the lazy-relabel plan "

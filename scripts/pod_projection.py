@@ -8,9 +8,16 @@ sharded engine, lowers it to StableHLO over a 256-virtual-device mesh
 (tracing allocates no state), and derives the wall-clock from the
 program's OWN collective/pass schedule plus stated hardware constants.
 
+The communication term prices through the planner's HIERARCHICAL
+topology model (docs/DISTRIBUTED.md §topology): the mesh splits into
+--hosts groups, the planner optimizes for weighted link cost (so the
+schedule it prices is the one a topology-aware run would execute), and
+the projection charges the verified intra-host bytes at --ici GB/s and
+the cross-host share at --dci GB/s separately.
+
 Outputs one JSON object; assumptions are fields, not prose, so the
 projection recomputes under different constants
-(--hbm/--ici GB/s). See docs/POD_PROJECTION.md for the analysis,
+(--hbm/--ici/--dci GB/s, --hosts). See docs/POD_PROJECTION.md for the analysis,
 including why the reference side of the north star is infeasible as
 stated (QuEST cannot hold 2^40 amplitudes on 32 A100s at any precision).
 
@@ -51,8 +58,11 @@ lower_s = time.time() - t0
 
 # the projection builds on the comm planner's metric, which must match
 # XLA's lowered accounting — a projection from a drifted predictor
-# would be fiction (tests/test_comm.py pins this; re-asserted here)
+# would be fiction (tests/test_comm.py pins this; re-asserted here).
+# The hierarchical split must also tile the asserted total exactly.
 assert rec["comm_matches_hlo"], rec
+assert rec["comm_ici_bytes"] + rec["comm_dci_bytes"] \
+    == rec["comm_bytes"], rec
 
 print(json.dumps({
     "gates": len(c.ops), "lower_s": round(lower_s, 2),
@@ -60,7 +70,11 @@ print(json.dumps({
     "comm_exchanges": rec["comm_exchanges"],
     "comm_all_to_alls": rec["comm_all_to_alls"],
     "comm_bytes": rec["comm_bytes"],
+    "comm_ici_bytes": rec["comm_ici_bytes"],
+    "comm_dci_bytes": rec["comm_dci_bytes"],
+    "comm_dci_exchanges": rec["comm_dci_exchanges"],
     "comm_strategy": rec["comm_strategy"],
+    "comm_topology": rec["comm_topology"],
     "ici_bytes_per_device_per_step": rec["ici_bytes_per_device"],
     "local_band_passes": rec["local_band_passes"],
     "global_qubit_items": rec["global_qubit_items"],
@@ -85,6 +99,15 @@ def main():
     ap.add_argument("--ici", type=float, default=450.0,
                     help="per-chip ICI egress GB/s (default: conservative "
                     "v5p 3D-torus estimate)")
+    ap.add_argument("--hosts", type=int, default=64,
+                    help="hosts the mesh splits into for the "
+                    "hierarchical comm model (QUEST_COMM_TOPOLOGY; "
+                    "default: 64 — a v5p-256 pod slice is 64 hosts x 4 "
+                    "chips); 1 = flat single-tier pricing")
+    ap.add_argument("--dci", type=float, default=100.0,
+                    help="per-chip cross-host (DCI/DCN) egress GB/s "
+                    "(default: conservative 100 — pod-level optical "
+                    "interconnect per chip)")
     args = ap.parse_args()
 
     env = dict(os.environ)
@@ -96,6 +119,16 @@ def main():
     # collective-permutes of 1/S chunk each) would inflate that by the
     # slice factor at unchanged real traffic
     env["QUEST_EXCHANGE_SLICES"] = "1"
+    env["QUEST_EXCHANGE_SLICES_DCI"] = "0"
+    # the hierarchical model the planner prices (and the projection
+    # charges per-link below); weights mirror the bandwidth ratio so
+    # plan CHOICE optimizes the same objective the projection reports
+    if args.hosts > 1:
+        env["QUEST_COMM_TOPOLOGY"] = (
+            f"hosts={args.hosts},ici=1,"
+            f"dci={max(args.ici / args.dci, 1.0):g}")
+    else:
+        env["QUEST_COMM_TOPOLOGY"] = "0"
     code = WORKER % {"repo": REPO, "n": args.n, "depth": args.depth,
                      "D": args.devices, "circuit": args.circuit}
     r = subprocess.run([sys.executable, "-c", code], env=env,
@@ -113,20 +146,32 @@ def main():
     # collective_permutes figure missed the all-to-all events entirely
     hbm_gb = (rec["local_band_passes"] + rec["comm_exchanges"]) \
         * 2 * chunk_gb
-    # ICI from the planner's verified per-device payload bytes
-    ici_gb = rec["comm_bytes"] / 1e9
+    # per-link GB from the planner's verified, topology-split payload:
+    # intra-host traffic rides ICI at its bandwidth, the cross-host
+    # share rides the (much slower) DCI — pricing DCI bytes at the flat
+    # ICI rate is exactly the optimism the hierarchical model exists to
+    # remove (docs/DISTRIBUTED.md §topology). The two are separate
+    # media and overlap; the comm wall is the slower stream.
+    ici_gb = rec["comm_ici_bytes"] / 1e9
+    dci_gb = rec["comm_dci_bytes"] / 1e9
     t_hbm = hbm_gb / args.hbm
     t_ici = ici_gb / args.ici
+    t_dci = dci_gb / args.dci
+    t_comm = max(t_ici, t_dci)
     rec.update({
         "circuit": args.circuit,
         "n": args.n, "depth": args.depth, "devices": args.devices,
+        "hosts": args.hosts,
         "chunk_gb": round(chunk_gb, 2),
         "assumed_hbm_gbps": args.hbm, "assumed_ici_gbps": args.ici,
+        "assumed_dci_gbps": args.dci,
         "hbm_gb_per_device": round(hbm_gb, 1),
-        "ici_gb_per_device": round(ici_gb, 1),
+        "ici_gb_per_device": round(ici_gb, 2),
+        "dci_gb_per_device": round(dci_gb, 2),
         "t_hbm_s": round(t_hbm, 2), "t_ici_s": round(t_ici, 2),
-        "projected_wall_clock_s": round(max(t_hbm, t_ici) + 0.2 * min(
-            t_hbm, t_ici), 2),  # collectives overlap compute imperfectly
+        "t_dci_s": round(t_dci, 2),
+        "projected_wall_clock_s": round(max(t_hbm, t_comm) + 0.2 * min(
+            t_hbm, t_comm), 2),  # collectives overlap compute imperfectly
         "hbm_provenance": ("v5p datasheet 2765 GB/s x 0.56 measured v5e "
                            "in-place derate (docs/KERNELS.md); "
                            "--hbm 2765 for the datasheet bound"

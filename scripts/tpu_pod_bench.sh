@@ -3,10 +3,23 @@
 # reference's SLURM/PBS submission scripts (examples/submissionScripts/).
 #
 # Usage: ./scripts/tpu_pod_bench.sh <tpu-name> <zone>
+#
+# QUEST_COMM_TOPOLOGY (docs/DISTRIBUTED.md §topology) passes through to
+# every worker so the comm planner prices the slice's real host
+# grouping; unset it to let the planner auto-derive hosts from
+# jax.devices() process ids (the default on a real pod).
 
 set -euo pipefail
 TPU_NAME=${1:?tpu name}
 ZONE=${2:?zone}
+TOPOLOGY=${QUEST_COMM_TOPOLOGY:-}
+
+# an EMPTY knob must stay unset on the workers (knobs parse loudly;
+# '' is malformed) — only export it when the caller actually set one
+ENVPREFIX=""
+if [ -n "$TOPOLOGY" ]; then
+  ENVPREFIX="QUEST_COMM_TOPOLOGY='${TOPOLOGY}' "
+fi
 
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
-  --command 'cd quest_tpu && python bench.py && python benchmarks/run.py'
+  --command "cd quest_tpu && ${ENVPREFIX}python bench.py && ${ENVPREFIX}python bench.py multichip && python benchmarks/run.py"

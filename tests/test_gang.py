@@ -1,0 +1,68 @@
+"""Gang-consistent durable execution on a 2-process gloo mesh.
+
+The durable executor's multi-host mode (docs/RESILIENCE.md
+§gang-consistent durable) must survive the one failure class a
+single-host chain cannot express: a checkpoint that commits on SOME
+hosts. This test actually RUNS the configuration — two OS processes,
+four virtual CPU devices each, one 8-device global mesh, collectives
+over gloo/TCP — and pins, per host: topology-aware planner parity
+(predicted == lowered StableHLO under QUEST_COMM_TOPOLOGY=hosts=2),
+preempt + resume bit-identity, and the mid-save host kill: the
+half-stamped gang save must never commit, both hosts must resume the
+SAME previous cut, and the finish must still be bit-identical to an
+uninterrupted run (tests/_gang_worker.py carries the assertions).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_gang_durable_two_process(tmp_path):
+    # slow-marked (~60 s: two subprocesses, each a full jax import plus
+    # four durable runs) — the same multihost discipline as
+    # test_multihost; CI's unfiltered `pytest tests/` keeps it covered
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("QUEST_COMM_TOPOLOGY", None)   # the worker pins its own
+    worker = os.path.join(REPO, "tests", "_gang_worker.py")
+    port = "19811"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", port, str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            # generous bound: two cold jax imports + four durable runs
+            # measured ~300 s on this host; gloo coordination is
+            # contention-sensitive, so leave CI headroom
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any("SKIP:" in out for out in outs):
+        pytest.skip("jaxlib lacks CPU cross-process (gloo) collectives")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+        assert "gang parity ok" in out, out[-1500:]
+        assert "gang uninterrupted ok" in out, out[-1500:]
+        assert "gang resume ok" in out, out[-1500:]
+        assert "gang midsave ok" in out, out[-1500:]
+    # the two hosts' final shard hashes differ (different slices), but
+    # each host's hash must be identical across its own runs — asserted
+    # in-worker; here: both workers agreed the planner chose the same
+    # strategy (the plan is host-independent)
+    import re
+    strategies = {re.search(r"strategy=(\w+)", o).group(1) for o in outs}
+    assert len(strategies) == 1, strategies

@@ -399,6 +399,60 @@ def test_comm_knobs_are_keyed_with_flips():
     assert parse("4") == 4
 
 
+def test_topology_knob_registry_coverage(tmp_path):
+    """QUEST_COMM_TOPOLOGY / QUEST_EXCHANGE_SLICES_DCI coverage of the
+    registry rules (ISSUE 13): registry reads of the keyed topology
+    knobs on a jit-reachable path pass QL001 (the engines' sliced
+    ppermutes read both at trace time); direct os.environ reads fire
+    QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_COMM_TOPOLOGY"):
+                return amps
+            return amps * knob_value("QUEST_EXCHANGE_SLICES_DCI")
+
+        def configure():
+            a = os.environ.get("QUEST_COMM_TOPOLOGY")
+            b = os.environ.get("QUEST_EXCHANGE_SLICES_DCI")
+            return a, b
+    """, name="topoknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 2 and all("bypasses" in v.message for v in q4), vs
+
+
+def test_topology_knobs_are_keyed_with_flips():
+    """The topology knobs select which compiled sharded program a call
+    resolves to (plan choice, slice counts), so both must stay keyed
+    with registered flips (the flip audit sweeps them automatically)
+    and parse loudly."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_COMM_TOPOLOGY", "QUEST_EXCHANGE_SLICES_DCI"):
+        k = KNOBS[name]
+        assert k.scope == "keyed" and k.layer == "planner", name
+        assert k.flips and k.flips[0] != k.flips[1], name
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    parse = KNOBS["QUEST_COMM_TOPOLOGY"].parse
+    assert parse("0") == 0
+    assert parse("hosts=2") == (2, 1.0, 4.0)
+    assert parse("hosts=4,ici=1,dci=8") == (4, 1.0, 8.0)
+    for bad in ("", "hosts=3", "hosts=0", "ici=2", "hosts=2,dci=0",
+                "hosts=2,link=9", "2"):
+        with pytest.raises(ValueError):
+            parse(bad)
+    parse_dci = KNOBS["QUEST_EXCHANGE_SLICES_DCI"].parse
+    assert parse_dci("0") == 0 and parse_dci("4") == 4
+    for bad in ("3", "-1", "2048", "x"):
+        with pytest.raises(ValueError):
+            parse_dci(bad)
+
+
 def test_fused_pipeline_knob_registry_coverage(tmp_path):
     """QUEST_FUSED_PIPELINE coverage of the registry rules (ISSUE 11):
     a registry read (knob_value) on a Pallas-reachable path passes

@@ -125,7 +125,8 @@ def test_ab_silicon_covers_the_flagged_debts():
     exchange slicing (PR 8) — dropping one silently reopens its debt."""
     src = _read("scripts/ab_silicon.py")
     for knob in ("QUEST_FUSED_PIPELINE", "QUEST_FUSED_NBUF",
-                 "QUEST_SWEEP_FUSION", "QUEST_EXCHANGE_SLICES"):
+                 "QUEST_SWEEP_FUSION", "QUEST_EXCHANGE_SLICES",
+                 "QUEST_EXCHANGE_SLICES_DCI", "QUEST_COMM_TOPOLOGY"):
         assert knob in src, knob
     assert "compiled_batched" in src and "lax.map" in src
 
@@ -147,7 +148,8 @@ def test_ab_silicon_smoke_runs():
             if ln.startswith("[ab-silicon] {")][-1]
     rec = json.loads(line[len("[ab-silicon] "):])
     assert set(rec) >= {"pipeline", "nbuf", "sweep_fusion",
-                        "batch_grid", "exchange_slices"}
+                        "batch_grid", "exchange_slices",
+                        "exchange_slices_dci"}
     for v in ("1", "0"):
         assert "error" not in rec["pipeline"][v], rec["pipeline"][v]
     assert "error" not in rec["batch_grid"], rec["batch_grid"]
