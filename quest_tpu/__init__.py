@@ -73,5 +73,6 @@ from quest_tpu import checkpoint
 from quest_tpu import profiling
 from quest_tpu import variational
 from quest_tpu import trajectories
+from quest_tpu import evolution
 
 __version__ = "0.1.0"

@@ -51,6 +51,19 @@ def dual_of(op: GateOp, shift: int):
         return dataclasses.replace(
             op, targets=tuple(t + shift for t in op.targets),
             operand=-op.operand)
+    parts = getattr(op, "parts", None)
+    if parts:
+        # a scheduler-shaped ComposedDiag (fusion.ComposedDiag) carries
+        # its phase components in `parts` alongside the composed table;
+        # the dual must conjugate BOTH representations — negating each
+        # part's angle is exactly the conjugate of its phase factor —
+        # or the Pallas MultiPhaseStage lowering (which reads parts)
+        # would disagree with the conjugated operand
+        return dataclasses.replace(
+            op, targets=tuple(t + shift for t in op.targets),
+            controls=tuple(c + shift for c in op.controls),
+            operand=np.conj(op.operand),
+            parts=tuple((kind, bits, -ang) for kind, bits, ang in parts))
     return dataclasses.replace(
         op, targets=tuple(t + shift for t in op.targets),
         controls=tuple(c + shift for c in op.controls),
@@ -852,7 +865,15 @@ class Circuit:
                 operand = np.conj(op.operand)
             else:                      # parity: exp(-i a/2 Z..Z)
                 operand = -op.operand
-            inv.ops.append(dataclasses.replace(op, operand=operand))
+            parts = getattr(op, "parts", None)
+            if parts:
+                # ComposedDiag: keep the phase components in step with
+                # the conjugated table (see dual_of)
+                inv.ops.append(dataclasses.replace(
+                    op, operand=operand,
+                    parts=tuple((k, b, -a) for k, b, a in parts)))
+            else:
+                inv.ops.append(dataclasses.replace(op, operand=operand))
         return inv
 
     @classmethod

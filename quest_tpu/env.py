@@ -322,6 +322,14 @@ _KNOB_LIST = (
              "expectation sweep — the expectation engine's stage "
              "budget (default: 64)",
          malformed="0", flips=("64", "1")),
+    Knob("QUEST_TROTTER_FUSION", _bool01("QUEST_TROTTER_FUSION"), True,
+         scope="keyed", layer="planner",
+         doc="pooled Trotter emission + fused-engine dispatch for the "
+             "evolution workload (docs/EVOLUTION.md): 1/0 (default: 1; "
+             "0 restores the legacy per-term emission dispatched "
+             "through the eager per-term workers — one flip-form pass "
+             "per term application, the honest bench baseline)",
+         malformed="2", flips=("1", "0")),
     Knob("QUEST_COMM_PLAN", _bool01("QUEST_COMM_PLAN"), True,
          scope="keyed", layer="planner",
          doc="communication planner for the sharded engines "

@@ -520,6 +520,62 @@ def _compose_diag_group(group) -> ComposedDiag:
                         tuple(parts) if phase_only else ())
 
 
+def compose_diag_runs(ops: Sequence, diag_max: int = DIAG_FUSE_MAX
+                      ) -> List:
+    """Pooling entry for SYNTHESIZED diagonal layers (the evolution
+    compiler's Trotter blocks, quest_tpu/evolution.py): greedily pack a
+    flat run of diagonal-class ops — parity / allones / concrete
+    diagonal, which all mutually commute by construction — into
+    `ComposedDiag` groups of union support <= diag_max, preserving
+    first-op order between groups.
+
+    This deliberately pools SINGLE-band diagonals too: schedule()'s
+    `_diag_class` leaves those in program order because a neighbouring
+    band matmul absorbs them for free (try_merge), but a synthesized
+    diagonal layer has no adjacent band operator — left unpooled, a
+    30-term Trotter diagonal block runs as 30 separate kernel phase
+    stages where ~5 additive MultiPhaseStage groups carry the same
+    math. Ops that cannot compose (traced operands, support wider than
+    diag_max, non-diagonal kinds) pass through unchanged in place.
+
+    The caller asserts mutual commutation — this entry does NO
+    commutation analysis, unlike schedule(); do not feed it ops that
+    mix with non-diagonal gates."""
+    groups: List[list] = []       # [support_set, [ops], first_pos]
+    passthrough: List[Tuple[int, object]] = []
+    for pos, op in enumerate(ops):
+        qs = set(op.targets) | set(op.controls)
+        # controlled parity/allones pass through: _compose_diag_group's
+        # parity/allones branches read targets only (schedule()'s
+        # _diag_class excludes them for the same reason) — composing
+        # one would silently drop its controls; controlled 'diagonal'
+        # composes fine (the group table embeds controls as identity
+        # rows)
+        composable = (op.kind in ("parity", "allones", "diagonal")
+                      and _concrete(op.operand) and len(qs) <= diag_max
+                      and not (op.controls and op.kind != "diagonal"))
+        if not composable:
+            passthrough.append((pos, op))
+            continue
+        placed = False
+        for g in groups:
+            if len(g[0] | qs) <= diag_max:
+                g[0] |= qs
+                g[1].append(op)
+                placed = True
+                break
+        if not placed:
+            groups.append([qs, [op], pos])
+    emitted: List[Tuple[int, object]] = list(passthrough)
+    for _, members, pos in groups:
+        if len(members) >= 2:
+            emitted.append((pos, _compose_diag_group(members)))
+        else:
+            emitted.append((pos, members[0]))
+    emitted.sort(key=lambda e: e[0])
+    return [op for _, op in emitted]
+
+
 def schedule(flat: Sequence, n: int,
              diag_max: int = DIAG_FUSE_MAX) -> Tuple[List, dict]:
     """Commutation-aware reorder + diagonal composition of a FLAT op

@@ -477,6 +477,7 @@ def _clear_chain(directory: str) -> None:
 def run_durable(circuit, state: Qureg, directory: str, *,
                 every: int = None, engine: str = None, mesh=None,
                 interpret: bool = False, keep: int = None,
+                cursor_extra: Optional[dict] = None,
                 registry: Optional[_metrics.Registry] = None) -> Qureg:
     """Apply `circuit` to `state` durably: execute the engine's own
     launch plan step by step, checkpoint planes + cursor every `every`
@@ -509,7 +510,10 @@ def run_durable(circuit, state: Qureg, directory: str, *,
     the durable_* metrics (default: the process-wide
     serve.metrics.REGISTRY) — the serve fleet's replicas pass their own
     registry so a fleet soak's durable tallies ride the same snapshot
-    as its fleet_* metrics."""
+    as its fleet_* metrics. `cursor_extra` adds workload-descriptor
+    fields (JSON-serializable) to every cursor, VALIDATED at resume
+    like the plan fields — quest_tpu.evolution's deep quenches stamp
+    their Trotter steps/order/dt through it (docs/EVOLUTION.md)."""
     from quest_tpu.env import knob_value
 
     if circuit.num_qubits != state.num_qubits:
@@ -547,6 +551,21 @@ def run_durable(circuit, state: Qureg, directory: str, *,
         "state_fp": (_state_fingerprint_gang(state) if gang
                      else _state_fingerprint(state)),
     }
+    if cursor_extra:
+        # workload-level descriptor fields (e.g. the Trotter
+        # steps/order/dt of quest_tpu.evolution's deep quenches): they
+        # ride EVERY cursor and are VALIDATED at resume exactly like
+        # the plan fields — a rerun under a different workload
+        # descriptor fails typed instead of splicing prefixes. Values
+        # must be JSON-serializable (the checkpoint meta self-digest
+        # canonicalizes them).
+        reserved = set(want) | {"kind", "step", "perm", "baseline"}
+        overlap = set(cursor_extra) & reserved
+        if overlap:
+            raise ValueError(
+                f"cursor_extra may not shadow reserved cursor fields "
+                f"{sorted(overlap)}")
+        want.update(cursor_extra)
     start, baseline = 0, None
     if gang:
         found = _latest_valid_gang(directory, "state", registry)

@@ -174,12 +174,67 @@ def expectation(ansatz: Callable, n: int, all_codes, coeffs=None,
         return E.expec_traced(amps, jnp.asarray(coeffs, amps.dtype),
                               plan).astype(amps.dtype)
 
+    ansatz_key = getattr(ansatz, "program_key", None)
+    if ansatz_key is not None:
+        # VALUE identity of the whole energy program: an ansatz that
+        # declares its program_key (e.g. evolution.trotter_ansatz)
+        # promises that equal keys trace identically, so a REBUILT
+        # energy over an equal ansatz + equal Pauli sum may share the
+        # compiled program. sweep() keys its program cache on this
+        # instead of the energy-fn object — without it, an optimizer
+        # loop rebuilding the ansatz each step retraced every
+        # iteration (tests/test_evolution.py pins the fix by call
+        # count and under the CompileAuditor). The BUILD-time
+        # engine_mode_key rides the key: the expec plan above is
+        # resolved NOW, so two energies built under different keyed
+        # knob values are different programs even when everything else
+        # matches (_sweep_program adds the TRACE-time mode key on top).
+        from quest_tpu.env import engine_mode_key
+        energy.sweep_key = ("variational.expectation", ansatz_key,
+                            codes_key, coeffs.tobytes(),
+                            int(initial_index), rdt.str, n,
+                            engine_mode_key())
     return energy
 
 
 # one jitted vmapped program per energy function (weak: a dropped fn
 # frees its trace cache with it)
 _SWEEP_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+# VALUE-keyed companion for energy functions that declare a `sweep_key`
+# (expectation() over a program_key-bearing ansatz): rebuilt-but-equal
+# functions hit the same compiled program. Bounded FIFO — value keys
+# cannot be weak, so the cap bounds held traces
+_SWEEP_CACHE_KEYED: dict = {}
+_SWEEP_KEYED_MAX = 64
+
+
+def _sweep_program(fn: Callable):
+    """The jitted vmapped program for `fn`: by VALUE when the function
+    declares `sweep_key` (the program_key contract — equal keys promise
+    identical traces), by object identity (weakly) otherwise. The
+    value key additionally carries engine_mode_key(): what a rebuilt
+    energy traces depends on the keyed knobs (the expec mask budget,
+    matmul precision, the f64 limb scheme), so a mid-process knob flip
+    must MISS — the Circuit.program_key discipline; the weak per-object
+    path needs no such guard because a flip changes what the NEXT
+    built fn closes over, and an already-built fn's trace is its own."""
+    key = getattr(fn, "sweep_key", None)
+    if key is None:
+        batched = _SWEEP_CACHE.get(fn)
+        if batched is None:
+            batched = jax.jit(jax.vmap(fn))
+            _SWEEP_CACHE[fn] = batched
+        return batched
+    from quest_tpu.env import engine_mode_key
+    key = (key, engine_mode_key())
+    batched = _SWEEP_CACHE_KEYED.get(key)
+    if batched is None:
+        batched = jax.jit(jax.vmap(fn))
+        _SWEEP_CACHE_KEYED[key] = batched
+        while len(_SWEEP_CACHE_KEYED) > _SWEEP_KEYED_MAX:
+            _SWEEP_CACHE_KEYED.pop(next(iter(_SWEEP_CACHE_KEYED)))
+    return batched
 
 
 def sweep(fn: Callable, param_batch, chunk: int = None):
@@ -193,17 +248,55 @@ def sweep(fn: Callable, param_batch, chunk: int = None):
     QUEST_BATCH_BUCKET) so mixed sweep sizes share one jit cache
     entry — the pad evaluations re-run the first parameter set and are
     sliced off. The jitted vmapped program is cached per `fn` (weakly,
-    so dropping the energy function frees it): repeated sweep() calls
-    in an optimizer loop reuse ONE trace instead of rebuilding
-    jax.jit(jax.vmap(fn)) — and with it the whole jit cache — each
-    call. Traced-parameter circuits cannot pre-compose into the
+    so dropping the energy function frees it) — or by VALUE when `fn`
+    declares a `sweep_key` (expectation() over a program_key-bearing
+    ansatz such as evolution.trotter_ansatz), so an optimizer loop
+    that REBUILDS an equal energy function every iteration still hits
+    one compiled program: repeated sweep() calls reuse ONE trace
+    instead of rebuilding jax.jit(jax.vmap(fn)) — and with it the
+    whole jit cache — each call. `param_batch` is a stacked array (a
+    list stacks, as always) or a tuple/dict pytree whose leaves share
+    the leading batch axis — the evolved ansatz's (coeffs, dt) pair.
+    A tuple whose leaves all share one shape is REJECTED loudly: it
+    could mean either stack-or-pytree, and the two disagree silently.
+    Traced-parameter circuits cannot pre-compose into the
     fixed-operand sweep kernels (their operands are data), so this is
     the supported fast path for parameter sweeps; fixed circuits batch
     through Circuit.compiled_batched instead."""
     from quest_tpu.env import batch_bucket
 
-    params = jnp.asarray(param_batch)
-    total = params.shape[0]
+    # param sets may be one stacked array (a list of param vectors
+    # STACKS, the original sweep contract) OR a tuple/dict pytree of
+    # stacked leaves sharing the leading batch axis — e.g. the evolved
+    # ansatz's (coeffs, dt) pair (evolution.trotter_ansatz): every
+    # leaf is sliced/padded together, vmap maps over axis 0 of each
+    if isinstance(param_batch, list):
+        param_batch = jnp.asarray(param_batch)
+    elif isinstance(param_batch, tuple):
+        # a tuple whose leaves all share ONE shape is ambiguous: under
+        # the pre-pytree contract jnp.asarray would have STACKED it
+        # into the batch axis, under the pytree contract each leaf
+        # carries the batch axis — silently picking either gives the
+        # other caller wrong results with no error, so refuse loudly
+        shapes = {tuple(getattr(v, "shape", np.shape(v)))
+                  for v in jax.tree_util.tree_leaves(param_batch)}
+        if len(shapes) <= 1:
+            raise ValueError(
+                "ambiguous tuple param_batch (every leaf has shape "
+                f"{shapes or {()}}): pass a LIST to stack parameter "
+                "sets into the batch axis, a pre-stacked array, or a "
+                "dict / shape-heterogeneous pytree whose leaves share "
+                "the leading batch axis")
+    params = jax.tree_util.tree_map(jnp.asarray, param_batch)
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        raise ValueError("param_batch has no array leaves to sweep over")
+    total = int(leaves[0].shape[0]) if leaves[0].ndim else 0
+    for leaf in leaves:
+        if leaf.ndim == 0 or int(leaf.shape[0]) != total:
+            raise ValueError(
+                "every param_batch leaf must share the leading batch "
+                f"axis: got shapes {[tuple(l.shape) for l in leaves]}")
     per_call = total if chunk is None else max(1, min(int(chunk), total))
     bucket = batch_bucket(per_call)
     if chunk is None and bucket > total:
@@ -213,17 +306,18 @@ def sweep(fn: Callable, param_batch, chunk: int = None):
         smaller = batch_bucket(max(1, bucket // 2))
         if smaller < bucket:
             bucket = smaller
-    batched = _SWEEP_CACHE.get(fn)
-    if batched is None:
-        batched = jax.jit(jax.vmap(fn))
-        _SWEEP_CACHE[fn] = batched
+    batched = _sweep_program(fn)
+
+    def _pad(a, pad):
+        return jnp.concatenate(
+            [a, jnp.broadcast_to(a[:1], (pad,) + a.shape[1:])])
+
     outs = []
     for lo in range(0, total, bucket):
-        pb = params[lo:lo + bucket]
-        pad = bucket - pb.shape[0]
+        pb = jax.tree_util.tree_map(lambda a: a[lo:lo + bucket], params)
+        pad = bucket - min(bucket, total - lo)
         if pad:
-            pb = jnp.concatenate(
-                [pb, jnp.broadcast_to(pb[:1], (pad,) + pb.shape[1:])])
+            pb = jax.tree_util.tree_map(lambda a: _pad(a, pad), pb)
         out = batched(pb)
         outs.append(out[:-pad] if pad else out)
     if len(outs) == 1:

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""CI fast-fail gate for the Trotter-evolution workload
+(docs/EVOLUTION.md): fails if the pooled emission regresses above the
+committed golden sweep counts, if the fused-vs-per-term plan advantage
+drops below 5x, if a short CPU quench's energy drift exceeds the
+documented bound, or if the QUEST_TROTTER_FUSION=0 record stops
+matching the legacy per-term emission model — all CPU-side through
+`evolution.trotter_plan_stats` and a small real quench (the
+check_expec_golden.py discipline; no chip).
+
+Goldens: the 30q TFIM order-2 step lowers to at most 3 HBM sweeps on
+the fused engine (one sublane-region sweep plus one per scattered
+band — the same geometry floor QFT-30 meets at 6) vs >= 15 passes for
+the per-term emission; a 20-step 8q quench at dt=0.05 conserves <H>
+within bench.TROTTER_DRIFT_PER_TERM per term. The goldens live HERE
+and are mirrored by the tier-1 assertions in tests/test_evolution.py;
+a planner change that moves either must update both, consciously.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TFIM30_GOLDEN_SWEEPS_PER_STEP = 3
+TFIM30_MIN_BASELINE_PASSES = 15
+MIN_PLAN_ADVANTAGE = 5
+DRIFT_N, DRIFT_STEPS = 8, 20
+
+
+def main() -> int:
+    import numpy as np
+
+    import bench
+    from quest_tpu import evolution as EV
+    from quest_tpu.ops import expec as E
+
+    spec30 = E.PauliSum.of(*bench._build_tfim_sum(30), 30)
+    fused = EV.trotter_plan_stats(spec30, bench.TROTTER_DT, order=2,
+                                  steps=50)
+
+    prior = os.environ.get("QUEST_TROTTER_FUSION")
+    os.environ["QUEST_TROTTER_FUSION"] = "0"
+    try:
+        legacy = EV.trotter_plan_stats(spec30, bench.TROTTER_DT,
+                                       order=2, steps=50)
+    finally:
+        if prior is None:
+            del os.environ["QUEST_TROTTER_FUSION"]
+        else:
+            os.environ["QUEST_TROTTER_FUSION"] = prior
+
+    # a real (tiny) quench: per-step energy drift vs the documented
+    # bound — the contract the bench's trot_energy_drift key reports
+    import quest_tpu as qt
+    spec = E.PauliSum.of(*bench._build_tfim_sum(DRIFT_N), DRIFT_N)
+    q0 = qt.init_plus_state(qt.create_qureg(DRIFT_N))
+    res = EV.run_evolution(spec, bench.TROTTER_DT, DRIFT_STEPS,
+                           state=q0, order=2, energy_every=5)
+    drift = float(np.abs(res.energies[:, 0] - res.energies[0, 0]).max())
+    drift_bound = bench.TROTTER_DRIFT_PER_TERM * len(spec.codes)
+
+    rec = {
+        "tfim30_hbm_sweeps_per_step": fused["hbm_sweeps_per_step"],
+        "tfim30_baseline_hbm_sweeps_per_step":
+            fused["baseline_hbm_sweeps_per_step"],
+        "tfim30_diag_groups": fused["diag_groups"],
+        "tfim30_frames": fused["frames"],
+        "knob_off_hbm_sweeps_per_step": legacy["hbm_sweeps_per_step"],
+        "quench_energy_drift": drift,
+        "quench_energy_drift_bound": drift_bound,
+    }
+    print(json.dumps(rec))
+    ok = True
+    if fused["hbm_sweeps_per_step"] > TFIM30_GOLDEN_SWEEPS_PER_STEP:
+        print(f"REGRESSION: TFIM-30 hbm_sweeps_per_step "
+              f"{fused['hbm_sweeps_per_step']} > golden "
+              f"{TFIM30_GOLDEN_SWEEPS_PER_STEP}", file=sys.stderr)
+        ok = False
+    if fused["baseline_hbm_sweeps_per_step"] < TFIM30_MIN_BASELINE_PASSES:
+        print(f"MODEL DRIFT: per-term baseline "
+              f"{fused['baseline_hbm_sweeps_per_step']} passes/step < "
+              f"{TFIM30_MIN_BASELINE_PASSES} — the legacy model no "
+              f"longer reflects one pass per term application",
+              file=sys.stderr)
+        ok = False
+    if (fused["baseline_hbm_sweeps_per_step"]
+            < MIN_PLAN_ADVANTAGE * max(fused["hbm_sweeps_per_step"], 1)):
+        print(f"REGRESSION: fused-vs-per-term plan advantage below "
+              f"{MIN_PLAN_ADVANTAGE}x", file=sys.stderr)
+        ok = False
+    if legacy["fusion"] or (legacy["hbm_sweeps_per_step"]
+                            != legacy["baseline_hbm_sweeps_per_step"]):
+        print("REGRESSION: QUEST_TROTTER_FUSION=0 record no longer "
+              "reports the legacy per-term emission it dispatches",
+              file=sys.stderr)
+        ok = False
+    if drift > drift_bound:
+        print(f"REGRESSION: {DRIFT_STEPS}-step {DRIFT_N}q quench "
+              f"energy drift {drift:.3e} > documented bound "
+              f"{drift_bound:.3e} (docs/EVOLUTION.md §energy drift)",
+              file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -382,6 +382,9 @@ def _deep_dynamic_circuit(n, layers=5, seed=11):
     return c
 
 
+@pytest.mark.slow          # ~6 s — tier-1 budget discipline; the
+                           # sharded dynamic kernel-execute test stays
+                           # in tier-1
 def test_sharded_dynamic_engines_agree():
     """xla / banded / banded+relabel / fused(interpret) dynamic engines
     draw identical trajectories and states for every key."""

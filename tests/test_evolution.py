@@ -1,0 +1,651 @@
+"""Trotterized time evolution (quest_tpu/evolution.py, ISSUE 14,
+docs/EVOLUTION.md): randomized product formulas vs the dense expm
+oracle at documented eps, imaginary-time projection onto the oracle
+ground state, the TFIM-30 plan golden (hbm_sweeps_per_step <= 3, >= 5x
+below the per-term emission), grad-vs-finite-difference parity through
+the traced core, the zero-retrace optimizer loop over REBUILT ansaetze
+(variational's value-keyed program cache, CompileAuditor-pinned),
+durable deep quenches resuming bit-identical — directly and through
+serve — and sharded 2-dev eps-equality."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu import evolution as EV
+from quest_tpu import variational as V
+from quest_tpu.circuit import Circuit
+from quest_tpu.ops import expec as E
+from quest_tpu.ops import fusion as F
+from quest_tpu.resilience import FaultPlan, faults, run_durable
+from quest_tpu.state import to_dense
+
+from .helpers import max_mesh_devices
+
+import bench
+
+N = 5
+
+# documented eps (docs/EVOLUTION.md §accuracy): the product-formula
+# circuit must match the EXACT dense exponential of the same product
+# formula to engine precision (the emission is algebraically exact per
+# group), and the order-2 formula must track expm at the analytic
+# O(dt^2 t) Trotter error
+ENGINE_EPS = {np.dtype(np.float32): 2e-5, np.dtype(np.float64): 1e-12}
+
+_PAULI = (np.eye(2), np.array([[0, 1], [1, 0]]),
+          np.array([[0, -1j], [1j, 0]]), np.array([[1, 0], [0, -1]]))
+
+
+def dense_term(row):
+    """Little-endian kron of one Pauli string (qubit 0 least
+    significant — the amplitude-index convention of tests/oracle.py)."""
+    M = np.array([[1.0]])
+    for code in row:
+        M = np.kron(_PAULI[code], M)
+    return M
+
+
+def dense_h(codes, coeffs):
+    dim = 1 << len(codes[0])
+    H = np.zeros((dim, dim), complex)
+    for row, c in zip(codes, coeffs):
+        H += c * dense_term(row)
+    return H
+
+
+def tfim(n, J=-1.0, h=-0.7):
+    """Open-chain TFIM: n-1 ZZ couplings + n transverse X fields."""
+    rows, cs = [], []
+    for q in range(n - 1):
+        r = [0] * n
+        r[q] = 3
+        r[q + 1] = 3
+        rows.append(r)
+        cs.append(J)
+    for q in range(n):
+        r = [0] * n
+        r[q] = 1
+        rows.append(r)
+        cs.append(h)
+    return E.PauliSum.of(np.asarray(rows), np.asarray(cs), n)
+
+
+def random_sum(rng, n, terms=6):
+    """Random-support Pauli sum: X/Y/Z content everywhere, so the plan
+    carries a diagonal block AND several rotation frames."""
+    rows = rng.integers(0, 4, size=(terms, n))
+    rows[0] = 0                       # keep one all-identity term in
+    rows[1, :] = np.where(rows[1] == 0, 0, 3)   # and one pure-Z term
+    return E.PauliSum.of(rows, rng.standard_normal(terms), n)
+
+
+def random_state(rng, n, rdt):
+    v = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    v /= np.linalg.norm(v)
+    q = qt.create_qureg(n, dtype=(np.complex64 if rdt == np.float32
+                                  else np.complex128))
+    q = qt.init_state_from_amps(q, v.real.astype(rdt), v.imag.astype(rdt))
+    return q, v
+
+
+def product_formula_oracle(plan, spec, dt, order, steps):
+    """The EXACT unitary of the emitted product formula: dense expm of
+    each commuting group, composed in the plan's Strang/Lie order —
+    what the circuit must match to engine eps (no Trotter error)."""
+    import scipy.linalg as sla
+    seq = plan.group_seq()
+    dim = 1 << spec.num_qubits
+
+    def group_u(g, scale):
+        kind, payload = g
+        idx = payload if kind == "diag" else payload.terms
+        Hg = np.zeros((dim, dim), complex)
+        for i in idx:
+            Hg += float(spec.coeffs[i]) * dense_term(spec.codes[i])
+        return sla.expm(-1j * float(dt) * scale * Hg)
+
+    if order == 1 or len(seq) <= 1:
+        step = np.eye(dim, dtype=complex)
+        for g in seq:
+            step = group_u(g, 1.0) @ step
+    else:
+        step = np.eye(dim, dtype=complex)
+        for g in seq[:-1]:
+            step = group_u(g, 0.5) @ step
+        step = group_u(seq[-1], 1.0) @ step
+        for g in reversed(seq[:-1]):
+            step = group_u(g, 0.5) @ step
+    # the identity terms are a global phase the pooled emission keeps
+    theta = float(dt) * sum(float(spec.coeffs[i]) for i in plan.identity)
+    out = np.linalg.matrix_power(step, steps) * np.exp(-1j * theta * steps)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# correctness vs the dense oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", [1, 2])
+def test_trotter_matches_product_formula_oracle(order, dtype, tol, rng):
+    """The pooled circuit IS the product formula: group-exact to engine
+    eps (composition/pooling/telescoping introduce no approximation on
+    top of the formula itself), f32 and f64, order 1 and 2."""
+    spec = random_sum(rng, N)
+    rdt = np.float32 if dtype == np.dtype("complex64") else np.float64
+    q0, v0 = random_state(rng, N, rdt)
+    steps = 4
+    res = EV.run_evolution(spec, 0.07, steps, state=q0, order=order)
+    plan = EV._plan_trotter(spec.codes)
+    U = product_formula_oracle(plan, spec, 0.07, order, steps)
+    np.testing.assert_allclose(to_dense(res.state), U @ v0,
+                               atol=30 * tol, rtol=0)
+
+
+@pytest.mark.parametrize("order", [1, 2])
+def test_trotter_converges_to_expm(order, rng):
+    """Against exp(-i H t) itself the error is the analytic Trotter
+    bound: O(dt) for Lie, O(dt^2) per unit time for Strang — halving dt
+    at fixed t must shrink the error by ~2^order."""
+    import scipy.linalg as sla
+    spec = random_sum(rng, N)
+    H = dense_h(spec.codes, np.asarray(spec.coeffs))
+    t = 0.4
+    _, v0 = random_state(rng, N, np.float64)
+    want = sla.expm(-1j * H * t) @ v0
+
+    def err(steps):
+        q0 = qt.create_qureg(N, dtype=np.complex128)
+        q0 = qt.init_state_from_amps(q0, v0.real, v0.imag)
+        res = EV.run_evolution(spec, t / steps, steps, state=q0,
+                               order=order)
+        return np.linalg.norm(to_dense(res.state) - want)
+
+    e1, e2 = err(8), err(16)
+    assert e1 < (0.3 if order == 1 else 0.05)
+    # convergence-order check with slack for the subdominant terms
+    assert e2 < e1 / (1.5 if order == 1 else 2.5), (e1, e2)
+
+
+def test_fused_matches_legacy_per_term_emission(monkeypatch, rng):
+    """QUEST_TROTTER_FUSION=0 restores the legacy per-term eager
+    dispatch; the pooled circuit matches it to engine eps, and both
+    stats records say which engine ran."""
+    spec = random_sum(rng, N)
+    q0, _ = random_state(rng, N, np.float32)
+    res_f = EV.run_evolution(spec, 0.05, 6, state=q0, order=2)
+    # sub-kernel-tier register on CPU auto-resolves to the banded
+    # program — still the pooled one-dispatch path, not per-term
+    assert res_f.stats["engine"] in ("fused", "banded")
+    assert res_f.stats["dispatches"] == 1
+    monkeypatch.setenv("QUEST_TROTTER_FUSION", "0")
+    res_l = EV.run_evolution(spec, 0.05, 6, state=q0, order=2)
+    assert res_l.stats["engine"] == "legacy-per-term"
+    # the legacy emission drops the all-identity terms' global phase
+    # (the reference's multiRotatePauli no-op, docs/EVOLUTION.md);
+    # align it before comparing
+    plan = EV._plan_trotter(spec.codes)
+    theta = 0.05 * 6 * sum(float(spec.coeffs[i]) for i in plan.identity)
+    np.testing.assert_allclose(to_dense(res_f.state),
+                               np.exp(-1j * theta)
+                               * to_dense(res_l.state),
+                               atol=2e-5, rtol=0)
+    # the knob-off plan record REPORTS the per-term model it dispatches
+    st = EV.trotter_plan_stats(spec, 0.05, order=2)
+    assert st["fusion"] is False
+    assert st["hbm_sweeps_per_step"] == st["baseline_hbm_sweeps_per_step"]
+    # ...but a circuit BUILT pooled keeps reporting its own emission
+    # under the flipped knob (the memoized `pooled` bit, not the knob)
+    circ_f = EV.trotter_circuit(spec, 0.05, order=2, steps=6)
+    assert circ_f.trotter["pooled"] is False      # built under knob=0
+    monkeypatch.delenv("QUEST_TROTTER_FUSION")
+    pooled_circ = EV.trotter_circuit(spec, 0.05, order=2, steps=6)
+    monkeypatch.setenv("QUEST_TROTTER_FUSION", "0")
+    assert pooled_circ.plan_stats()["trotter"]["fusion"] is True
+    # the legacy eager baseline has no mesh/engine counterpart: loud,
+    # not a silent single-device run
+    with pytest.raises(ValueError, match="legacy per-term"):
+        EV.run_evolution(spec, 0.05, 2, state=q0, engine="banded")
+
+
+def test_imag_time_converges_to_ground_state():
+    """exp(-dt H) with in-trace renormalization projects |+>^n onto the
+    oracle ground state of the TFIM (gapped, so convergence is fast)."""
+    spec = tfim(N)
+    H = dense_h(spec.codes, np.asarray(spec.coeffs))
+    w, v = np.linalg.eigh(H)
+    q0 = qt.init_plus_state(qt.create_qureg(N, dtype=np.complex128))
+    res = EV.run_evolution(spec, 0.1, 300, state=q0, imag_time=True,
+                           energy_every=100)
+    assert res.stats["engine"] == "traced-imag"
+    # the energy track is monotone toward E0; the fixed point of the
+    # Strang imaginary-time map carries an O(dt^2) Trotter bias, so
+    # the landing tolerance is 1e-3, not machine eps (dt=0.1 measures
+    # ~4e-5 on this Hamiltonian)
+    track = res.energies[:, 0]
+    assert all(np.diff(track) < 1e-9)
+    assert abs(track[-1] - w[0]) < 1e-3, (track[-1], w[0])
+    fid = abs(np.vdot(v[:, 0], to_dense(res.state)))
+    assert fid > 1 - 1e-4          # the same O(dt^2) fixed-point bias
+
+
+def test_imag_time_rejects_engine_pin():
+    """The imaginary-time path runs as one traced XLA program — an
+    engine= pin is refused loudly, not silently ignored (review
+    hardening, consistent with the legacy-knob and mesh rejections)."""
+    q0 = qt.init_plus_state(qt.create_qureg(N))
+    with pytest.raises(ValueError, match="no engine"):
+        EV.run_evolution(tfim(N), 0.1, 2, state=q0, imag_time=True,
+                         engine="fused")
+
+
+def test_noisy_circuit_plan_stats_reports_noisy_emission():
+    """TrotterCircuit.plan_stats threads the circuit's noise into the
+    'trotter' record (review hardening: it used to report the
+    noise-free telescoped sweep rate for a noisy circuit): the record
+    self-describes the channel and its marginal is measured over the
+    NOISY emission, planned on the density register."""
+    noise = ("dephasing", 0.05)
+    c = EV.trotter_circuit(tfim(N), 0.05, steps=2, noise=noise)
+    rec = c.plan_stats()["trotter"]
+    assert rec["noise"] == noise
+    assert rec["hbm_sweeps_per_step"] >= 0
+    clean = EV.trotter_circuit(tfim(N), 0.05, steps=2).plan_stats()
+    assert clean["trotter"]["noise"] is None
+
+
+def test_energy_tracking_matches_eager_expectation(rng):
+    """The per-chunk device-resident energy record equals the eager
+    calc_expec_pauli_sum of the evolved state at each recorded step,
+    for a second observable too."""
+    spec = tfim(N)
+    obs = random_sum(rng, N)
+    q0, _ = random_state(rng, N, np.float32)
+    res = EV.run_evolution(spec, 0.05, 6, state=q0,
+                           observables=[spec, obs], energy_every=2)
+    assert res.energy_steps.tolist() == [0, 2, 4, 6]
+    assert res.energies.shape == (4, 2)
+    for k, upto in enumerate(res.energy_steps):
+        if upto == 0:
+            q = q0
+        else:
+            q = EV.run_evolution(spec, 0.05, int(upto), state=q0).state
+        for j, o in enumerate((spec, obs)):
+            want = qt.calc_expec_pauli_sum(q, np.asarray(o.codes),
+                                           np.asarray(o.coeffs))
+            assert abs(res.energies[k, j] - want) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# the TFIM-30 plan golden (CPU-assertable; mirrored in
+# scripts/check_evolution_golden.py)
+# ---------------------------------------------------------------------------
+
+
+def test_tfim30_plan_golden():
+    codes, coeffs = bench._build_tfim_sum(30)
+    st = EV.trotter_plan_stats(E.PauliSum.of(codes, coeffs, 30), 0.05,
+                               order=2, steps=50)
+    assert st["fusion"] is True
+    assert st["hbm_sweeps_per_step"] <= 3, st
+    assert st["baseline_hbm_sweeps_per_step"] >= 15, st
+    assert (st["baseline_hbm_sweeps_per_step"]
+            >= 5 * st["hbm_sweeps_per_step"]), st
+    # the ring TFIM is one diagonal block + one X frame
+    assert st["frames"] == 1 and st["diag_terms"] == 30, st
+
+
+def test_compose_diag_runs_pools_singletons(rng):
+    """The synthesized-layer pooling entry packs single-band parity
+    runs into ComposedDiag groups (schedule() deliberately leaves lone
+    diagonals to band absorption — a synthesized layer has no bands to
+    absorb them) and passes traced/unpoolable ops through in place."""
+    from quest_tpu.circuit import GateOp
+    ops = [GateOp("parity", (q, q + 1), (), (), 0.1 * (q + 1))
+           for q in range(6)]
+    out = F.compose_diag_runs(ops)
+    assert len(out) < len(ops)
+    assert all(o.kind in ("parity", "diagonal", "composed_diag", "allones")
+               or hasattr(o, "table") for o in out)
+    # traced operand passes through untouched, order preserved
+    traced = GateOp("parity", (0, 1), (), (), object())
+    out2 = F.compose_diag_runs([traced] + ops)
+    assert out2[0] is traced
+    # CONTROLLED parity/allones pass through UNPOOLED with controls
+    # intact: the group composer reads targets only, so composing one
+    # would silently drop its controls (review hardening —
+    # schedule()'s _diag_class excludes them for the same reason)
+    ctrl = GateOp("allones", (0,), (2,), (1,), np.exp(0.7j))
+    out3 = F.compose_diag_runs([ctrl] + ops)
+    kept = [o for o in out3 if getattr(o, "kind", "") == "allones"]
+    assert len(kept) == 1 and kept[0] is ctrl
+
+
+# ---------------------------------------------------------------------------
+# autodiff + the zero-retrace optimizer loop
+# ---------------------------------------------------------------------------
+
+
+def test_grad_matches_finite_differences(rng):
+    """jax.grad through a short evolution (coefficients AND dt as
+    runtime operands) matches central finite differences at f64 eps."""
+    spec = tfim(4)
+    ansatz = EV.trotter_ansatz(spec, order=2, steps=2)
+    energy = jax.jit(V.expectation(ansatz, 4, spec, dtype=np.float64))
+    cf = jnp.asarray(np.asarray(spec.coeffs))
+    dt0 = 0.13
+    g_cf, g_dt = jax.jit(jax.grad(energy))((cf, jnp.float64(dt0)))
+    eps = 1e-6
+
+    def at(c, d):
+        return float(energy((jnp.asarray(c), jnp.float64(d))))
+
+    fd_dt = (at(cf, dt0 + eps) - at(cf, dt0 - eps)) / (2 * eps)
+    assert abs(float(g_dt) - fd_dt) < 1e-6, (float(g_dt), fd_dt)
+    for j in (0, len(cf) - 1):
+        cp = np.asarray(cf).copy()
+        cm = cp.copy()
+        cp[j] += eps
+        cm[j] -= eps
+        fd = (at(cp, dt0) - at(cm, dt0)) / (2 * eps)
+        assert abs(float(g_cf[j]) - fd) < 1e-6, (j, float(g_cf[j]), fd)
+
+
+def test_grad_through_imag_time_ansatz():
+    """The imaginary-time core (decays + renormalization) is traced
+    jnp end-to-end, so grad flows through a projection ansatz too."""
+    spec = tfim(4)
+    ansatz = EV.trotter_ansatz(spec, order=1, steps=2, imag_time=True)
+    energy = V.expectation(ansatz, 4, spec, dtype=np.float64)
+    cf = jnp.asarray(np.asarray(spec.coeffs))
+    g_cf, g_dt = jax.grad(energy)((cf, jnp.float64(0.2)))
+    assert np.isfinite(np.asarray(g_cf)).all() and np.isfinite(g_dt)
+    # deeper imaginary time lowers the energy: d E/d dt < 0 off minimum
+    assert float(g_dt) < 0
+
+
+def test_zero_retrace_optimizer_loop(compile_auditor):
+    """A VQE loop that REBUILDS the evolved ansatz + energy function
+    every iteration compiles zero programs after warmup: equal
+    (program_key, PauliSum value-hash) pairs hit variational.sweep's
+    value-keyed program cache (the ISSUE-14 small fix), call-count
+    pinned via the shared compiled program identity."""
+    spec = tfim(4)
+    cf0 = np.asarray(spec.coeffs, np.float32)
+
+    def build():
+        ansatz = EV.trotter_ansatz(spec, order=2, steps=2)
+        return V.expectation(ansatz, 4, spec)
+
+    def batch(cf):
+        return (jnp.stack([cf, cf * 0.9]),
+                jnp.asarray([0.1, 0.11], jnp.float32))
+
+    e0 = build()
+    assert V._sweep_program(e0) is V._sweep_program(build())
+    V.sweep(e0, batch(jnp.asarray(cf0)))          # warmup
+    with compile_auditor as aud:
+        cf = jnp.asarray(cf0)
+        for _ in range(3):
+            energy = build()                      # rebuilt every step
+            vals = V.sweep(energy, batch(cf))
+            cf = cf * 0.99
+        assert np.isfinite(np.asarray(vals)).all()
+    aud.assert_no_retrace("rebuilt-ansatz optimizer loop")
+    # a keyed-knob flip must MISS the value-keyed cache (the rebuilt
+    # energy closes over a different expec plan — Circuit.program_key's
+    # engine_mode_key discipline)
+    warm = V._sweep_program(build())
+    prior = os.environ.get("QUEST_EXPEC_MAX_MASKS")
+    os.environ["QUEST_EXPEC_MAX_MASKS"] = "1"
+    try:
+        assert V._sweep_program(build()) is not warm
+    finally:
+        if prior is None:
+            del os.environ["QUEST_EXPEC_MAX_MASKS"]
+        else:
+            os.environ["QUEST_EXPEC_MAX_MASKS"] = prior
+
+
+def test_sweep_list_param_batch_still_stacks():
+    """A LIST of parameter sets stacks into one batch axis (the
+    original sweep contract) — only tuple/dict pytrees are treated as
+    structured param sets with per-leaf batch axes."""
+    def fn(p):
+        return jnp.sum(p * p)
+
+    out = V.sweep(fn, [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0]),
+                       jnp.asarray([0.5, 0.5])])
+    np.testing.assert_allclose(np.asarray(out), [5.0, 25.0, 0.5],
+                               atol=1e-6)
+
+
+def test_sweep_rejects_ambiguous_uniform_tuple():
+    """A TUPLE whose leaves all share one shape could mean stack (the
+    legacy list semantics) or pytree (per-leaf batch axes) — the two
+    disagree silently, so sweep refuses it loudly instead of guessing
+    (review hardening: a pre-pytree caller passing a tuple of param
+    vectors would have gotten k wrong energies with no error)."""
+    def fn(p):
+        return jnp.sum(p[0] * p[1])
+
+    with pytest.raises(ValueError, match="ambiguous tuple"):
+        V.sweep(fn, (jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0])))
+
+
+def test_rebuilt_trotter_circuit_shares_program_family():
+    """Equal (hamiltonian, dt, order, steps) calls memoize to ONE
+    TrotterCircuit, so serve requests over equal evolution jobs land in
+    one program family (program_key keys on the circuit object)."""
+    spec = tfim(N)
+    c1 = EV.trotter_circuit(spec, 0.05, order=2, steps=8)
+    c2 = EV.trotter_circuit(spec, 0.05, order=2, steps=8)
+    assert c1 is c2
+    assert c1.program_key() == c2.program_key()
+    c3 = EV.trotter_circuit(spec, 0.05, order=2, steps=9)
+    assert c3 is not c1
+    rec = c1.plan_stats()
+    assert rec["trotter"]["hbm_sweeps_per_step"] <= 3
+
+
+# ---------------------------------------------------------------------------
+# the circuit algebra of ComposedDiag (dual + inverse keep `parts`)
+# ---------------------------------------------------------------------------
+
+
+def test_density_evolution_matches_oracle(rng):
+    """A pooled Trotter circuit applied to a density register (the
+    dual path: ComposedDiag's `parts` must conjugate with its table)
+    matches U rho U+ from the product-formula oracle."""
+    n = 3
+    spec = random_sum(rng, n, terms=4)
+    plan = EV._plan_trotter(spec.codes)
+    U = product_formula_oracle(plan, spec, 0.09, 2, 2)
+    v = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+    v /= np.linalg.norm(v)
+    rho = np.outer(v, v.conj())
+    q = qt.create_density_qureg(n, dtype=np.complex128)
+    q = qt.init_pure_state(q, qt.init_state_from_amps(
+        qt.create_qureg(n, dtype=np.complex128), v.real, v.imag))
+    c = EV.trotter_circuit(spec, 0.09, order=2, steps=2)
+    out = c.apply_banded(q)
+    np.testing.assert_allclose(to_dense(out), U @ rho @ U.conj().T,
+                               atol=1e-10, rtol=0)
+
+
+def test_inverse_unwinds_evolution(rng):
+    """circuit.inverse() of a pooled Trotter circuit (ComposedDiag ops
+    negate their phase `parts` alongside the conjugated table) returns
+    the initial state to engine eps."""
+    spec = random_sum(rng, N)
+    c = EV.trotter_circuit(spec, 0.11, order=2, steps=2)
+    q0, v0 = random_state(rng, N, np.float64)
+    # banded engine: the per-gate XLA program is pathologically slow to
+    # compile for ~100-op circuits on XLA-CPU and is not this
+    # workload's engine anyway
+    out = c.inverse().apply_banded(c.apply_banded(q0))
+    np.testing.assert_allclose(to_dense(out), v0, atol=1e-10, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# durable deep quenches
+# ---------------------------------------------------------------------------
+
+
+def _amps(q):
+    return np.asarray(jax.device_get(q.amps))
+
+
+def test_durable_quench_resume_bit_identity_fused(tmp_path, rng):
+    """A preempted deep quench resumes BIT-IDENTICAL to the
+    uninterrupted durable run; the cursor carries the validated Trotter
+    descriptor, and a resume under a DIFFERENT descriptor fails typed
+    instead of splicing checkpoint prefixes."""
+    from quest_tpu import checkpoint as ckpt
+    from quest_tpu.resilience import DurableError
+    spec = tfim(8)
+    q0 = qt.init_debug_state(qt.create_qureg(8))
+    ref = EV.run_evolution(spec, 0.05, 8, state=q0,
+                           durable_dir=str(tmp_path / "ref"),
+                           durable_every=2)
+    # the EvolutionResult contract holds on the durable path: row 0 is
+    # the initial state, the final row the quenched one
+    assert ref.energy_steps.tolist() == [0, 8]
+    assert ref.energies.shape == (2, 1)
+    d = str(tmp_path / "pre")
+    plan = FaultPlan().inject("durable.preempt", after_n=4, times=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            EV.run_evolution(spec, 0.05, 8, state=q0, durable_dir=d,
+                             durable_every=2)
+    assert plan.fired() == 1
+    dirs = ckpt.step_dirs(d)
+    assert dirs, "preempted quench left no checkpoint"
+    cursor = ckpt.read_extra(dirs[-1][1])
+    assert cursor["workload"] == "trotter"
+    assert cursor["trotter_steps"] == 8 and cursor["trotter_order"] == 2
+    # descriptor mismatch fails typed (no prefix splicing)
+    circ21 = EV.trotter_circuit(spec, 0.05, order=2, steps=21)
+    with pytest.raises(DurableError):
+        run_durable(circ21, q0, d, every=2,
+                    cursor_extra={"workload": "trotter",
+                                  "trotter_steps": 21,
+                                  "trotter_order": 2,
+                                  "trotter_dt": repr(0.05),
+                                  "trotter_terms": len(spec.codes)})
+    out = EV.run_evolution(spec, 0.05, 8, state=q0, durable_dir=d,
+                           durable_every=2)
+    np.testing.assert_array_equal(_amps(out.state), _amps(ref.state))
+    assert ckpt.step_dirs(d) == []        # completed run consumed chain
+
+
+@pytest.mark.slow
+def test_durable_quench_resume_bit_identity_sharded_2dev(tmp_path):
+    # slow-marked (~10 s of per-launch sharded jits — the PR-4 budget
+    # discipline); the CI fast-fail step runs it unfiltered, tier-1
+    # keeps the fused and through-serve resume pins
+    from quest_tpu.parallel import make_amp_mesh
+    if max_mesh_devices(2) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = make_amp_mesh(2)
+    spec = tfim(8)
+    q0 = qt.init_debug_state(qt.create_qureg(8))
+    ref = EV.run_evolution(spec, 0.05, 8, state=q0, mesh=mesh,
+                           durable_dir=str(tmp_path / "ref"),
+                           durable_every=2)
+    d = str(tmp_path / "pre")
+    plan = FaultPlan().inject("durable.preempt", after_n=3, times=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            EV.run_evolution(spec, 0.05, 8, state=q0, mesh=mesh,
+                             durable_dir=d, durable_every=2)
+    out = EV.run_evolution(spec, 0.05, 8, state=q0, mesh=mesh,
+                           durable_dir=d, durable_every=2)
+    np.testing.assert_array_equal(_amps(out.state), _amps(ref.state))
+    # eps-equality with the single-device fused quench
+    single = EV.run_evolution(spec, 0.05, 8,
+                              state=qt.init_debug_state(
+                                  qt.create_qureg(8)))
+    np.testing.assert_allclose(to_dense(out.state),
+                               to_dense(single.state), atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_durable_quench_through_serve(tmp_path):
+    """An evolution job submitted through serve with durable_dir= rides
+    the durable executor at the worker: an injected preempt mid-quench
+    RESUMES in place and the future resolves bit-identical to the
+    uninterrupted durable run."""
+    from quest_tpu.serve.engine import ServeEngine
+    from quest_tpu.serve import metrics
+    spec = tfim(8)
+    circ = EV.trotter_circuit(spec, 0.05, order=2, steps=12)
+    q0 = qt.init_debug_state(qt.create_qureg(8))
+    s0 = _amps(q0)
+    ref = run_durable(circ, q0, str(tmp_path / "ref"), every=2)
+    ref_hash = hashlib.sha256(_amps(ref).tobytes()).hexdigest()
+    reg = metrics.Registry()
+    plan = FaultPlan().inject("durable.preempt", after_n=4, times=1)
+    with faults.active(plan):
+        with ServeEngine(max_wait_ms=2, registry=reg) as eng:
+            out = eng.submit(circ, state=s0,
+                             durable_dir=str(tmp_path / "job"),
+                             durable_every=2).result(timeout=600)
+    assert plan.fired("durable.preempt") == 1
+    assert hashlib.sha256(np.asarray(out).tobytes()).hexdigest() \
+        == ref_hash
+    assert reg.snapshot()["counters"]["serve_durable_inplace_resumes"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# sharded + trajectory smoke
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_quench_eps_equality(rng):
+    from quest_tpu.parallel import make_amp_mesh
+    if max_mesh_devices(2) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = make_amp_mesh(2)
+    spec = random_sum(rng, 6)
+    q0 = qt.init_debug_state(qt.create_qureg(6))
+    res_m = EV.run_evolution(spec, 0.05, 6, state=q0, mesh=mesh,
+                             energy_every=3)
+    res_1 = EV.run_evolution(spec, 0.05, 6, state=q0, energy_every=3)
+    assert res_m.stats["engine"] == "sharded-banded"
+    np.testing.assert_allclose(to_dense(res_m.state),
+                               to_dense(res_1.state), atol=1e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(res_m.energies, res_1.energies,
+                               atol=1e-3, rtol=1e-4)
+    # engine='fused' under mesh= is HONORED (review hardening: it used
+    # to silently dispatch the sharded-banded program)
+    res_f = EV.run_evolution(spec, 0.05, 6, state=q0, mesh=mesh,
+                             energy_every=3, engine="fused",
+                             interpret=True)
+    assert res_f.stats["engine"] == "sharded-fused"
+    np.testing.assert_allclose(to_dense(res_f.state),
+                               to_dense(res_1.state), atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_noisy_trotter_trajectories(rng):
+    """Noisy Trotter rides the EXISTING channel path: per-step
+    dephasing trajectories stay normalized per shot, and the shot
+    average of Z0 approaches the density-matrix evolution."""
+    spec = tfim(3)
+    planes, draws = EV.run_evolution_trajectories(
+        spec, 0.05, 3, 4, noise=("dephasing", 0.05),
+        key=jax.random.key(3))
+    assert planes.shape == (4, 2, 8)
+    norms = (planes.astype(np.float64) ** 2).sum(axis=(1, 2))
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    # draws: one per noise site per step (3 qubits x 3 steps)
+    assert draws.shape[0] == 4 and draws.shape[1] == 9

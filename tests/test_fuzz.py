@@ -166,6 +166,9 @@ def test_fuzz_density_with_channels(seed):
                                    err_msg=f"host density seed={seed}")
 
 
+@pytest.mark.slow          # ~24 s across seeds — fuzz rides with the
+                           # laneblock fuzz oracle in the slow set
+                           # (tier-1 budget discipline)
 @pytest.mark.parametrize("seed", range(4))
 def test_fuzz_sharded_engines(seed):
     """The same random mixed circuits over the 8-device mesh: per-gate,
@@ -220,6 +223,8 @@ def test_fuzz_high_precision_tier(seed):
                                err_msg=f"high-tier seed={seed}")
 
 
+@pytest.mark.slow          # ~5 s — fuzz rides in the slow set
+                           # (tier-1 budget discipline)
 def test_fuzz_qasm_roundtrip():
     """Random circuits over the QASM-expressible op vocabulary survive
     to_qasm -> from_qasm with the same action up to global phase (%g

@@ -115,6 +115,10 @@ def test_laneblock_path_matches_oracle():
         assert err < 1e-5, (targets, controls, cstates, err)
 
 
+@pytest.mark.slow          # ~34 s across the 7 pairs — tier-1 budget
+                           # discipline; the randomized sweep oracles
+                           # keep cross-band 2q coverage in tier-1
+                           # (runs in the full CI suite step)
 @pytest.mark.parametrize("pair", [(3, 10), (3, 17), (10, 17), (15, 18),
                                   (16, 22), (3, 22), (10, 22)])
 def test_fused_2q_unitary_every_band_pair(pair, rng):

@@ -52,6 +52,9 @@ def _check_equiv(circ, mesh, density=False):
     np.testing.assert_allclose(a, b, atol=1e-12, rtol=0)
 
 
+@pytest.mark.slow          # ~8 s — tier-1 budget discipline; the
+                           # deep-global equivalence test keeps lazy
+                           # parity coverage in tier-1
 def test_lazy_equivalence_random_circuits(mesh):
     for seed in (3, 11):
         _check_equiv(random_circuit(N, depth=5, seed=seed), mesh)
@@ -78,6 +81,8 @@ def test_lazy_equivalence_banded_engine(mesh):
                                atol=1e-12, rtol=0)
 
 
+@pytest.mark.slow          # ~12 s on this host — tier-1 budget
+                           # discipline (runs in the full CI suite step)
 def test_lazy_reduces_collective_traffic(mesh, monkeypatch):
     # the LEGACY comparison this test owns (lazy rewrite vs the plain
     # swap-dance schedule) — pinned under QUEST_COMM_PLAN=0, since the
@@ -149,6 +154,8 @@ def test_full_relabel_fused_engine_equivalence(mesh):
     np.testing.assert_allclose(got, want, atol=2e-4 * scale, rtol=0)
 
 
+@pytest.mark.slow          # ~12 s on this host — tier-1 budget
+                           # discipline (runs in the full CI suite step)
 def test_full_relabel_cuts_fused_collective_bytes(mesh):
     """The relabeled fused schedule must ship FEWER collective bytes
     and FEWER collective ops than the plain schedule on the deep-global
@@ -180,6 +187,9 @@ def test_full_relabel_cuts_fused_collective_bytes(mesh):
             < plain["collective_exchanges"]), (plain, relab)
 
 
+@pytest.mark.slow          # ~7 s — tier-1 budget discipline; the
+                           # fused-engine full-relabel equivalence
+                           # stays in tier-1
 def test_full_relabel_banded_engine(mesh):
     """The banded sharded engine (the f64 pod path) runs the same
     layer-amortized relabel events by default: equivalence against the

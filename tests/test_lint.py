@@ -352,6 +352,45 @@ def test_expec_knobs_are_keyed_with_flips():
             k.parse(k.malformed)
 
 
+def test_trotter_knob_registry_coverage(tmp_path):
+    """QUEST_TROTTER_FUSION coverage of the registry rules (ISSUE 14):
+    a registry read (knob_value) of the keyed Trotter-emission knob on
+    a jit-reachable path passes QL001; a direct os.environ read of the
+    same knob fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_TROTTER_FUSION"):
+                return amps
+            return amps * 2
+
+        def configure():
+            return os.environ.get("QUEST_TROTTER_FUSION")
+    """, name="trotterknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and "bypasses" in q4[0].message, vs
+
+
+def test_trotter_knob_is_keyed_with_flips():
+    """The Trotter-emission knob must stay keyed (it selects which
+    circuit a memoized trotter_circuit call builds, and with it every
+    compiled program the evolution workload resolves to) and
+    flip-auditable — the knob-flip audit sweeps every keyed knob with
+    registered flips automatically, so this pin keeps it in that
+    sweep, and the 0/1 parser must reject malformed input loudly."""
+    from quest_tpu.env import KNOBS
+    k = KNOBS["QUEST_TROTTER_FUSION"]
+    assert k.scope == "keyed" and k.layer == "planner"
+    assert k.flips == ("1", "0")
+    with pytest.raises(ValueError):
+        k.parse(k.malformed)
+
+
 def test_comm_knob_registry_coverage(tmp_path):
     """QUEST_COMM_PLAN / QUEST_EXCHANGE_SLICES coverage of the registry
     rules (ISSUE 9): a registry read (knob_value) of the keyed comm
@@ -765,6 +804,10 @@ def test_suppression_comments(tmp_path):
     assert not _lint_fixture(tmp_path, src_file, name="bad2.py")
 
 
+@pytest.mark.slow          # ~8 s CLI subprocess spawns — tier-1 budget
+                           # discipline (the CI runs `python -m
+                           # quest_tpu.analysis` as its own step AND the
+                           # full suite including slow)
 def test_cli_exit_codes(tmp_path):
     """`python -m quest_tpu.analysis` exits 0 on a clean path, 1 on a
     seeded violation, and lists the rule catalog."""

@@ -212,6 +212,9 @@ def test_scattered_qubits_fuse():
     check(c, n=n)
 
 
+@pytest.mark.slow          # ~9 s — tier-1 budget discipline; the
+                           # sparse-high-band SCB test keeps
+                           # scattered-bit coverage in tier-1
 def test_full_high_band_scb():
     """A whole 7-qubit high band (d=128 scb) plus gates in every other
     band and a cross-band CZ — numerics through the interpreter. The
@@ -420,6 +423,8 @@ def test_channel_builders_validate():
         c.kraus((0, 1), [np.eye(2)])           # dim mismatch
 
 
+@pytest.mark.slow          # ~18 s on this host — tier-1 budget
+                           # discipline (runs in the full CI suite step)
 def test_deep_circuit_segment_stage_cap():
     """Deep circuits split at MAX_SEGMENT_STAGES so kernel operand blocks
     cannot accumulate without bound in VMEM; numerics unchanged."""
@@ -643,6 +648,8 @@ def test_scan_applier_matches_sequential_with_stub_segment():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
 
 
+@pytest.mark.slow          # ~11 s — tier-1 budget discipline (runs in
+                           # the full CI suite step)
 def test_apply_matrix_rows_matches_flat():
     """apply_matrix on the (2, rows, 128) kernel layout must match the
     flat path across target/control placements. The shaped path exists

@@ -62,6 +62,9 @@ def test_op_metrics_reports_bytes():
     assert isinstance(metrics, dict)  # backend-dependent contents
 
 
+@pytest.mark.slow          # ~29 s: the heaviest single test on this
+                           # host — tier-1 budget discipline (runs in
+                           # the full CI suite step)
 def test_annotate_and_trace(tmp_path):
     with profiling.annotate("test-region"):
         _ = qt.create_qureg(4)

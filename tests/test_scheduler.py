@@ -95,6 +95,9 @@ def test_ghz_plan_unchanged_by_scheduler():
     assert sc == un
 
 
+@pytest.mark.slow          # ~7 s 30q-class planning — tier-1 budget
+                           # discipline; the sweep golden gate holds
+                           # the plan ceilings CI-side
 def test_rcs30_does_not_regress():
     """The headline workload: scheduling must not add passes (it
     currently removes a couple by composing the CZ brick)."""

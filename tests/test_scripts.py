@@ -61,6 +61,8 @@ def test_tunnel_lib_dead_port_reports_down():
     assert out.stdout.strip() == "DOWN", out.stderr
 
 
+@pytest.mark.slow          # ~8 s subprocess spawns — tier-1 budget
+                           # discipline (runs in the full CI suite step)
 def test_probe_tolerates_empty_and_garbage_port():
     """ensure_live_backend must degrade, not crash, on any QUEST_AXON_PORT
     value (empty string and non-numeric both reach the int parse)."""
