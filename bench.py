@@ -1212,6 +1212,55 @@ def _build_durable_circuit(n: int, layers: int = 16, seed: int = 11):
     return c
 
 
+def _build_elastic_circuit(n: int, layers: int = 3, seed: int = 7):
+    """The elastic-resume pins' workload (docs/RESILIENCE.md §elastic):
+    a circuit whose ARITHMETIC is mesh-portable, so an elastic resume
+    on a different device/host count can be pinned BIT-identical to an
+    uninterrupted native run on the target mesh (general circuits
+    resume eps-close: band contractions reassociate per chunk shape).
+    The portability rules, each verified empirically on this backend
+    (tests/test_elastic.py):
+
+      * rotations (rx/ry) only on qubits < 7, each isolated in its OWN
+        band operator by a cross-band cz blocker — a single embedded 1q
+        gate contracts with <= 2 products per output component, which
+        every chunk shape with local_n >= 8 reduces identically (a
+        merged multi-qubit operator or a >= 4-product complex row
+        reassociates per shape);
+      * amplitude reaches qubits >= 7 only through PERMUTATION gates
+        (CNOT — moves are exact on the band path AND the sharded
+        pair-exchange path, which otherwise disagree on fma usage);
+      * phases via cz only (exact -1 multiplies everywhere).
+
+    Run the pins under QUEST_SCHEDULE=0: the scheduler's diagonal
+    pooling hoists the blockers away and re-merges the rotations. One
+    home, shared by tests/test_elastic.py, tests/_elastic_worker.py and
+    scripts/check_elastic_golden.py."""
+    from quest_tpu.circuit import Circuit
+
+    if n < 8:
+        # the portability contract itself needs local_n >= 8 on every
+        # mesh, so a sub-8q register can never be in scope — and the
+        # high-qubit transfer below would index control h-7 < 0
+        raise ValueError(
+            f"the mesh-portable elastic circuit needs n >= 8 (its "
+            f"arithmetic-portability rules require local_n >= 8 on "
+            f"every tested mesh), got {n}")
+    rng = np.random.default_rng(seed)
+    c = Circuit(n)
+    for layer in range(layers):
+        for q in range(7):
+            c.cz(q, n - 1)
+            ang = float(rng.uniform(0, 2 * np.pi))
+            (c.rx if (layer + q) % 2 == 0 else c.ry)(q, ang)
+        if layer == 0:
+            for h in range(7, n):
+                c.cnot(h - 7, h)
+        for h in range(7, n):
+            c.cz(h, (h + layer) % 7)
+    return c
+
+
 def _measure_durable(n: int = 18, layers: int = 16, every: int = 64,
                      reps: int = 3):
     """The `bench.py durable` scenario (docs/RESILIENCE.md §durable):

@@ -714,6 +714,9 @@ def load_step_gang(path: str, *, kind_extra: str = None):
     any missing/corrupt/mismatched piece."""
     if faults.ACTIVE:
         faults.check("checkpoint.load", directory=path)
+        # the gang-specific site: chaos plans target gang resume /
+        # elastic reassembly without arming every plain load
+        faults.check("checkpoint.load_gang", directory=path)
     meta0_path = os.path.join(path, "meta-0.json")
     if not os.path.exists(meta0_path):
         raise CheckpointError(
@@ -806,6 +809,79 @@ def load_step_gang(path: str, *, kind_extra: str = None):
                 f"Invalid checkpoint: {path!r} carries no "
                 f"{kind_extra!r} durable cursor")
     return metas, planes
+
+
+# ---------------------------------------------------------------------------
+# elastic (mesh-independent) step loading
+# ---------------------------------------------------------------------------
+
+
+def is_gang_step(path: str) -> bool:
+    """True when `path` is a COMMITTED gang-format step checkpoint
+    (save_step_gang's per-host shard layout) rather than a plain
+    single-process one — the elastic loader's format dispatch."""
+    return os.path.exists(os.path.join(path, "meta-0.json"))
+
+
+def load_step_elastic(path: str, *, mesh=None, perm=None):
+    """(cursor, planes) of ONE committed step checkpoint in CANONICAL
+    LOGICAL ORDER, whatever wrote it (docs/RESILIENCE.md §elastic):
+
+      * a gang checkpoint (any host count) reassembles through
+        load_step_gang — every shard's digests re-verified — and the
+        cursor's relabel permutation normalizes the physical layout;
+      * a plain checkpoint written canonical (cursor layout
+        'canonical') loads as-is; a LEGACY physical-layout one (older
+        chains) normalizes tolerantly through its recorded perm —
+        old-format checkpoints either load correctly or fail loudly,
+        never resume wrong.
+
+    The cursor must be a durable STATE cursor carrying the fields the
+    normalization needs; anything else raises CheckpointError. `mesh`
+    re-enters the planes onto a target mesh's amplitude sharding via
+    make_array_from_callback (required on multi-host meshes, where a
+    device_put cannot target non-addressable devices), after applying
+    `perm` (the TARGET plan's cut permutation, logical -> physical;
+    None/identity for canonical entry) — the durable executor passes
+    its re-derived boundary perm through this."""
+    from quest_tpu.parallel import relabel as R
+
+    if is_gang_step(path):
+        metas, planes = load_step_gang(path, kind_extra="state")
+        cursor = metas[0].get("extra")
+        layout = "physical"
+    else:
+        meta, arrays = load_arrays(path, require=("planes",))
+        cursor = meta.get("extra")
+        if not isinstance(cursor, dict) or cursor.get("kind") != "state":
+            raise CheckpointError(
+                f"Invalid checkpoint: {path!r} carries no durable "
+                f"state cursor — not an elastically loadable step")
+        planes = np.asarray(arrays["planes"])
+        layout = cursor.get("layout", "physical")
+    if not isinstance(cursor, dict):
+        raise CheckpointError(
+            f"Invalid checkpoint: {path!r} carries no durable cursor")
+    if layout != "canonical":
+        src_perm = cursor.get("perm")
+        if src_perm is not None:
+            if (not isinstance(src_perm, (list, tuple))
+                    or (1 << len(src_perm)) != planes.shape[-1]):
+                raise CheckpointError(
+                    f"Invalid checkpoint: {path!r} carries a relabel "
+                    f"perm of {src_perm!r} that does not match its "
+                    f"{planes.shape[-1]}-amp planes — refusing to "
+                    f"normalize (a wrong layout resumes to wrong "
+                    f"amplitudes)")
+            planes = R.canonicalize_planes(planes, list(src_perm))
+    if mesh is not None:
+        if perm:
+            planes = R.physicalize_planes(np.asarray(planes), perm)
+        from quest_tpu.parallel.mesh import amp_sharding
+        arr = np.asarray(planes)
+        planes = jax.make_array_from_callback(
+            arr.shape, amp_sharding(mesh), lambda idx: arr[idx])
+    return cursor, planes
 
 
 # ---------------------------------------------------------------------------

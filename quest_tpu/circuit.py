@@ -38,6 +38,39 @@ class GateOp:
     # the channel's Kraus decomposition from the superoperator op
 
 
+# op-count threshold above which the PER-GATE XLA engine (Circuit.apply
+# / compiled / trace — one HLO op chain per gate) warns about its
+# compile time: XLA-CPU compile of a ~100-op per-gate program measured
+# PATHOLOGICALLY slow (minutes; observed on the PR-13 evolution
+# circuits — the banded/fused engines compile the same circuit in
+# seconds because band composition collapses the chain). 64 keeps the
+# oracle path quiet for the small fuzz circuits the tests trace while
+# catching every real workload-sized circuit.
+PERGATE_COMPILE_WARN_OPS = 64
+
+_pergate_warned = False
+
+
+def _warn_pergate_compile_once(num_ops: int) -> None:
+    """Once-per-process stderr nudge toward the fusing engines: the
+    per-gate path is the semantic ORACLE, not the way to run a deep
+    circuit (docs/SCHEDULER.md)."""
+    global _pergate_warned
+    if _pergate_warned:
+        return
+    _pergate_warned = True
+    import sys
+    print(f"[quest_tpu.circuit] compiling a {num_ops}-op circuit "
+          f"through the PER-GATE XLA engine (Circuit.apply/compiled): "
+          f"XLA compile time grows pathologically with per-gate op "
+          f"chains (minutes at ~100 ops on XLA-CPU). Use "
+          f"Circuit.apply_banded or compiled_fused — the fusing "
+          f"engines compose the same circuit into band passes and "
+          f"compile in seconds (threshold: "
+          f"PERGATE_COMPILE_WARN_OPS={PERGATE_COMPILE_WARN_OPS}; "
+          f"warned once per process)", file=sys.stderr, flush=True)
+
+
 def dual_of(op: GateOp, shift: int):
     """The column-space dual of a gate on a density register: conjugated
     operand on targets/controls shifted by N (ref QuEST.c:8-10). The ONE
@@ -989,6 +1022,14 @@ class Circuit:
     def compiled(self, n: int, density: bool, donate: bool = True,
                  iters: int = 1):
         self._reject_measure("compiled")
+        # compiled-program size, not work: past _LOOP_UNROLL_MAX the
+        # iteration rides ONE fori_loop whose body traces len(ops) HLO
+        # ops (_loop), so only an UNROLLED iters multiplies what XLA
+        # must compile
+        unroll = iters if 1 <= iters <= _LOOP_UNROLL_MAX else 1
+        emitted = len(self.ops) * unroll
+        if emitted > PERGATE_COMPILE_WARN_OPS:
+            _warn_pergate_compile_once(emitted)
         key = (n, density, donate, iters,
                _engine_mode_key())
         fn = self._compiled.get(key)

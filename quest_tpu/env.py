@@ -228,6 +228,18 @@ def _parse_pos_float(name: str) -> Callable[[str], float]:
     return parse
 
 
+def _parse_nonneg_float(name: str) -> Callable[[str], float]:
+    def parse(raw: str) -> float:
+        try:
+            v = float(raw)
+        except ValueError:
+            raise ValueError(f"{name} must be a float, got {raw!r}")
+        if not (v >= 0.0):
+            raise ValueError(f"{name} must be >= 0, got {v}")
+        return v
+    return parse
+
+
 def _parse_fault_plan(raw: str):
     # the resilience package is stdlib-only at import time, so the lazy
     # import cannot cycle back into env.py's module load
@@ -551,6 +563,26 @@ _KNOB_LIST = (
              "corrupt newest checkpoint always leaves a valid "
              "predecessor to resume from)",
          malformed="0"),
+    Knob("QUEST_DURABLE_ELASTIC", _bool01("QUEST_DURABLE_ELASTIC"),
+         False,
+         scope="runtime", layer="serve",
+         doc="default for run_durable(elastic=): 1 makes durable "
+             "resume MESH-INDEPENDENT — a checkpoint chain written by "
+             "D devices across H hosts re-enters any mesh that holds "
+             "the amplitudes, re-verifying digests and re-deriving the "
+             "comm plan (default: 0 — mesh mismatch rejects typed; "
+             "docs/RESILIENCE.md §elastic)",
+         malformed="yes"),
+    Knob("QUEST_DISPATCH_TIMEOUT_S",
+         _parse_nonneg_float("QUEST_DISPATCH_TIMEOUT_S"), 0.0,
+         scope="runtime", layer="serve",
+         doc="serve dispatch watchdog deadline in seconds: a launch "
+             "exceeding it fails typed DispatchTimeout, counts toward "
+             "the program's breaker, and the supervisor replaces the "
+             "wedged worker thread instead of letting drain() hang "
+             "(default: 0 = watchdog off; docs/RESILIENCE.md "
+             "§watchdog)",
+         malformed="-1"),
     Knob("_QUEST_DRYRUN_BOOTSTRAPPED", _parse_choice(
          "_QUEST_DRYRUN_BOOTSTRAPPED", ("1",)), None,
          scope="runtime", layer="infra",

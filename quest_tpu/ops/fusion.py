@@ -163,14 +163,41 @@ def band_range(n: int, b: int) -> Tuple[int, int]:
     return ql, min(BAND_W, n - ql)
 
 
-def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List:
+class _SrcTrackedList(list):
+    """plan()'s item list with per-item input-op attribution: append
+    records the planner loop's current op index (`cur`) into a parallel
+    `src` list; try_merge unions merged ops' indices in place. Kept
+    inside the planner — callers see plain items plus the optional
+    `attr` out-list."""
+
+    __slots__ = ("src", "cur")
+
+    def __init__(self):
+        super().__init__()
+        self.src: List[set] = []
+        self.cur = -1
+
+    def append(self, x):
+        super().append(x)
+        self.src.append({self.cur})
+
+
+def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None,
+         attr: Optional[List] = None) -> List:
     """Fuse a GateOp sequence into [BandOp | DiagItem | PassOp], preserving
     semantics. Gate operands must be concrete (numpy) to compose; ops with
     traced operands become PassOps.
 
     `bands` optionally overrides the default 7-wide band layout with a
     list of (ql, w) ranges covering [0, n) — the Pallas engine uses this
-    to align the tile band with its block top (pallas_band.plan_bands)."""
+    to align the tile band with its block top (pallas_band.plan_bands).
+
+    `attr`, when a list, receives one frozenset per emitted item holding
+    the INPUT op indices that item consumed (composition unions them; an
+    op the planner decomposes — cross-band SWAP/KAK — attributes every
+    piece). The durable executor's elastic-resume layer maps plan-step
+    boundaries back to op-stream positions through this
+    (quest_tpu/resilience/durable.py, docs/RESILIENCE.md §elastic)."""
     if bands is None:
         band_of = _band_of
         band_rng = lambda b: band_range(n, b)  # noqa: E731
@@ -184,7 +211,7 @@ def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List
         def band_rng(b):
             return bands[b]
 
-    items: List = []
+    items = _SrcTrackedList()
 
     def try_merge(band: int, emb: np.ndarray, preds, nondiag, touched):
         """Merge emb into an existing BandOp for `band` if every item in
@@ -197,13 +224,15 @@ def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List
                 comp = emb @ (g.gre.astype(np.complex128) + 1j * g.gim)
                 items[i] = BandOp(g.ql, g.w, comp.real, comp.imag, preds,
                                   g.nondiag | nondiag, g.touched | touched)
+                items.src[i].add(items.cur)
                 return True
             g_nondiag = getattr(g, "nondiag", frozenset())
             if not _commutes(nondiag, new_all, g_nondiag, g.qubits()):
                 return False
         return False
 
-    for op in ops:
+    for op_idx, op in enumerate(ops):
+        items.cur = op_idx
         targets = tuple(op.targets)
         controls = tuple(op.controls)
         cstates = tuple(op.cstates) if op.cstates else (1,) * len(controls)
@@ -354,7 +383,9 @@ def plan(ops: Sequence, n: int, bands: Sequence[Tuple[int, int]] = None) -> List
             continue
         items.append(BandOp(ql, w, emb.real, emb.imag, preds, nondiag,
                             touched))
-    return items
+    if attr is not None:
+        attr.extend(frozenset(s) for s in items.src)
+    return list(items)
 
 
 # ---------------------------------------------------------------------------

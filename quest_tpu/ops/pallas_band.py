@@ -58,7 +58,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -312,15 +312,22 @@ def max_block_row_bits() -> int:
 
 
 def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
-                 batch: int = 1):
+                 batch: int = 1, attr: Optional[list] = None):
     """Split fusion-plan items into kernel segments and XLA passthroughs.
     Returns a list of ("segment", [stages], [op_arrays]) and
     ("xla", item) entries, in program order. `batch` sizes the
     (batch, 8) zero-placeholder operands of ChannelItem stages (batched
     trajectory channels) — the one place the batch enters the plan's
     operand-byte accounting; all other stage operands are shared across
-    the batch and stay batch-independent."""
+    the batch and stay batch-independent. `attr`, when a list, receives
+    one tuple of input ITEM indices per emitted part (the durable
+    executor's elastic cut-boundary attribution, composed with
+    fusion.plan's per-item op attribution — docs/RESILIENCE.md
+    §elastic)."""
     parts: List = []
+    part_src: List[tuple] = []      # item indices per emitted part
+    seg_src: List[int] = []         # item indices in the open segment
+    cur_item = -1
     stages: List = []
     arrays: List = []
     scat_bits: set = set()
@@ -328,12 +335,18 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
     row_budget = max_block_row_bits()
 
     def flush():
-        nonlocal stages, arrays, scat_bits, b1_floor
+        nonlocal stages, arrays, scat_bits, b1_floor, seg_src
         if stages:
             parts.append(("segment", stages, arrays))
+            part_src.append(tuple(seg_src))
             stages, arrays = [], []
+        seg_src = []
         scat_bits = set()
         b1_floor = 0
+
+    def emit_xla(it):
+        parts.append(("xla", it))
+        part_src.append((cur_item,))
 
     def reserve(bits=frozenset(), floor=0):
         """Claim scattered row bits / a sublane-floor for the next stage,
@@ -358,7 +371,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
         b1_floor = new_floor
         return True
 
-    for it in items:
+    for cur_item, it in enumerate(items):
         if len(stages) >= MAX_SEGMENT_STAGES:
             flush()
         if isinstance(it, ChannelItem):
@@ -391,6 +404,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                         f"channel qubit {q} does not fit an empty "
                         f"segment under the caller's scatter budget")
             stages.append(BatchSelStage(q, it.index, it.barrier))
+            seg_src.append(cur_item)
             arrays.append(np.zeros((batch, 8), dtype=np.float32))
             continue
         if isinstance(it, F.BandOp):
@@ -409,7 +423,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                 g = it.gre + 1j * it.gim
                 if not reserve(bits=(bit,)):
                     flush()
-                    parts.append(("xla", it))
+                    emit_xla(it)
                     continue
             else:                  # high band: one MXU dot over its
                 kind = "scb"       # merged scattered axes
@@ -435,7 +449,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                         w = w2
                 if not reserve(bits=range(bit, bit + w)):
                     flush()
-                    parts.append(("xla", it))
+                    emit_xla(it)
                     continue
                 # do NOT Kron-split a factorizable band operator into
                 # narrow per-factor dots: measured r4, a narrow scb's
@@ -454,6 +468,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                 g = g.T
             stages.append(MatStage(kind, g.shape[0], real_only, lane_p,
                                    row_p, bit))
+            seg_src.append(cur_item)
             # keep operator arrays HOST-side (numpy): as closure
             # constants they upload with the program instead of occupying
             # HBM and round-tripping device->host at trace time
@@ -468,6 +483,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                 rm = sum(1 << (q - LANE_QUBITS) for q in targets
                          if q >= LANE_QUBITS)
                 stages.append(ParityStage())
+                seg_src.append(cur_item)
                 arrays.append(np.array(
                     [[np.cos(half), np.sin(half), lm,
                       rm & 0x7FFF, rm >> 15, 0, 0, 0]], dtype=np.float32))
@@ -489,6 +505,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                                      0, 0, 0, 0])
                         forms.append("a" if form == "allones" else "p")
                     stages.append(MultiPhaseStage(tuple(forms)))
+                    seg_src.append(cur_item)
                     arrays.append(np.array(rows, dtype=np.float32))
                     continue
                 d = np.asarray(op.operand, dtype=np.complex128).reshape(-1)
@@ -496,6 +513,7 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                     tuple(zip(op.controls, op.cstates or
                               (1,) * len(op.controls))))
                 stages.append(DiagVecStage(targets, lane_p, row_p))
+                seg_src.append(cur_item)
                 arrays.append(np.stack([d.real, d.imag]).astype(np.float32))
                 continue
             if op.kind == "allones" and isinstance(
@@ -513,12 +531,13 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                         rw |= s << (q - LANE_QUBITS)
                 t = complex(op.operand)
                 stages.append(PhaseStage())
+                seg_src.append(cur_item)
                 arrays.append(np.array(
                     [[t.real, t.imag, lm, lw, rm & 0x7FFF, rm >> 15,
                       rw & 0x7FFF, rw >> 15]], dtype=np.float32))
                 continue
             flush()
-            parts.append(("xla", it))
+            emit_xla(it)
             continue
         if isinstance(it, F.PassOp):
             st = _try_pair_stage(it, scatter_max)
@@ -531,11 +550,14 @@ def segment_plan(items: Sequence, n: int, scatter_max: int = SCATTER_MAX,
                     floor = max(floor, stage.sliced_bit + 1)
                 if reserve(bits=new_scat or frozenset(), floor=floor):
                     stages.append(stage)
+                    seg_src.append(cur_item)
                     arrays.append(arr)
                     continue
         flush()
-        parts.append(("xla", it))
+        emit_xla(it)
     flush()
+    if attr is not None:
+        attr.extend(part_src)
     return parts
 
 
@@ -701,7 +723,8 @@ def sweep_operand_budget() -> int:
 
 def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
                row_budget: int = None, max_stages: int = MAX_SWEEP_STAGES,
-               operand_bytes: int = None):
+               operand_bytes: int = None, attr: Optional[list] = None,
+               part_attrs: Optional[Sequence] = None):
     """Merge consecutive ("segment", stages, arrays) parts of a
     segment_plan (or a concatenation of several applications' plans)
     into maximal single-launch sweeps, preserving program order.
@@ -709,19 +732,28 @@ def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
     (compile_segment, _scan_partition, the sharded compilers) is
     unchanged. `n` is unused by the merge rule itself but kept so the
     layer sits uniformly between segment_plan(items, n) and the kernel
-    compilers."""
+    compilers. `attr`, when a list, receives one tuple of attribution
+    entries per OUTPUT part, merged from `part_attrs` (one tuple per
+    input part, e.g. segment_plan's item attribution; defaults to each
+    input part's own index) — the durable elastic layer's cut-boundary
+    bookkeeping (docs/RESILIENCE.md §elastic)."""
     del n
     if row_budget is None:
         row_budget = max_block_row_bits()
     if operand_bytes is None:
         operand_bytes = sweep_operand_budget()
+    if part_attrs is None:
+        part_attrs = [(i,) for i in range(len(parts))]
     out = []
+    out_attr: List[tuple] = []
     cur_scat: set = set()
     cur_floor = 0
     cur_bytes = 0
-    for part in parts:
+    for pi, part in enumerate(parts):
+        src = tuple(part_attrs[pi])
         if part[0] != "segment":
             out.append(part)            # XLA passthrough: a sweep barrier
+            out_attr.append(src)
             cur_scat, cur_floor, cur_bytes = set(), 0, 0
             continue
         stages, arrays = list(part[1]), list(part[2])
@@ -743,11 +775,15 @@ def sweep_plan(parts, n: int, *, scatter_max: int = SCATTER_MAX,
                     and u_floor + len(u_scat) <= row_budget
                     and cur_bytes + nbytes <= operand_bytes):
                 out[-1] = ("segment", prev[1] + stages, prev[2] + arrays)
+                out_attr[-1] = out_attr[-1] + src
                 cur_scat, cur_floor = u_scat, u_floor
                 cur_bytes += nbytes
                 continue
         out.append(("segment", stages, arrays))
+        out_attr.append(src)
         cur_scat, cur_floor, cur_bytes = set(scat), floor, nbytes
+    if attr is not None:
+        attr.extend(out_attr)
     return out
 
 

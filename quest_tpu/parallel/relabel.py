@@ -44,6 +44,12 @@ SWAP = np.array([[1, 0, 0, 0],
                  [0, 1, 0, 0],
                  [0, 0, 0, 1]], dtype=np.complex128)
 
+# meta tag on every SWAP the relabel passes themselves insert: marks the
+# op as layout movement (excluded from the elastic boundary map's
+# canonical op count), distinguishing it from a user-authored SWAP
+# unitary that merely shares the matrix value
+INSERTED_META = ("relabel", "inserted-swap")
+
 
 def reject_dynamic_ops(flat: Sequence, pass_name: str) -> None:
     """Dynamic-circuit ops carry NESTED gate lists in their operands that
@@ -87,9 +93,14 @@ class _PermTracker:
             self.inv[s], self.inv[gpos] = lg, ls
 
     def emit_swap(self, a: int, b: int) -> None:
-        """Physical 2q SWAP of positions a, b."""
+        """Physical 2q SWAP of positions a, b. The meta marker tags the
+        op as PASS-INSERTED layout movement (vs a user-authored SWAP
+        unitary): the durable executor's elastic boundary map classifies
+        flat ops through it (docs/RESILIENCE.md §elastic); replay_perm
+        keeps its value-match so pre-marker op lists replay unchanged."""
         from quest_tpu.circuit import GateOp
-        self.out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP))
+        self.out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP,
+                               meta=INSERTED_META))
         la, lb = self.inv[a], self.inv[b]
         self.perm[la], self.perm[lb] = b, a
         self.inv[a], self.inv[b] = lb, la
@@ -165,6 +176,84 @@ def replay_perm(flat_prefix: Sequence, n: int, local_n: int) -> List[int]:
     return list(tr.perm)
 
 
+def is_inserted_layout_op(op) -> bool:
+    """True for ops the relabel passes INSERTED as layout movement: the
+    whole-register relabel events and the meta-tagged SWAPs. These ops
+    move data without consuming circuit semantics, so the durable
+    elastic boundary map excludes them from the canonical op count
+    (quest_tpu/resilience/durable.py, docs/RESILIENCE.md §elastic)."""
+    kind = getattr(op, "kind", None)
+    if kind == "relabel":
+        return True
+    return (kind == "matrix"
+            and getattr(op, "meta", None) == INSERTED_META)
+
+
+# ---------------------------------------------------------------------------
+# canonical <-> physical plane layout (the elastic checkpoint contract)
+# ---------------------------------------------------------------------------
+#
+# A sharded engine's live amplitude array is laid out in PHYSICAL
+# positions: after relabel events / inserted SWAPs, column-index bit p
+# holds logical qubit inv[p] (perm[l] = physical position of logical
+# qubit l — the _PermTracker convention replay_perm reconstructs). A
+# checkpoint stored in that layout is only meaningful to a reader that
+# replays the same relabel history on the same mesh. The two helpers
+# below convert between that layout and CANONICAL LOGICAL ORDER
+# (column-index bit l = logical qubit l) as a pure, exact index
+# permutation — zero floating-point arithmetic, so a canonicalize ->
+# physicalize round trip is bit-identical (tests/test_elastic.py).
+
+
+def _perm_axes(perm: Sequence[int]):
+    """numpy transpose axes converting a (2,)*n bit-tensor view of the
+    planes from physical to canonical bit order. Axis 1 + i of the
+    reshaped (2, 2, ..., 2) array corresponds to column bit n-1-i
+    (row-major reshape: leading axes are high bits)."""
+    n = len(perm)
+    # out axis for logical bit l must read the in axis of physical bit
+    # perm[l]: axes[out_pos] = in_pos with bit b at pos n-1-b (+1 for
+    # the plane axis)
+    axes = [0] + [0] * n
+    for l in range(n):
+        axes[1 + (n - 1 - l)] = 1 + (n - 1 - perm[l])
+    return axes
+
+
+def canonicalize_planes(planes: np.ndarray, perm: Sequence[int]
+                        ) -> np.ndarray:
+    """Reorder (2, 2^n) planes from the physical layout under `perm`
+    (perm[l] = physical position of logical qubit l) into canonical
+    logical order. Identity perm returns the input unchanged."""
+    perm = list(perm)
+    n = len(perm)
+    if perm == list(range(n)):
+        return planes
+    planes = np.asarray(planes)
+    if planes.shape != (2, 1 << n):
+        raise ValueError(
+            f"planes of shape {tuple(planes.shape)} do not match the "
+            f"{n}-position permutation {perm}")
+    view = planes.reshape((2,) + (2,) * n)
+    return np.ascontiguousarray(
+        np.transpose(view, _perm_axes(perm))).reshape(2, 1 << n)
+
+
+def physicalize_planes(planes: np.ndarray, perm: Sequence[int]
+                       ) -> np.ndarray:
+    """Inverse of canonicalize_planes: reorder canonical-order planes
+    into the physical layout under `perm` (exact; round trips bit-
+    identically)."""
+    perm = list(perm)
+    n = len(perm)
+    if perm == list(range(n)):
+        return planes
+    inv = [0] * n
+    for l, p in enumerate(perm):
+        inv[p] = l
+    return canonicalize_planes(planes, inv)
+
+
 def _uses(flat, n):
     """Per logical qubit, the sorted indices of ops where it is a MATRIX
     TARGET — the only role that demands a local slot (controls are free
@@ -208,7 +297,8 @@ def lazy_relabel_ops(flat: Sequence, n: int, local_n: int) -> List:
     def emit_swap(a: int, b: int):
         """Physical swap of positions a, b as an explicit 2q SWAP op."""
         from quest_tpu.circuit import GateOp
-        out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP))
+        out.append(GateOp(kind="matrix", targets=(a, b), operand=SWAP,
+                          meta=INSERTED_META))
         la, lb = inv[a], inv[b]
         perm[la], perm[lb] = b, a
         inv[a], inv[b] = lb, la

@@ -127,6 +127,40 @@ def _state_fingerprint(state: Qureg) -> str:
     return h.hexdigest()[:32]
 
 
+_ELASTIC_FP_FNS: dict = {}
+
+
+def _state_fingerprint_elastic(state: Qureg) -> str:
+    """MESH-INDEPENDENT exact fingerprint of the initial register, for
+    the elastic cursor (docs/RESILIENCE.md §elastic): the float-sum
+    fingerprints above round differently per mesh (a psum of shard
+    partials reassociates), so an elastic resume on a different device
+    or host count could never match them. This one reduces the raw
+    amplitude BITS with modular uint32 arithmetic — a plain bit-sum and
+    an index-weighted bit-sum, both wraparound-exact and fully
+    associative/commutative — so the value is BIT-EQUAL on any mesh
+    that holds the same amplitudes (and across hosts of a gang, where
+    the cursor must agree byte-for-byte)."""
+    amps = state.amps
+    key = (tuple(amps.shape), str(amps.dtype))
+    fn = _ELASTIC_FP_FNS.get(key)
+    if fn is None:
+        def f(a):
+            bits = jax.lax.bitcast_convert_type(a, jnp.uint32).reshape(-1)
+            idx = jax.lax.iota(jnp.uint32, bits.shape[0])
+            s1 = jnp.sum(bits, dtype=jnp.uint32)
+            # +1 gives every position a DISTINCT nonzero weight (mod
+            # 2^32), so moving one amplitude between positions changes
+            # the weighted sum even though the plain sum is unchanged
+            s2 = jnp.sum(bits * (idx + jnp.uint32(1)), dtype=jnp.uint32)
+            return s1, s2
+        fn = _ELASTIC_FP_FNS[key] = jax.jit(f)
+    vals = [int(v) for v in fn(amps)]
+    h = hashlib.sha256()
+    h.update(repr((key, vals)).encode())
+    return h.hexdigest()[:32]
+
+
 _GANG_FP_FNS: dict = {}
 
 
@@ -202,8 +236,20 @@ def _build_steps(circuit, n: int, density: bool, engine: str,
     devices = 1
     if engine == "fused":
         flat = circuit._planned_flat(n, density)
-        items = F.plan(flat, n, bands=PB.plan_bands(n))
-        parts = PB.maybe_sweep(PB.segment_plan(items, n), n)
+        item_attr: list = []
+        items = F.plan(flat, n, bands=PB.plan_bands(n), attr=item_attr)
+        seg_attr: list = []
+        seg_parts = PB.segment_plan(items, n, attr=seg_attr)
+        if PB.sweep_enabled():
+            part_attr: list = []
+            parts = PB.sweep_plan(seg_parts, n, attr=part_attr,
+                                  part_attrs=seg_attr)
+        else:
+            parts, part_attr = list(seg_parts), list(seg_attr)
+        # per-STEP flat-op attribution: parts index items, items index
+        # flat ops
+        step_attr = [frozenset().union(*(item_attr[i] for i in pa))
+                     if pa else frozenset() for pa in part_attr]
         seg_cache: dict = {}
         steps = []
         for part in parts:
@@ -215,11 +261,16 @@ def _build_steps(circuit, n: int, density: bool, engine: str,
                 fn = _xla_part_applier(part, n)
             steps.append(jax.jit(fn))
         layout = "fused"
+        flat_used, exec_items = flat, None
     elif engine == "banded":
-        items = F.plan(circuit._planned_flat(n, density), n)
+        flat = circuit._planned_flat(n, density)
+        item_attr = []
+        items = F.plan(flat, n, attr=item_attr)
         steps = [jax.jit(lambda a, it=it: _apply_banded_items(a, n, (it,)))
                  for it in items]
         layout = "flat"
+        step_attr = item_attr
+        flat_used, exec_items = flat, items
     else:                                   # sharded
         import math
         from quest_tpu.parallel import sharded as S
@@ -229,12 +280,24 @@ def _build_steps(circuit, n: int, density: bool, engine: str,
         cinfo: dict = {}
         flat_r = S.engine_flat(circuit.ops, n, density, local_n,
                                bands=bands, comm_info=cinfo)
+        item_attr = []
+        planned = F.plan(flat_r, n, bands=bands, attr=item_attr)
         items = cinfo.get("items")
         if items is None:
-            items = F.plan(flat_r, n, bands=bands)
+            items = planned
+        elif not _plans_align(items, planned):
+            # the comm planner handed back a plan the deterministic
+            # re-plan does not reproduce — attribution would be
+            # misaligned (a mis-mapped boundary would double-apply an
+            # op on elastic resume), so the elastic boundary map
+            # degrades to "no portable boundaries" (strict resume is
+            # untouched)
+            item_attr = None
         steps = [S.compile_plan_items_sharded((it,), n, mesh)
                  for it in items]
         layout = "sharded"
+        step_attr = item_attr
+        flat_used, exec_items = flat_r, items
         # the relabel-permutation trajectory at every cut: perm_ops[k]
         # is the GateOp stream behind items[:k] that replay_perm
         # fingerprints (band-composed ops expose no op; relabel events
@@ -248,6 +311,9 @@ def _build_steps(circuit, n: int, density: bool, engine: str,
                 acc.append(op)
         perm_ops.append(tuple(acc))
 
+    sched = circuit._planned_flat(n, density)
+    ops_done_at = _boundary_ops_done(flat_used, step_attr, exec_items,
+                                     len(steps))
     info = {
         "engine": engine,
         "n": n,
@@ -259,9 +325,112 @@ def _build_steps(circuit, n: int, density: bool, engine: str,
         "devices": devices,
         "mesh": mesh,
         "perm_ops": perm_ops,
+        # elastic boundary bookkeeping (docs/RESILIENCE.md §elastic):
+        # the SCHEDULED canonical op stream is mesh-independent (the
+        # relabel rewrites only remap/insert), so a cut that consumed
+        # exactly its first m ops can re-enter any other mesh's plan at
+        # a boundary with the same count
+        "sched_sha": _ops_sha(sched),
+        "ops_total": len(sched),
+        "ops_done_at": ops_done_at,
     }
     circuit._compiled[key] = (steps, info)
     return steps, info
+
+
+def _plans_align(items, planned) -> bool:
+    """STRUCTURAL equality of the comm planner's item list and the
+    attribution re-plan — length alone could mask a same-length plan
+    that composes ops differently (under-counting ops_done by one and
+    double-applying a gate on elastic resume). Both lists wrap the SAME
+    flat-stream op objects, so exposed ops compare by identity; band
+    items compare by geometry + the qubit sets that drove composition."""
+    if len(items) != len(planned):
+        return False
+    for a, b in zip(items, planned):
+        if type(a) is not type(b):
+            return False
+        if getattr(a, "op", None) is not getattr(b, "op", None):
+            return False
+        if (getattr(a, "ql", None) != getattr(b, "ql", None)
+                or getattr(a, "w", None) != getattr(b, "w", None)
+                or getattr(a, "nondiag", None) != getattr(b, "nondiag",
+                                                          None)
+                or getattr(a, "touched", None) != getattr(b, "touched",
+                                                          None)):
+            return False
+    return True
+
+
+def _boundary_ops_done(flat_used, step_attr, exec_items,
+                       num_steps: int) -> List[Optional[int]]:
+    """ops_done_at[b] for every step boundary b in [0, num_steps]: the
+    number of CANONICAL (scheduled-stream) ops fully consumed by steps
+    [0, b) when that boundary is PORTABLE — the consumed ops form an
+    exact prefix of the canonical stream, nothing straddles the cut,
+    and every relabel-pass-inserted layout op before it is VISIBLE to
+    the perm replay (an inserted SWAP the planner composed into a band
+    operator moves data replay_perm cannot see — canonicalization would
+    be wrong from that step on) — else None. Boundary 0 is always
+    portable (restart from op 0). `step_attr` is the per-step flat-op
+    attribution (None = attribution unavailable: only boundary 0
+    stays portable)."""
+    from quest_tpu.parallel import relabel as R
+
+    out: List[Optional[int]] = [0]
+    if step_attr is None:
+        return out + [None] * num_steps
+    nflat = len(flat_used)
+    canon_of: List[Optional[int]] = []
+    m = 0
+    for op in flat_used:
+        if R.is_inserted_layout_op(op):
+            canon_of.append(None)
+        else:
+            canon_of.append(m)
+            m += 1
+    first = [num_steps] * nflat
+    last = [-1] * nflat
+    poison = num_steps + 1
+    for k, srcs in enumerate(step_attr):
+        for p in srcs:
+            first[p] = min(first[p], k)
+            last[p] = max(last[p], k)
+            if canon_of[p] is None and exec_items is not None:
+                # layout ops must ride op-exposing items (PassOp for
+                # relabel events, DiagItem never): a band-composed one
+                # is invisible to the perm replay — poison every
+                # boundary past its item
+                if getattr(exec_items[k], "op", None) is not flat_used[p]:
+                    poison = min(poison, k)
+    canon_total = m
+    for b in range(1, num_steps + 1):
+        if b > poison:
+            out.append(None)
+            continue
+        done = 0
+        hi = -1
+        ok = True
+        for p in range(nflat):
+            consumed = last[p] < b and last[p] >= 0
+            touched = first[p] < b
+            if consumed != touched:
+                ok = False          # an op straddles the cut
+                break
+            if consumed and canon_of[p] is not None:
+                done += 1
+                hi = max(hi, canon_of[p])
+        # prefix check: the consumed canonical ops must be exactly
+        # 0..done-1 of the scheduled stream
+        if ok and hi == done - 1:
+            out.append(done)
+        else:
+            out.append(None)
+    # a fully-consumed plan must land on the full canonical count —
+    # anything else means attribution lost ops; degrade loudly-safe
+    if out[num_steps] is not None and out[num_steps] != canon_total:
+        out[num_steps] = None
+    return out
 
 
 def _cut_perm(info: dict, step: int) -> Optional[List[int]]:
@@ -396,8 +565,16 @@ def _latest_valid(directory: str, kind: str, registry=None):
     are skipped LOUDLY (stderr + counter) in favor of older ones —
     never silently consumed. Returns (meta, arrays, cursor, path) or
     None when no valid checkpoint exists (the run restarts from op
-    0)."""
+    0). A GANG-format step (written by a multi-host run) is a typed
+    mesh mismatch, not corruption: restarting from op 0 over a valid
+    multi-host chain would silently discard it."""
     for step, path in reversed(ckpt.step_dirs(directory)):
+        if ckpt.is_gang_step(path):
+            raise DurableError(
+                f"Invalid durable resume: checkpoint {path!r} was "
+                f"written by a multi-host gang run; resume it on the "
+                f"same mesh, or pass elastic=True to re-enter it on "
+                f"this one (docs/RESILIENCE.md §elastic)")
         try:
             meta, arrays = ckpt.load_arrays(path, require=("planes",))
             cursor = meta.get("extra")
@@ -413,8 +590,11 @@ def _latest_valid(directory: str, kind: str, registry=None):
                 raise ckpt.CheckpointError(
                     f"Invalid checkpoint: {path!r} carries cursor cut "
                     f"{cut!r}, directory name says {step}")
-        except (ckpt.CheckpointError, OSError,
+        except (ckpt.CheckpointError, OSError, TypeError, ValueError,
                 faults.InjectedFault) as e:
+            # TypeError/ValueError: a parseable-but-malformed cursor
+            # (e.g. no 'step' field) is corruption, not a crash — the
+            # scan's contract is skip-loudly-to-older
             # InjectedFault: the checkpoint.load site's default error —
             # its documented contract is that the resume chain SKIPS to
             # an older checkpoint, so the injected failure must prove
@@ -438,6 +618,13 @@ def _latest_valid_gang(directory: str, kind: str, registry=None):
     its step uncommitted, and corruption anywhere skips the whole gang
     to the same older cut. Returns (cursor, planes, path) or None."""
     for step, path in reversed(ckpt.step_dirs(directory)):
+        if os.path.exists(os.path.join(path, "qureg_meta.json")):
+            raise DurableError(
+                f"Invalid durable resume: checkpoint {path!r} was "
+                f"written by a single-process run, but this is a "
+                f"multi-host gang resume; resume it on the writing "
+                f"mesh, or pass elastic=True to re-enter it on this "
+                f"one (docs/RESILIENCE.md §elastic)")
         try:
             metas, planes = ckpt.load_step_gang(path, kind_extra=kind)
             cursor = metas[0].get("extra")
@@ -446,8 +633,11 @@ def _latest_valid_gang(directory: str, kind: str, registry=None):
                 raise ckpt.CheckpointError(
                     f"Invalid checkpoint: {path!r} carries cursor cut "
                     f"{cut!r}, directory name says {step}")
-        except (ckpt.CheckpointError, OSError,
+        except (ckpt.CheckpointError, OSError, TypeError, ValueError,
                 faults.InjectedFault) as e:
+            # TypeError/ValueError: a parseable-but-malformed cursor
+            # (e.g. no 'step' field) is corruption, not a crash — the
+            # scan's contract is skip-loudly-to-older
             _counter("durable_corrupt_checkpoints_skipped",
                      registry).inc()
             print(f"[durable] SKIPPING corrupt gang checkpoint "
@@ -455,6 +645,97 @@ def _latest_valid_gang(directory: str, kind: str, registry=None):
                   file=sys.stderr, flush=True)
             continue
         return cursor, planes, path
+    return None
+
+
+def _iter_valid_elastic(directory: str, registry=None):
+    """Format-agnostic scan for ELASTIC resume (docs/RESILIENCE.md
+    §elastic): yields every step checkpoint — plain single-process
+    (canonical or legacy physical layout) or multi-host gang — that
+    loads and digests cleanly, newest first, in CANONICAL LOGICAL ORDER
+    via checkpoint.load_step_elastic. Corrupt/unreadable entries skip
+    loudly to older ones, exactly like the strict scanners; the caller
+    advances past entries the target mesh cannot re-enter. Yields
+    (cursor, canonical_planes, path)."""
+    for step, path in reversed(ckpt.step_dirs(directory)):
+        try:
+            cursor, planes = ckpt.load_step_elastic(path)
+            cut = cursor.get("step")
+            if int(cut) != step:
+                raise ckpt.CheckpointError(
+                    f"Invalid checkpoint: {path!r} carries cursor cut "
+                    f"{cut!r}, directory name says {step}")
+        except (ckpt.CheckpointError, OSError, TypeError, ValueError,
+                faults.InjectedFault) as e:
+            # TypeError/ValueError: a parseable-but-malformed cursor
+            # (e.g. no 'step' field) is corruption, not a crash — the
+            # scan's contract is skip-loudly-to-older
+            _counter("durable_corrupt_checkpoints_skipped",
+                     registry).inc()
+            print(f"[durable] SKIPPING corrupt checkpoint {path!r} "
+                  f"({e}); falling back to the previous one",
+                  file=sys.stderr, flush=True)
+            continue
+        yield cursor, planes, path
+
+
+def _enter_elastic(want, elastic_want, cursor_extra, info, state,
+                   directory: str, registry=None):
+    """Elastic re-entry (docs/RESILIENCE.md §elastic): walk the chain
+    newest->oldest and re-enter the first checkpoint THIS plan can
+    continue. Per checkpoint:
+
+      * a mismatched sched_sha / state_efp / dtype / density / ops_total
+        (or cursor_extra descriptor) raises typed DurableError — elastic
+        never relaxes WHAT is computed, only where;
+      * a pre-elastic cursor (no sched_sha) falls back to the STRICT
+        field validation: on the writing mesh it resumes tolerantly, on
+        a changed mesh it rejects typed (old checkpoints never resume
+        wrong);
+      * a cut this mesh's plan has no matching portable boundary for
+        (ops_done is None, or the target compositions straddle that
+        count) skips LOUDLY to an older checkpoint — op 0 is always
+        portable, so the walk terminates correctly.
+
+    Returns (start_step, layouted_amps, baseline) or None (no usable
+    checkpoint: start from op 0)."""
+    from quest_tpu.parallel import relabel as R
+
+    for cursor, canon, path in _iter_valid_elastic(directory, registry):
+        if "sched_sha" not in cursor:
+            _validate_cursor(cursor, want, path)
+            step = int(cursor["step"])
+            perm = _cut_perm(info, step)
+            _validate_cursor(cursor, {"perm": perm}, path)
+            b = step
+        else:
+            _validate_cursor(cursor, elastic_want, path)
+            if cursor_extra:
+                _validate_cursor(cursor, cursor_extra, path)
+            m = cursor.get("ops_done")
+            b = (info["ops_done_at"].index(m)
+                 if m is not None and m in info["ops_done_at"] else None)
+            if b is None:
+                print(f"[durable] checkpoint {path!r} cut at canonical "
+                      f"op {m!r} has no portable boundary in this "
+                      f"mesh's plan; falling back to an older "
+                      f"checkpoint (docs/RESILIENCE.md §elastic)",
+                      file=sys.stderr, flush=True)
+                continue
+            perm = _cut_perm(info, b)
+        if canon.shape != state.amps.shape:
+            raise DurableError(
+                f"Invalid durable resume: checkpoint {path!r} holds "
+                f"planes of shape {tuple(canon.shape)}, register "
+                f"expects {tuple(state.amps.shape)}")
+        planes = np.asarray(canon).astype(state.real_dtype)
+        if perm:
+            planes = R.physicalize_planes(planes, perm)
+        _counter("durable_resumes", registry).inc()
+        if (cursor.get("devices") != info["devices"]
+                or cursor.get("engine") != info["engine"]):
+            _counter("durable_elastic_resumes", registry).inc()
+        return b, _to_layout(planes, info), cursor.get("baseline")
     return None
 
 
@@ -477,6 +758,7 @@ def _clear_chain(directory: str) -> None:
 def run_durable(circuit, state: Qureg, directory: str, *,
                 every: int = None, engine: str = None, mesh=None,
                 interpret: bool = False, keep: int = None,
+                elastic: Optional[bool] = None,
                 cursor_extra: Optional[dict] = None,
                 registry: Optional[_metrics.Registry] = None) -> Qureg:
     """Apply `circuit` to `state` durably: execute the engine's own
@@ -513,7 +795,23 @@ def run_durable(circuit, state: Qureg, directory: str, *,
     as its fleet_* metrics. `cursor_extra` adds workload-descriptor
     fields (JSON-serializable) to every cursor, VALIDATED at resume
     like the plan fields — quest_tpu.evolution's deep quenches stamp
-    their Trotter steps/order/dt through it (docs/EVOLUTION.md)."""
+    their Trotter steps/order/dt through it (docs/EVOLUTION.md).
+
+    `elastic` (default: the QUEST_DURABLE_ELASTIC knob, off) makes the
+    resume MESH-INDEPENDENT (docs/RESILIENCE.md §elastic): a checkpoint
+    chain written by D devices across H hosts — including a gang chain
+    — re-enters THIS call's mesh (any D'/H', including single-device
+    and single->sharded) by reassembling the planes in canonical
+    logical order, re-verifying every source digest, matching the
+    cursor's canonical op count against this plan's portable step
+    boundaries, and re-deriving the comm plan / relabel permutation for
+    the new mesh. What still rejects typed: a different circuit or
+    scheduled stream (sched_sha), a different initial state (the exact
+    bit-sum state_efp), a different dtype, and cursor_extra mismatches
+    — elastic relaxes only WHERE the run executes, never WHAT it
+    computes. A checkpoint whose cut is not portable to this mesh
+    skips LOUDLY to an older one (op 0 is always portable). Without
+    elastic, a mesh mismatch rejects typed exactly as before."""
     from quest_tpu.env import knob_value
 
     if circuit.num_qubits != state.num_qubits:
@@ -531,6 +829,8 @@ def run_durable(circuit, state: Qureg, directory: str, *,
                                mesh)
     integrity = knob_value("QUEST_INTEGRITY")
     tol = knob_value("QUEST_INTEGRITY_TOL")
+    if elastic is None:
+        elastic = bool(knob_value("QUEST_DURABLE_ELASTIC"))
     # multi-host gang mode: one gang-consistent checkpoint per cursor
     # step (two-phase commit across the mesh's processes — all hosts
     # stamp or none do, checkpoint.save_step_gang), cursor fields
@@ -551,6 +851,17 @@ def run_durable(circuit, state: Qureg, directory: str, *,
         "state_fp": (_state_fingerprint_gang(state) if gang
                      else _state_fingerprint(state)),
     }
+    # mesh-independent cursor fields: every state cursor carries them
+    # (whether or not THIS run is elastic), so any chain can later be
+    # picked up by an elastic resume on different hardware
+    # (docs/RESILIENCE.md §elastic)
+    elastic_want = {
+        "sched_sha": info["sched_sha"],
+        "ops_total": info["ops_total"],
+        "state_efp": _state_fingerprint_elastic(state),
+        "dtype": str(state.real_dtype),
+        "density": density,
+    }
     if cursor_extra:
         # workload-level descriptor fields (e.g. the Trotter
         # steps/order/dt of quest_tpu.evolution's deep quenches): they
@@ -559,7 +870,9 @@ def run_durable(circuit, state: Qureg, directory: str, *,
         # descriptor fails typed instead of splicing prefixes. Values
         # must be JSON-serializable (the checkpoint meta self-digest
         # canonicalizes them).
-        reserved = set(want) | {"kind", "step", "perm", "baseline"}
+        reserved = (set(want) | set(elastic_want)
+                    | {"kind", "step", "perm", "baseline", "layout",
+                       "ops_done"})
         overlap = set(cursor_extra) & reserved
         if overlap:
             raise ValueError(
@@ -567,30 +880,46 @@ def run_durable(circuit, state: Qureg, directory: str, *,
                 f"{sorted(overlap)}")
         want.update(cursor_extra)
     start, baseline = 0, None
-    if gang:
-        found = _latest_valid_gang(directory, "state", registry)
-    else:
-        found = _latest_valid(directory, "state", registry)
-    if found is not None:
-        if gang:
-            cursor, planes, path = found
+    if elastic:
+        resume = _enter_elastic(want, elastic_want, cursor_extra,
+                                info, state, directory, registry)
+        if resume is not None:
+            start, amps, baseline = resume
         else:
-            meta, arrays, cursor, path = found
-            planes = arrays["planes"]
-        _validate_cursor(cursor, want, path)
-        step = int(cursor["step"])
-        _validate_cursor(cursor, {"perm": _cut_perm(info, step)}, path)
-        if planes.shape != state.amps.shape:
-            raise DurableError(
-                f"Invalid durable resume: checkpoint {path!r} holds "
-                f"planes of shape {tuple(planes.shape)}, register "
-                f"expects {tuple(state.amps.shape)}")
-        amps = _to_layout(planes.astype(state.real_dtype), info)
-        start = step
-        baseline = cursor.get("baseline")
-        _counter("durable_resumes", registry).inc()
+            amps = _to_layout(state.amps, info)
     else:
-        amps = _to_layout(state.amps, info)
+        if gang:
+            found = _latest_valid_gang(directory, "state", registry)
+        else:
+            found = _latest_valid(directory, "state", registry)
+        if found is not None:
+            if gang:
+                cursor, planes, path = found
+            else:
+                meta, arrays, cursor, path = found
+                planes = arrays["planes"]
+            _validate_cursor(cursor, want, path)
+            step = int(cursor["step"])
+            perm = _cut_perm(info, step)
+            _validate_cursor(cursor, {"perm": perm}, path)
+            if planes.shape != state.amps.shape:
+                raise DurableError(
+                    f"Invalid durable resume: checkpoint {path!r} holds "
+                    f"planes of shape {tuple(planes.shape)}, register "
+                    f"expects {tuple(state.amps.shape)}")
+            if cursor.get("layout") == "canonical" and perm:
+                # canonical-order checkpoint (the save-side normalizes,
+                # docs/RESILIENCE.md §elastic): re-enter the validated
+                # cut's physical layout — an exact index permutation,
+                # so the strict round trip stays bit-identical
+                from quest_tpu.parallel import relabel as R
+                planes = R.physicalize_planes(np.asarray(planes), perm)
+            amps = _to_layout(planes.astype(state.real_dtype), info)
+            start = step
+            baseline = cursor.get("baseline")
+            _counter("durable_resumes", registry).inc()
+        else:
+            amps = _to_layout(state.amps, info)
     if baseline is None and integrity:
         baseline = _sentinel_values(amps, info)
 
@@ -616,10 +945,19 @@ def run_durable(circuit, state: Qureg, directory: str, *,
             if integrity:
                 _check_integrity(_sentinel_values(amps, info), baseline,
                                  tol, done, registry)
-            cursor = dict(want, kind="state", step=done,
-                          perm=_cut_perm(info, done), baseline=baseline)
+            perm_cut = _cut_perm(info, done)
+            cursor = dict(want, **elastic_want, kind="state", step=done,
+                          perm=perm_cut, baseline=baseline,
+                          ops_done=info["ops_done_at"][done],
+                          layout="physical" if gang else "canonical")
             stamped = True
             if gang:
+                # gang shards stay in the PHYSICAL layout (no host
+                # holds its peers' canonical columns without a
+                # collective); the perm in the digested cursor makes
+                # the checkpoint's meaning writer-independent — the
+                # elastic loader normalizes at reassembly
+                # (checkpoint.load_step_elastic)
                 committed = ckpt.save_step_gang(
                     directory, done,
                     qureg=state.replace_amps(_from_layout(amps, info)),
@@ -633,9 +971,21 @@ def run_durable(circuit, state: Qureg, directory: str, *,
                            or os.path.isdir(ckpt.step_path(directory,
                                                            done)))
             else:
+                # normalize to CANONICAL LOGICAL ORDER before digesting
+                # (docs/RESILIENCE.md §elastic): the shard file's
+                # meaning no longer depends on the writer's relabel
+                # history — an exact index permutation, undone at
+                # strict resume bit-identically
+                planes_np = np.asarray(
+                    jax.device_get(_from_layout(amps, info)))
+                if perm_cut:
+                    from quest_tpu.parallel import relabel as R
+                    planes_np = R.canonicalize_planes(planes_np,
+                                                      perm_cut)
                 ckpt.save_step(directory, done,
-                               qureg=state.replace_amps(
-                                   _from_layout(amps, info)),
+                               qureg=Qureg(amps=planes_np,
+                                           num_qubits=state.num_qubits,
+                                           is_density=state.is_density),
                                extra=cursor, keep=keep)
             if stamped:
                 _counter("durable_checkpoints_saved", registry).inc()

@@ -70,6 +70,14 @@ SITES = (
     "checkpoint.load",      # checkpoint read path (load/load_arrays) —
                             # emulates IO failures; the durable resume
                             # chain must skip to an older checkpoint
+    "checkpoint.load_gang", # gang/elastic reassembly read path
+                            # (checkpoint.load_step_gang — every
+                            # multi-host resume and every elastic
+                            # re-entry of a gang chain): chaos plans
+                            # can fail the reassembly on one host; the
+                            # gang scanner must skip to an older
+                            # committed step on EVERY host (validity is
+                            # a pure function of the shared dir)
     "durable.step",         # durable executor, before each sweep-plan
                             # step (ctx carries the step index)
     "durable.preempt",      # the durable KILL site: same cut points as
@@ -91,6 +99,13 @@ SITES = (
                             # victim is about to shed (ctx: pressure,
                             # priority, evict) — soaks can force the
                             # decision path deterministically
+    "fleet.requeue",        # failover REQUEUE hop: fires as a dead
+                            # replica's ticket is re-submitted to its
+                            # chosen survivor (ctx: replica, target,
+                            # hops, durable) — distinct from
+                            # fleet.failover (the decision point), so
+                            # chaos plans can fail the hop itself, e.g.
+                            # mid-durable-failover
 )
 
 

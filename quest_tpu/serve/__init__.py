@@ -29,6 +29,7 @@ _LAZY = {
     "RejectedError": ("quest_tpu.serve.admission", "RejectedError"),
     "DeadlineExceeded": ("quest_tpu.serve.admission", "DeadlineExceeded"),
     "ShedError": ("quest_tpu.serve.admission", "ShedError"),
+    "DispatchTimeout": ("quest_tpu.serve.admission", "DispatchTimeout"),
     "TenantQuota": ("quest_tpu.serve.admission", "TenantQuota"),
     "TenantQuotaExceeded": ("quest_tpu.serve.admission",
                             "TenantQuotaExceeded"),

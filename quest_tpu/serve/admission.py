@@ -48,6 +48,16 @@ class DeadlineExceeded(QuESTError):
     without occupying a slot in any launch."""
 
 
+class DispatchTimeout(QuESTError):
+    """A serve launch exceeded the dispatch watchdog's deadline
+    (QUEST_DISPATCH_TIMEOUT_S): the batch's futures fail with this, the
+    program's breaker records the failure, and the supervisor REPLACES
+    the wedged worker thread so the engine keeps serving instead of
+    drain() hanging forever (docs/RESILIENCE.md §watchdog). The launch
+    outcome is unknown — like a crash at dispatch, retrying could
+    double-serve, so only durable requests requeue."""
+
+
 class TenantQuotaExceeded(RejectedError):
     """The submitting tenant already has its quota's worth of pending
     requests in the fleet (QUEST_SERVE_TENANT_QUOTA): the request was

@@ -71,7 +71,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -82,7 +82,8 @@ from quest_tpu.resilience.breaker import (CLOSED, HALF_OPEN, OPEN,
 from quest_tpu.resilience.supervisor import Supervisor
 from quest_tpu.serve import metrics as M
 from quest_tpu.serve.admission import (AdmissionController,
-                                       DeadlineExceeded, RejectedError)
+                                       DeadlineExceeded, DispatchTimeout,
+                                       RejectedError)
 
 # the full degradation ladder, most capable first (the same engine
 # names bench.py's fallback ladder uses): 'fused' is whatever the
@@ -181,7 +182,10 @@ class ServeEngine:
                  breaker_threshold: Optional[int] = None,
                  breaker_cooldown_s: float = 0.5,
                  ladder: Optional[Tuple[str, ...]] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 dispatch_timeout_s: Optional[float] = None,
+                 durable_mesh=None,
+                 durable_elastic: Optional[bool] = None):
         from quest_tpu.env import knob_value
         if max_wait_ms is None:
             max_wait_ms = knob_value("QUEST_SERVE_MAX_WAIT_MS")
@@ -193,6 +197,11 @@ class ServeEngine:
             restart_max = knob_value("QUEST_SERVE_RESTART_MAX")
         if breaker_threshold is None:
             breaker_threshold = knob_value("QUEST_SERVE_BREAKER_THRESHOLD")
+        if dispatch_timeout_s is None:
+            dispatch_timeout_s = knob_value("QUEST_DISPATCH_TIMEOUT_S")
+        if dispatch_timeout_s < 0:
+            raise ValueError(
+                f"dispatch_timeout_s must be >= 0, got {dispatch_timeout_s}")
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         if max_batch < 1:
@@ -241,10 +250,35 @@ class ServeEngine:
         # so supervision can requeue/fail instead of stranding futures
         self._active: List[Tuple[_Queue, List[_Request]]] = []
         self._active_failed: List[Tuple[_Request, BaseException]] = []
+        # dispatch watchdog (docs/RESILIENCE.md §watchdog): the worker
+        # GENERATION counter supersedes a wedged worker — a stale
+        # thread that eventually unsticks sees the bumped generation
+        # and exits without touching recovered state
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
+        self.durable_mesh = durable_mesh
+        self.durable_elastic = durable_elastic
+        self._worker_gen = 0
+        self._watch: Dict[int, Tuple[float, int, _Queue]] = {}
+        self._watch_seq = 0
+        self._watchdog: Optional[threading.Thread] = None
         _F.install_from_env()             # QUEST_FAULT_PLAN soak arming
-        self._worker = threading.Thread(target=self._worker_main,
-                                        name="quest-serve-worker",
-                                        daemon=True)
+        with self._cond:
+            self._spawn_worker_locked()
+            if self.dispatch_timeout_s > 0:
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_main,
+                    name="quest-serve-watchdog", daemon=True)
+                self._watchdog.start()
+
+    def _spawn_worker_locked(self) -> None:
+        """Start a fresh worker thread under a NEW generation (callers
+        hold the lock). The previous generation — if any thread still
+        runs under it — is thereby superseded: its every state mutation
+        is generation-guarded."""
+        self._worker_gen += 1
+        self._worker = threading.Thread(
+            target=self._worker_main, args=(self._worker_gen,),
+            name="quest-serve-worker", daemon=True)
         self._worker.start()
 
     # -- client API --------------------------------------------------------
@@ -565,7 +599,13 @@ class ServeEngine:
                       counter: Optional[str] = "serve_requests_failed"
                       ) -> None:
         """Resolve one future with a typed error, tolerating requests
-        that were already started (requeued survivors) or cancelled."""
+        that were already started (requeued survivors) or cancelled.
+        The done()-then-set pair is NOT atomic and two threads may race
+        it (the dispatch watchdog failing a batch at the instant its
+        superseded worker unsticks and completes the same future) — the
+        loser's InvalidStateError means the future was resolved either
+        way, so it is swallowed, never allowed to kill the watchdog
+        before it spawns the replacement worker."""
         if r.future.done():
             return
         if not r.started:
@@ -573,7 +613,10 @@ class ServeEngine:
                 self.registry.counter("serve_requests_cancelled").inc()
                 return
             r.started = True
-        r.future.set_exception(exc)
+        try:
+            r.future.set_exception(exc)
+        except InvalidStateError:
+            return
         if counter:
             self.registry.counter(counter).inc()
 
@@ -634,54 +677,153 @@ class ServeEngine:
 
     # -- worker ------------------------------------------------------------
 
-    def _worker_main(self) -> None:
+    def _worker_main(self, my_gen: int) -> None:
         """Supervised outer loop: `_run` only returns on a clean stop;
         anything escaping it is a worker crash, restarted with backoff
         until the budget (`QUEST_SERVE_RESTART_MAX`) is exhausted —
         then the engine transitions to FAILED, resolving EVERY pending
-        future with a typed error (docs/RESILIENCE.md)."""
+        future with a typed error (docs/RESILIENCE.md). A thread whose
+        generation was superseded (the dispatch watchdog replaced it
+        while it was wedged) exits silently — the watchdog already ran
+        the recovery."""
         while True:
             try:
-                self._run()
+                self._run(my_gen)
                 return
             except BaseException as e:    # noqa: BLE001 - supervised
-                delay = self._supervisor.next_backoff()
                 with self._cond:
-                    doomed = self._recover_locked(e)
-                    evacuated = ([] if delay is not None
-                                 else self._evacuate_locked())
-                    if delay is None:
-                        self._failure_cause = e
-                        self._state = "failed"
-                # futures complete OUTSIDE the lock (user callbacks
-                # must not be able to deadlock against submit). Popped
-                # expiries recovered here keep their normal tally —
-                # only requests the crash itself doomed count as failed
-                for r, exc in doomed:
-                    self._fail_request(
-                        r, exc,
-                        counter=("serve_requests_expired"
-                                 if isinstance(exc, DeadlineExceeded)
-                                 else "serve_requests_failed"))
-                if delay is None:
-                    fail = RejectedError(
-                        f"Invalid operation: ServeEngine FAILED — its "
-                        f"worker crashed "
-                        f"{self._supervisor.total_restarts + 1} time(s) "
-                        f"and the restart budget is exhausted; last "
-                        f"cause: {e!r} (docs/RESILIENCE.md).")
-                    fail.__cause__ = e
-                    for r in evacuated:
-                        self._fail_request(r, fail)
-                with self._cond:
-                    self._cond.notify_all()
-                if delay is None:
+                    if my_gen != self._worker_gen:
+                        return
+                if not self._handle_worker_failure(e):
                     return
-                self.registry.counter("serve_worker_restarts").inc()
-                if delay:
-                    time.sleep(delay)
+                with self._cond:
+                    if my_gen != self._worker_gen:
+                        return        # superseded during the backoff
 
-    def _run(self) -> None:
+    def _handle_worker_failure(self, e: BaseException) -> bool:
+        """Worker-crash bookkeeping, shared by the in-thread supervisor
+        loop and the dispatch watchdog: requeue/fail in-flight work,
+        FAILED transition when the restart budget is exhausted. Returns
+        True when the worker should keep running (the backoff was
+        slept), False on FAILED."""
+        delay = self._supervisor.next_backoff()
+        with self._cond:
+            doomed = self._recover_locked(e)
+            evacuated = ([] if delay is not None
+                         else self._evacuate_locked())
+            if delay is None:
+                self._failure_cause = e
+                self._state = "failed"
+        # futures complete OUTSIDE the lock (user callbacks
+        # must not be able to deadlock against submit). Popped
+        # expiries recovered here keep their normal tally —
+        # only requests the crash itself doomed count as failed
+        for r, exc in doomed:
+            self._fail_request(
+                r, exc,
+                counter=("serve_requests_expired"
+                         if isinstance(exc, DeadlineExceeded)
+                         else "serve_requests_failed"))
+        if delay is None:
+            fail = RejectedError(
+                f"Invalid operation: ServeEngine FAILED — its "
+                f"worker crashed "
+                f"{self._supervisor.total_restarts + 1} time(s) "
+                f"and the restart budget is exhausted; last "
+                f"cause: {e!r} (docs/RESILIENCE.md).")
+            fail.__cause__ = e
+            for r in evacuated:
+                self._fail_request(r, fail)
+        with self._cond:
+            self._cond.notify_all()
+        if delay is None:
+            return False
+        self.registry.counter("serve_worker_restarts").inc()
+        if delay:
+            time.sleep(delay)
+        return True
+
+    # -- dispatch watchdog (docs/RESILIENCE.md §watchdog) -------------------
+
+    def _watch_arm(self, q: _Queue) -> Optional[int]:
+        """Register the imminent dispatch with the watchdog. Durable
+        jobs are exempt: they are legitimately long (the checkpoint
+        cadence is their progress signal) and their own retry ladder
+        already bounds failures."""
+        if self.dispatch_timeout_s <= 0 or q.kind == "durable":
+            return None
+        with self._cond:
+            self._watch_seq += 1
+            token = self._watch_seq
+            self._watch[token] = (
+                time.monotonic() + self.dispatch_timeout_s,
+                self._worker_gen, q)
+            self._cond.notify_all()
+        return token
+
+    def _watch_disarm(self, token: Optional[int]) -> None:
+        if token is not None:
+            with self._cond:
+                self._watch.pop(token, None)
+
+    def _watchdog_main(self) -> None:
+        """Monitor thread: when an armed dispatch outlives its
+        deadline, the worker is WEDGED (stuck inside a launch it will
+        never return from — the failure class the bounded-drain hang
+        detector in the tests catches but production could not). The
+        watchdog supersedes its generation, fails the batch typed
+        DispatchTimeout through the normal crash recovery (durable
+        requests requeue, dispatched ones fail — no double-serve),
+        records a failure on the program's breaker, and spawns a
+        replacement worker under the supervisor's restart budget — so
+        drain() completes instead of hanging forever."""
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                now = time.monotonic()
+                fire = None
+                due = None
+                for token, (deadline, gen, q) in self._watch.items():
+                    if gen != self._worker_gen:
+                        continue      # armed by an already-dead worker
+                    if now >= deadline:
+                        fire = (token, q)
+                        break
+                    t = deadline - now
+                    due = t if due is None else min(due, t)
+                if fire is None:
+                    self._cond.wait(due if due is not None else 0.5)
+                    continue
+                token, q = fire
+                del self._watch[token]
+                # supersede the wedged worker FIRST: whenever it
+                # unsticks, every one of its state mutations is
+                # generation-guarded away
+                self._worker_gen += 1
+                new_gen = self._worker_gen
+            e = DispatchTimeout(
+                f"Invalid operation: serve launch exceeded the "
+                f"dispatch watchdog deadline "
+                f"(QUEST_DISPATCH_TIMEOUT_S={self.dispatch_timeout_s}) "
+                f"— the worker was wedged and has been replaced; the "
+                f"launch outcome is unknown (docs/RESILIENCE.md "
+                f"§watchdog).")
+            self.registry.counter("serve_dispatch_timeouts").inc()
+            # the wedge counts toward the program's breaker: a program
+            # that reliably wedges must step down the degradation
+            # ladder, not wedge every replacement worker. Safe without
+            # the worker lock discipline: the owning worker is stuck
+            # inside the launch, and the replacement is not yet spawned.
+            br = self._breakers.get(q.key)
+            if br is not None:
+                br.record_failure()
+            if self._handle_worker_failure(e):
+                with self._cond:
+                    if new_gen == self._worker_gen and not self._stop:
+                        self._spawn_worker_locked()
+
+    def _run(self, my_gen: int) -> None:
         while True:
             if _F.ACTIVE:
                 self._fault("serve.worker_loop", phase="idle")
@@ -690,7 +832,7 @@ class ServeEngine:
             cancelled: List[_Request] = []
             with self._cond:
                 while True:
-                    if self._stop:
+                    if self._stop or my_gen != self._worker_gen:
                         return
                     batches, failed, cancelled = self._pop_ready_locked()
                     if batches or failed or cancelled:
@@ -720,12 +862,25 @@ class ServeEngine:
                 # raises ONLY for an exhausted durable resume loop
                 # (deliberate escalation into the supervised restart);
                 # every other failure is split/isolated/typed inside
-                self._dispatch(q, reqs)
+                token = self._watch_arm(q)
+                try:
+                    self._dispatch(q, reqs)
+                finally:
+                    self._watch_disarm(token)
                 with self._cond:
+                    if my_gen != self._worker_gen:
+                        # superseded mid-dispatch by the watchdog: the
+                        # recovery already reset the in-flight ledger —
+                        # touching it again would corrupt the
+                        # replacement worker's accounting
+                        return
                     self._inflight -= 1
                     self._active.remove((q, reqs))
                     self._cond.notify_all()
             if batches:
+                with self._cond:
+                    if my_gen != self._worker_gen:
+                        return
                 # a fully processed pop cycle is the health signal that
                 # refills the restart budget (crash-LOOP bound, not a
                 # lifetime quota)
@@ -832,7 +987,18 @@ class ServeEngine:
             qw.observe(t_pop - r.submit_t)
 
     def _finish_one(self, r: _Request, result) -> None:
-        r.future.set_result(result)
+        if r.future.done():
+            # a watchdog-superseded worker unsticking late: the future
+            # was already failed typed DispatchTimeout — the stale
+            # result is discarded (the single-engine analogue of the
+            # fleet's discarded post-cancel results)
+            return
+        try:
+            r.future.set_result(result)
+        except InvalidStateError:
+            # lost the done()-then-set race against the watchdog's
+            # typed failure — same discard as the done() early-out
+            return
         self._m_served.inc()
         self._m_e2e.observe(time.monotonic() - r.submit_t)
 
@@ -901,8 +1067,16 @@ class ServeEngine:
                     reg = Qureg(amps=jnp.asarray(r.state),
                                 num_qubits=q.circuit.num_qubits,
                                 is_density=q.density)
+                    # durable_mesh runs the job sharded over this
+                    # replica's mesh; durable_elastic lets it RESUME a
+                    # chain another (differently-sized) replica left
+                    # behind — the fleet failover story for
+                    # heterogeneous survivors (docs/RESILIENCE.md
+                    # §elastic)
                     out = run_durable(q.circuit, reg, r.durable_dir,
                                       every=r.durable_every,
+                                      mesh=self.durable_mesh,
+                                      elastic=self.durable_elastic,
                                       interpret=self.interpret,
                                       registry=self.registry)
                     self._record_batch([r], 1.0, t_pop)
@@ -1044,6 +1218,7 @@ class ServeEngine:
         import jax
 
         t_pop = time.monotonic()
+        gen0 = self._worker_gen     # breaker-success guard (watchdog)
         n = (q.circuit.num_qubits * 2 if q.density
              else q.circuit.num_qubits)
         fn, primary, br = self._resolve_program(
@@ -1067,7 +1242,11 @@ class ServeEngine:
         if _F.ACTIVE:
             self._fault("serve.dispatch", reqs=reqs)
         out_dev = jax.block_until_ready(fn(batch))
-        if primary:
+        if primary and gen0 == self._worker_gen:
+            # generation-guarded like every other stale-worker mutation:
+            # a slow-but-not-stuck launch that unsticks AFTER the
+            # watchdog fired must not erase the failure it just
+            # recorded on this program's breaker
             br.record_success()
         # AT MOST one device->host materialization for the whole batch:
         # slicing the jax array per request would dispatch an XLA
@@ -1112,6 +1291,7 @@ class ServeEngine:
         import jax.numpy as jnp
 
         t_pop = time.monotonic()
+        gen0 = self._worker_gen     # breaker-success guard (watchdog)
         n = q.circuit.num_qubits
         total = sum(r.shots for r in reqs)
         # the per-request key chains match run_batched exactly: shot i
@@ -1217,7 +1397,8 @@ class ServeEngine:
                     dead.add(i)
                     self._fail_request(r, e)
             launches += 1
-        if primary:
+        if primary and gen0 == self._worker_gen:
+            # the apply path's stale-worker breaker guard, same rationale
             br.record_success()
         self.registry.counter("serve_batches_dispatched").inc(
             launches - 1)                 # _record_batch adds the 1st

@@ -165,8 +165,20 @@ class ServeFleet:
         self.tenant_quota = TenantQuota(tenant_quota)
         self.shed_threshold = float(shed_threshold)
         self.priorities = int(priorities)
+        # durable_mesh may be a PER-REPLICA list (heterogeneous fleet:
+        # a big-mesh replica runs deep jobs sharded, a small survivor
+        # resumes them elastically after failover — docs/RESILIENCE.md
+        # §elastic); a single mesh (or None) applies to every replica
+        meshes = engine_kw.pop("durable_mesh", None)
+        if not isinstance(meshes, (list, tuple)):
+            meshes = [meshes] * int(replicas)
+        if len(meshes) != int(replicas):
+            raise ValueError(
+                f"durable_mesh list has {len(meshes)} entries for "
+                f"{replicas} replicas")
         self._engines: List[ServeEngine] = [
-            ServeEngine(registry=self.registry, name=f"r{i}", **engine_kw)
+            ServeEngine(registry=self.registry, name=f"r{i}",
+                        durable_mesh=meshes[i], **engine_kw)
             for i in range(int(replicas))]
         # the requeue bound: a request may hop at most once past every
         # replica and once more (the survivor it lands on may fail
@@ -546,6 +558,20 @@ class ServeFleet:
             try:
                 _F.check("fleet.failover", replica=ticket.replica,
                          target=target)
+            except BaseException as e:  # noqa: BLE001 - typed resolve
+                self.registry.counter("serve_faults_injected").inc()
+                self._resolve(ticket, exc=e)
+                return
+        if _F.ACTIVE:
+            # the requeue site proper (vs fleet.failover, the decision
+            # point above): fires as the ticket is RE-SUBMITTED to its
+            # survivor, so chaos plans can fail the requeue hop itself
+            # — e.g. while a durable chain waits on disk — without
+            # touching first-time routing (docs/RESILIENCE.md)
+            try:
+                _F.check("fleet.requeue", replica=ticket.replica,
+                         target=target, hops=ticket.requeues,
+                         durable=ticket.kind == "durable")
             except BaseException as e:  # noqa: BLE001 - typed resolve
                 self.registry.counter("serve_faults_injected").inc()
                 self._resolve(ticket, exc=e)

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""CI gate for elastic durable resume + the dispatch watchdog
+(docs/RESILIENCE.md §elastic / §watchdog): fails if
+
+  * a 2dev-sharded chain preempted mid-run does NOT resume on 1 device
+    to the exact native 1-device amplitudes (sha256 — the elastic
+    contract on the mesh-portable circuit is BIT identity), or the
+    1dev -> 2dev direction regresses;
+  * digest re-verification on reshard breaks: a corrupted newest
+    checkpoint must be SKIPPED (loudly, counted) in favor of the older
+    one, still landing bit-identical — never consumed;
+  * a mesh mismatch without elastic=True stops rejecting typed
+    DurableError (elastic must stay opt-in);
+  * the dispatch watchdog does not fail a wedged launch with typed
+    DispatchTimeout within 2x QUEST_DISPATCH_TIMEOUT_S, or the engine
+    cannot serve afterwards (the wedged worker must be REPLACED, not
+    merely timed out).
+
+The committed budgets live HERE; the per-path pins live in
+tests/test_elastic.py — a change that moves either must update both,
+consciously.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
+# the mesh-portable circuit's discipline (bench._build_elastic_circuit):
+# the scheduler's pooling re-merges its isolated rotations
+os.environ["QUEST_SCHEDULE"] = "0"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WATCHDOG_S = 0.5           # deadline under test; gate bound is 2x + slack
+
+
+def _sha(arr) -> str:
+    import numpy as np
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def main() -> int:
+    import numpy as np
+    import jax
+
+    import bench
+    import quest_tpu as qt
+    from quest_tpu import checkpoint as ckpt
+    from quest_tpu.parallel import make_amp_mesh, shard_qureg
+    from quest_tpu.resilience import (DurableError, FaultPlan, faults,
+                                      run_durable)
+    from quest_tpu.serve import metrics
+
+    n = 10
+    c = bench._build_elastic_circuit(n)
+    mesh = make_amp_mesh(2)
+
+    def sv():
+        base = np.zeros((2, 1 << n), dtype=np.float32)
+        base[0, 0] = 1.0
+        return qt.Qureg(amps=jax.numpy.asarray(base), num_qubits=n,
+                        is_density=False)
+
+    def amps(q):
+        return np.asarray(jax.device_get(q.amps))
+
+    def preempted(runner, after):
+        plan = FaultPlan().inject("durable.preempt", after_n=after,
+                                  times=1)
+        with faults.active(plan):
+            try:
+                runner()
+            except faults.InjectedFault:
+                return True
+        return False
+
+    rec = {}
+    ok = True
+    with tempfile.TemporaryDirectory() as root:
+        native1 = amps(run_durable(c, sv(), os.path.join(root, "r1"),
+                                   every=3, engine="banded"))
+        native2 = amps(run_durable(c, shard_qureg(sv(), mesh),
+                                   os.path.join(root, "r2"), every=3,
+                                   mesh=mesh))
+
+        # -- 2dev -> 1dev ---------------------------------------------------
+        d = os.path.join(root, "a")
+        fired = preempted(
+            lambda: run_durable(c, shard_qureg(sv(), mesh), d, every=3,
+                                mesh=mesh), after=5)
+        rec["elastic_preempt_fired"] = fired
+        rec["elastic_stamped_before_kill"] = bool(ckpt.step_dirs(d))
+        # without elastic: typed reject (never a silent restart)
+        try:
+            run_durable(c, sv(), d, every=3, engine="banded")
+            rec["elastic_strict_rejects"] = False
+        except DurableError:
+            rec["elastic_strict_rejects"] = True
+        out = run_durable(c, sv(), d, every=3, engine="banded",
+                          elastic=True)
+        rec["elastic_2to1_bitexact"] = _sha(amps(out)) == _sha(native1)
+        rec["elastic_chain_consumed"] = ckpt.step_dirs(d) == []
+
+        # -- 1dev -> 2dev ---------------------------------------------------
+        d = os.path.join(root, "b")
+        preempted(lambda: run_durable(c, sv(), d, every=3,
+                                      engine="banded"), after=5)
+        out = run_durable(c, shard_qureg(sv(), mesh), d, every=3,
+                          mesh=mesh, elastic=True)
+        rec["elastic_1to2_bitexact"] = _sha(amps(out)) == _sha(native2)
+
+        # -- digest re-verification on reshard ------------------------------
+        d = os.path.join(root, "c")
+        c4 = bench._build_elastic_circuit(n, layers=4)
+        native_c4 = amps(run_durable(c4, sv(), os.path.join(root, "rc"),
+                                     every=2, engine="banded"))
+        preempted(lambda: run_durable(c4, shard_qureg(sv(), mesh), d,
+                                      every=2, mesh=mesh, keep=3),
+                  after=9)
+        dirs = ckpt.step_dirs(d)
+        rec["elastic_fallback_available"] = len(dirs) >= 2
+        path = os.path.join(dirs[-1][1], "amps.npz")
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        reg = metrics.Registry()
+        out = run_durable(c4, sv(), d, every=2, engine="banded",
+                          elastic=True, registry=reg)
+        rec["elastic_corrupt_skipped"] = (
+            reg.counter("durable_corrupt_checkpoints_skipped").value >= 1)
+        rec["elastic_reshard_after_corrupt_bitexact"] = (
+            _sha(amps(out)) == _sha(native_c4))
+
+    # -- watchdog -----------------------------------------------------------
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.serve.admission import DispatchTimeout
+    from quest_tpu.serve.engine import ServeEngine
+
+    cw = Circuit(4).h(0).cnot(0, 1)
+    state = np.zeros((2, 16), dtype=np.float32)
+    state[0, 0] = 1.0
+    reg = metrics.Registry()
+    with ServeEngine(max_wait_ms=1, registry=reg, backoff_base_s=0.0,
+                     dispatch_timeout_s=WATCHDOG_S) as eng:
+        eng.submit(cw, state=state).result(timeout=300)   # warm compile
+        orig = eng._apply_program
+
+        def wedged(q, b, rung):
+            fn = orig(q, b, rung)
+
+            def run(batch):
+                time.sleep(30.0)
+                return fn(batch)
+
+            run.bucket = fn.bucket
+            return run
+
+        eng._apply_program = wedged
+        t0 = time.monotonic()
+        fut = eng.submit(cw, state=state)
+        try:
+            fut.result(timeout=10.0)
+            rec["watchdog_fired_typed"] = False
+        except DispatchTimeout:
+            rec["watchdog_fired_typed"] = True
+        except Exception:
+            rec["watchdog_fired_typed"] = False
+        dt = time.monotonic() - t0
+        rec["watchdog_latency_s"] = round(dt, 3)
+        rec["watchdog_within_2x"] = dt <= 2 * WATCHDOG_S + 0.25
+        eng._apply_program = orig
+        out = eng.submit(cw, state=state).result(timeout=300)
+        rec["watchdog_engine_recovered"] = (
+            np.asarray(out).shape == (2, 16))
+        eng.drain(timeout_s=30.0)
+    rec["watchdog_timeouts_counted"] = (
+        reg.snapshot()["counters"].get("serve_dispatch_timeouts", 0) >= 1)
+
+    print(json.dumps(rec))
+    checks = {
+        "elastic_preempt_fired": "the seeded preempt never fired — the "
+                                 "scenario no longer exercises resume",
+        "elastic_stamped_before_kill": "the kill landed before the "
+                                       "first stamp — hollow restart",
+        "elastic_strict_rejects": "mesh mismatch without elastic=True "
+                                  "no longer rejects typed",
+        "elastic_2to1_bitexact": "2dev->1dev elastic resume is NOT "
+                                 "bit-identical to the native run",
+        "elastic_chain_consumed": "completed elastic run left its chain",
+        "elastic_1to2_bitexact": "1dev->2dev elastic resume is NOT "
+                                 "bit-identical to the native run",
+        "elastic_fallback_available": "scenario lost its older "
+                                      "checkpoint — nothing to verify",
+        "elastic_corrupt_skipped": "the corrupted checkpoint was not "
+                                   "skipped (digest re-verification "
+                                   "broken)",
+        "elastic_reshard_after_corrupt_bitexact": "resume past the "
+                                                  "corrupt checkpoint "
+                                                  "diverged",
+        "watchdog_fired_typed": "the wedged launch did not fail typed "
+                                "DispatchTimeout",
+        "watchdog_within_2x": "the watchdog took more than 2x the "
+                              "deadline to fire",
+        "watchdog_engine_recovered": "the engine could not serve after "
+                                     "the wedge — worker not replaced",
+        "watchdog_timeouts_counted": "serve_dispatch_timeouts metric "
+                                     "not advanced",
+    }
+    for key, msg in checks.items():
+        if not rec.get(key):
+            print(f"REGRESSION: {msg}", file=sys.stderr)
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -688,7 +688,8 @@ def test_empty_plan_keeps_fleet_sites_zero_cost(compile_auditor):
         aud.assert_no_retrace("warmed fleet stream, empty fault plan")
         armed = FaultPlan()
         for site in ("fleet.route", "fleet.failover", "fleet.shed",
-                     "serve.dispatch"):
+                     "fleet.requeue", "serve.dispatch",
+                     "checkpoint.load_gang"):
             armed.inject(site, after_n=10 ** 9)
         with faults.active(armed):
             assert faults.ACTIVE

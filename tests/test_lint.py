@@ -676,6 +676,61 @@ def test_durable_knobs_registered_with_loud_parsers():
     assert KNOBS["QUEST_CHECKPOINT_KEEP"].default == 2
 
 
+def test_elastic_knob_registry_coverage(tmp_path):
+    """QUEST_DURABLE_ELASTIC / QUEST_DISPATCH_TIMEOUT_S coverage of the
+    registry rules (ISSUE 15): both RUNTIME scope — read host-side at
+    run_durable entry / ServeEngine construction, never inside a
+    compiled path — so a registry read off-jit is clean, the same read
+    on a jit-reachable path fires QL001, and a direct os.environ read
+    fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        def configure_elastic():
+            a = knob_value("QUEST_DURABLE_ELASTIC")
+            b = knob_value("QUEST_DISPATCH_TIMEOUT_S")
+            return a, b
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_DURABLE_ELASTIC"):
+                return amps * 2
+            return amps
+
+        def bypass():
+            return os.environ.get("QUEST_DISPATCH_TIMEOUT_S")
+    """, name="elasticknobs.py")
+    assert not [v for v in vs if v.line in (7, 8)], vs
+    q1 = [v for v in vs if v.rule == "QL001"]
+    assert len(q1) == 1 and q1[0].line == 13, vs
+    assert "scope='runtime'" in q1[0].message, q1
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and q4[0].line == 18, vs
+    assert "bypasses" in q4[0].message, q4
+
+
+def test_elastic_knobs_registered_with_loud_parsers():
+    """The elastic/watchdog knobs are registry-backed with malformed
+    samples that REJECT loudly (docs/CONFIG.md parity rides
+    test_docs.py), and their parsers enforce the documented ranges."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_DURABLE_ELASTIC", "QUEST_DISPATCH_TIMEOUT_S"):
+        k = KNOBS[name]
+        assert k.scope == "runtime" and k.layer == "serve", k
+        assert k.malformed is not None
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    assert KNOBS["QUEST_DURABLE_ELASTIC"].default is False
+    assert KNOBS["QUEST_DURABLE_ELASTIC"].parse("1") is True
+    assert KNOBS["QUEST_DISPATCH_TIMEOUT_S"].default == 0.0
+    assert KNOBS["QUEST_DISPATCH_TIMEOUT_S"].parse("2.5") == 2.5
+    assert KNOBS["QUEST_DISPATCH_TIMEOUT_S"].parse("0") == 0.0
+    with pytest.raises(ValueError):
+        KNOBS["QUEST_DISPATCH_TIMEOUT_S"].parse("-0.5")
+
+
 def test_fleet_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_{REPLICAS,TENANT_QUOTA,SHED_THRESHOLD,PRIORITIES}
     coverage of the registry rules (ISSUE 12): all four are RUNTIME
