@@ -692,6 +692,11 @@ HEADLINE_JSON_KEYS = frozenset({
     "traj_metric", "traj_value", "traj_unit", "traj_compile_s", "batch",
     "states_per_sweep", "traj_hbm_sweeps", "traj_channels",
     "traj_baseline_value", "traj_baseline_note", "traj_speedup",
+    "plan_metric", "plan_value", "plan_unit", "plan_engine",
+    "plan_incumbent", "plan_candidates", "plan_search_ms",
+    "plan_warm_ms", "plan_cache_cold", "plan_cache_warm",
+    "plan_chosen_ms", "plan_forced_pergate_ms", "plan_forced_banded_ms",
+    "plan_forced_fused_ms",
 })
 
 
@@ -1540,6 +1545,108 @@ def evolution_main():
         raise SystemExit(1)
 
 
+def _measure_autotune(n: int, reps: int = 3):
+    """The plan-autotuner scenario (docs/PLANNING.md): chooser-vs-
+    forced-engine throughput spread on the headline circuit, the plan
+    search's wall time, and the persistent cache's cold/warm hit
+    profile — the numbers that justify (or indict) letting the priced
+    chooser route dispatch. Runs in a throwaway plan-cache directory so
+    the cold/warm split is THIS process's, not an earlier run's."""
+    import tempfile
+
+    from quest_tpu import plan as P
+    from quest_tpu.ops import pallas_band as PB
+    from quest_tpu.state import basis_planes
+
+    c = _build_circuit(n)
+    rec = {"plan_metric": f"plan autotune spread ({n}q headline)",
+           "plan_unit": "x (worst forced engine / chosen)"}
+    with tempfile.TemporaryDirectory() as d:
+        old = os.environ.get("QUEST_PLAN_CACHE_DIR")
+        os.environ["QUEST_PLAN_CACHE_DIR"] = d
+        P.reset_cache_stats()
+        try:
+            t0 = time.perf_counter()
+            plan = P.autotune(c)
+            rec["plan_search_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            cold = P.cache_stats()
+            t0 = time.perf_counter()
+            P.autotune(c)
+            rec["plan_warm_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            warm = P.cache_stats()
+        finally:
+            if old is None:
+                os.environ.pop("QUEST_PLAN_CACHE_DIR", None)
+            else:
+                os.environ["QUEST_PLAN_CACHE_DIR"] = old
+    rec.update({
+        "plan_engine": plan.engine,
+        "plan_incumbent": plan.incumbent,
+        "plan_candidates": len(plan.candidates),
+        "plan_cache_cold": cold["searches"],
+        "plan_cache_warm": warm["hits"],
+    })
+
+    def time_engine(fn):
+        amps = basis_planes(0, n=n, rdt=np.float32)
+        amps = fn(amps)                       # compile + warm
+        _sync(amps)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            amps = fn(amps)
+        _sync(amps)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    forced = {"pergate": c.compiled(n, False, donate=True),
+              "banded": c.compiled_banded(n, False, donate=True)}
+    if PB.usable(n):
+        fused = c.compiled_fused(n, False, donate=True)
+        # the fused program runs on the banked (2, rows, LANES) layout
+        forced["fused"] = (lambda a: fused(
+            a.reshape(2, -1, PB.LANES)).reshape(2, -1))
+    ms = {}
+    for name, fn in forced.items():
+        try:
+            ms[name] = time_engine(fn)
+        except Exception:
+            _log(f"autotune scenario: forced {name} failed\n"
+                 f"{traceback.format_exc()}")
+    for name, v in ms.items():
+        rec[f"plan_forced_{name}_ms"] = round(v, 3)
+    chosen_ms = ms.get(plan.engine)
+    if chosen_ms is not None and ms:
+        rec["plan_chosen_ms"] = round(chosen_ms, 3)
+        rec["plan_value"] = round(max(ms.values()) / chosen_ms, 2)
+    return rec
+
+
+def autotune_main():
+    """`python bench.py autotune [n]` — the plan-autotuner scenario
+    alone, one JSON line of plan_* keys (docs/PLANNING.md). Exits
+    nonzero when the chosen engine is measurably slower than the best
+    forced engine by more than 20% — the chooser must not regress the
+    circuits it prices."""
+    from quest_tpu.env import ensure_live_backend
+    ensure_live_backend()
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    rec = _measure_autotune(n)
+    print(json.dumps(rec))
+    unknown = set(rec) - HEADLINE_JSON_KEYS
+    assert not unknown, (
+        f"autotune scenario emitted unregistered key(s) "
+        f"{sorted(unknown)}: add them to HEADLINE_JSON_KEYS")
+    chosen = rec.get("plan_chosen_ms")
+    forced = [v for k, v in rec.items()
+              if k.startswith("plan_forced_") and v is not None]
+    if chosen is not None and forced and chosen > 1.2 * min(forced):
+        _log(f"REGRESSION: chosen engine {rec['plan_engine']} at "
+             f"{chosen} ms/app is >20% above the best forced engine "
+             f"({min(forced)} ms)")
+        raise SystemExit(1)
+
+
 def expec_main():
     """`python bench.py expec` — the expectation-engine scenario alone,
     one JSON line of expec_* keys (docs/EXPECTATION.md)."""
@@ -1789,10 +1896,12 @@ if __name__ == "__main__":
         fleet_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "evolution":
         evolution_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "autotune":
+        autotune_main()
     elif len(sys.argv) > 1:
         raise SystemExit(f"unknown bench scenario {sys.argv[1]!r} "
                          f"(known: serve, fleet, expec, multichip, "
-                         f"durable, evolution; no argument = headline "
-                         f"run)")
+                         f"durable, evolution, autotune; no argument = "
+                         f"headline run)")
     else:
         main()

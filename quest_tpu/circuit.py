@@ -1041,10 +1041,25 @@ class Circuit:
         return fn
 
     def apply(self, q: Qureg, donate: bool = False) -> Qureg:
-        """Apply the circuit to a register (donate=True invalidates q)."""
+        """Apply the circuit to a register (donate=True invalidates q).
+
+        Above PERGATE_COMPILE_WARN_OPS ops the dispatch auto-routes
+        through the banded engine (QUEST_APPLY_AUTOROUTE, default on):
+        the per-gate XLA chain compiles pathologically slowly there —
+        minutes at ~100 ops on XLA-CPU — while the banded program
+        compiles in seconds and applies the same unitaries
+        (eps-identical in general, BIT-identical for permutation/phase
+        gates at HIGHEST — tests/test_plan.py pins both). 0 restores
+        the legacy warn-only per-gate dispatch (docs/PLANNING.md)."""
         n = q.num_state_qubits
         if self.num_qubits != q.num_qubits:
             raise ValueError("circuit/register size mismatch")
+        if (len(self.ops) > PERGATE_COMPILE_WARN_OPS
+                and not self._dynamic_count()
+                and not any(op.kind == "superop" for op in self.ops)):
+            from quest_tpu.env import knob_value
+            if knob_value("QUEST_APPLY_AUTOROUTE"):
+                return self.apply_banded(q, donate)
         return q.replace_amps(self.compiled(n, q.is_density, donate)(q.amps))
 
     def _flat_ops(self, n: int, density: bool) -> List[GateOp]:
@@ -1404,122 +1419,25 @@ class Circuit:
         40q/256-device schedule prices on a laptop
         (docs/DISTRIBUTED.md; scripts/check_comm_golden.py holds the
         goldens and tests/test_comm.py pins it equal to the lowered
-        StableHLO accounting)."""
-        self._reject_measure("plan_stats")
-        from quest_tpu.ops import fusion as F
-        from quest_tpu.ops import pallas_band as PB
+        StableHLO accounting).
 
-        n = self.num_qubits * 2 if density else self.num_qubits
-        flat = self._flat_ops(n, density)
-        enabled = F._schedule_enabled()
-        # ONE scheduler run serves both the stats and the planned list
-        sched_ops, sstats = F.schedule(flat, n)
-        sstats["enabled"] = enabled
-        planned = sched_ops if enabled else flat
-        rec = {
-            "scheduled": enabled,
-            "flat_ops": len(flat),
-            "planned_ops": len(planned),
-            "scheduler": sstats,
-            "banded": F.plan_stats(F.plan(planned, n)),
-        }
-        if PB.usable(n):
-            items = F.plan(planned, n, bands=PB.plan_bands(n))
-            parts = PB.segment_plan(items, n)
-            segs = sum(1 for p in parts if p[0] == "segment")
-            # hbm_sweeps: HBM passes per application AFTER sweep fusion
-            # (pallas_band.sweep_plan) under the current
-            # QUEST_SWEEP_FUSION setting — the fused engine's
-            # memory-traffic metric, CPU-assertable like the pass
-            # counts above (tests/test_sweeps.py holds the goldens)
-            swept = PB.maybe_sweep(parts, n)
-            sw = PB.sweep_stats(swept)
-            rec["fused"] = {
-                "kernel_segments": segs,
-                "xla_passthroughs": len(parts) - segs,
-                "full_state_passes": len(parts),
-                "stages": sum(len(p[1]) for p in parts
-                              if p[0] == "segment"),
-                "sweeps_enabled": PB.sweep_enabled(),
-                "hbm_sweeps": sw["hbm_sweeps"],
-                "sweep_stages": sw["sweep_stages"],
-            }
-            # decoupled-pipeline schedule (QUEST_FUSED_PIPELINE, keyed):
-            # pipeline_in_slots/out_slots/overlap_steps, CPU-side like
-            # the sweep counts; {} when the legacy driver is active, so
-            # the knob-off record stays bit-for-bit the old one
-            # (scripts/check_sweep_golden.py gates both)
-            rec["fused"].update(PB.pipeline_stats(swept, n))
-            if batch is not None:
-                from quest_tpu.env import batch_bucket
-                rec["batched"] = PB.batched_stats(
-                    swept, int(batch), batch_bucket(batch))
-        elif batch is not None:
-            # below the kernel tier compiled_batched rides the vmapped
-            # banded program: still one dispatch per banded pass for
-            # the whole bucket (trajectories.plan_stats's fallback
-            # record, so the documented `batch=` parameter never
-            # KeyErrors on small registers)
-            from quest_tpu.env import batch_bucket
-            bucket = batch_bucket(batch)
-            rec["batched"] = {
-                "batch": int(batch), "bucket": bucket,
-                "states_per_sweep": bucket,
-                "hbm_sweeps": rec["banded"]["full_state_passes"],
-                "kernel_sweeps": 0, "batched_stages": 0,
-            }
-        # f64-at-capacity sizing (docs/PRECISION.md): the limb path's
-        # chunk-bounded peak-memory model at this register size — the
-        # record bench.py's f64 ladder gates 28q on, and the CPU-side
-        # answer to "does reference-default precision fit this chip"
-        rec["f64"] = A.f64_capacity_stats(n)
-        if devices is not None:
-            rec["comm"] = self._comm_plan_stats(n, density, int(devices))
-        return rec
+        Since PR 16 this dict is a VIEW of the ProgramPlan IR
+        (quest_tpu/plan.py builds one object, this method re-emits its
+        historical shape bit-for-bit — docs/PLANNING.md); query
+        plan.build_plan / plan.autotune for the typed structure."""
+        self._reject_measure("plan_stats")
+        from quest_tpu import plan as P
+        return P.build_plan(self, density=density, batch=batch,
+                            devices=devices).stats()
 
     def _comm_plan_stats(self, n: int, density: bool, devices: int) -> dict:
         """The plan_stats 'comm' record: predicted collective schedule
         of the banded/fused sharded engines over `devices`, through the
-        SAME policy home they execute (parallel.sharded.engine_flat +
-        comm predictor) so it cannot drift from the lowered program."""
-        from quest_tpu import precision
-        from quest_tpu.ops import fusion as F
-        from quest_tpu.parallel import comm as C
+        SAME policy home they execute (parallel.sharded.comm_plan_record
+        wraps engine_flat + the comm predictor) so it cannot drift from
+        the lowered program."""
         from quest_tpu.parallel import sharded as S
-
-        if devices < 2 or devices & (devices - 1):
-            raise ValueError(
-                f"devices must be a power of two >= 2, got {devices}")
-        g = devices.bit_length() - 1
-        local_n = n - g
-        if local_n < 1:
-            raise ValueError(
-                f"register too small to shard over {devices} devices "
-                f"(ref E_DISTRIB_QUREG_TOO_SMALL)")
-        cinfo: dict = {}
-        bands = S._shard_bands(n, local_n)
-        flat_r = S.engine_flat(self.ops, n, density, local_n,
-                               bands=bands, comm_info=cinfo)
-        items = cinfo.get("items")
-        if items is None:
-            items = F.plan(flat_r, n, bands=bands)
-        rdt = precision.real_dtype_of(precision.get_default_dtype())
-        topo = C.topology(devices)
-        ici_b = topo.ici_bits(devices) if topo.hierarchical else None
-        rec = C.comm_stats(C.predict_exchanges_items(items, local_n,
-                                                     ici_b),
-                           num_devices=devices,
-                           bytes_per_real=np.dtype(rdt).itemsize,
-                           topo=topo)
-        rec.update({
-            "devices": devices,
-            "comm_strategy": cinfo.get("strategy", "plain"),
-            "comm_plan_enabled": C.plan_enabled(),
-            "comm_topology": topo.describe(devices),
-            "relabel_events": sum(1 for op in flat_r
-                                  if op.kind == "relabel"),
-        })
-        return rec
+        return S.comm_plan_record(self.ops, n, density, devices)
 
     def explain(self, density: bool = False, batch: int = None) -> str:
         """Human-readable fused-engine schedule: what compiled_fused will
@@ -1567,10 +1485,26 @@ class Circuit:
             except Exception:
                 pass
 
+        def plan_line():
+            # the one unified plan line (docs/PLANNING.md): the priced
+            # autotuner's verdict for this circuit — chosen engine,
+            # estimated ms/application, incumbent and candidate count.
+            # Searched fresh (persist=False: explain never reads or
+            # writes the plan cache); omitted, never fatal, when a
+            # subsystem cannot price (traced operands)
+            try:
+                from quest_tpu import plan as P
+                lines.append("  " + P.autotune(
+                    self, state_kind="density" if density else "pure",
+                    batch=batch, persist=False).line())
+            except Exception:
+                pass
+
         if not PB.usable(n):
             lines.append(f"  register below the kernel tier's minimum "
                          f"({PB.LANE_QUBITS + 3} qubits): the banded XLA "
                          f"engine runs instead")
+            plan_line()
             host_line()
             return "\n".join(lines)
 
@@ -1667,6 +1601,7 @@ class Circuit:
             f"  estimated steady state on one {chip}: {lo:.1f}-{hi:.1f} "
             f"ms per application at HIGHEST "
             f"(constants: {model['provenance']}){tag}")
+        plan_line()
         host_line()
         return "\n".join(lines)
 

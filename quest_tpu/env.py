@@ -383,6 +383,25 @@ _KNOB_LIST = (
              "sizes share one compiled program; off compiles exact sizes "
              "(default: pow2)",
          malformed="4", flips=("pow2", "off")),
+    Knob("QUEST_APPLY_AUTOROUTE", _bool01("QUEST_APPLY_AUTOROUTE"), True,
+         scope="keyed", layer="planner",
+         doc="Circuit.apply auto-routes through the banded engine above "
+             "PERGATE_COMPILE_WARN_OPS flat ops (the per-gate XLA chain "
+             "compiles pathologically slowly there — docs/PLANNING.md): "
+             "1/0 (default: 1; 0 restores the legacy warn-only per-gate "
+             "dispatch)",
+         malformed="2", flips=("1", "0")),
+    Knob("QUEST_PLAN_CACHE", _bool01("QUEST_PLAN_CACHE"), True,
+         scope="runtime", layer="infra",
+         doc="persistent content-addressed plan cache for plan.autotune "
+             "(docs/PLANNING.md): 1/0 (default: 1; 0 prices every "
+             "autotune call fresh — host-side planning only, never "
+             "inside a traced program)"),
+    Knob("QUEST_PLAN_CACHE_DIR", str, None,
+         scope="runtime", layer="infra",
+         doc="plan-cache directory for plan.autotune (default: the "
+             "compile cache path + '.plans' — next to the XLA compile "
+             "cache)"),
     Knob("QUEST_COMPILE_CACHE_DIR", str, None,
          scope="runtime", layer="infra",
          doc="persistent XLA compile-cache directory for "

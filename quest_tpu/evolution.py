@@ -249,6 +249,16 @@ class TrotterCircuit(Circuit):
             noise=self.trotter["noise"])
         return rec
 
+    def _plan_extra(self, density: bool) -> dict:
+        # the plan IR's subsystem-extension hook (quest_tpu/plan.py):
+        # autotuned TrotterCircuit plans carry the frame record too
+        density = density or self.trotter["noise"] is not None
+        return {"trotter": trotter_plan_stats(
+            self.trotter["spec"], self.trotter["dt"],
+            order=self.trotter["order"], steps=self.trotter["steps"],
+            density=density, pooled=self.trotter["pooled"],
+            noise=self.trotter["noise"])}
+
 
 def _zy_angle(coef: float, tau: float, scale: float) -> float:
     # exp(-i tau c P) == exp(-i angle/2 P) at angle = 2 tau c

@@ -634,6 +634,13 @@ class PauliSum:
     def num_qubits(self) -> int:
         return len(self.codes[0]) if self.codes else 0
 
+    def plan_stats(self, density: bool = False) -> dict:
+        """The module-level plan_stats for this spec — the observable
+        counterpart of Circuit.plan_stats, so a (circuit, observable)
+        pair introspects through one idiom (quest_tpu/plan.py consumers,
+        docs/PLANNING.md)."""
+        return plan_stats(self.codes, self.num_qubits, density=density)
+
 
 def batched_reducer(spec: PauliSum, num_qubits: int, density: bool = False):
     """(B, 2, 2^n) planes -> (B,) fused expectations — the serve

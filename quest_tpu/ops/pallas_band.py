@@ -885,6 +885,28 @@ def pipeline_stats(parts, n: int, batch: int = 1) -> dict:
     }
 
 
+def fused_record(parts, swept, n: int) -> dict:
+    """The plan IR's 'fused' record — the fused engine's CPU-assertable
+    geometry in ONE home (quest_tpu/plan.py builds it, Circuit.plan_stats
+    re-emits it bit-for-bit): segment/passthrough counts and stage mix
+    from the RAW segment plan `parts`, HBM sweep counts from the SWEPT
+    plan, plus the decoupled pipeline's slot schedule
+    (scripts/check_sweep_golden.py gates these keys)."""
+    segs = sum(1 for p in parts if p[0] == "segment")
+    sw = sweep_stats(swept)
+    rec = {
+        "kernel_segments": segs,
+        "xla_passthroughs": len(parts) - segs,
+        "full_state_passes": len(parts),
+        "stages": sum(len(p[1]) for p in parts if p[0] == "segment"),
+        "sweeps_enabled": sweep_enabled(),
+        "hbm_sweeps": sw["hbm_sweeps"],
+        "sweep_stages": sw["sweep_stages"],
+    }
+    rec.update(pipeline_stats(swept, n))
+    return rec
+
+
 def sweep_vmem_bytes(stages, arrays, n: int, batch: int = 1) -> dict:
     """CPU-assertable VMEM residency of ONE compiled sweep launch:
     slot buffers (the in/out rings of the decoupled pipeline, or the

@@ -420,3 +420,40 @@ def sharded_measured_schedule(ops: Sequence, n: int, density: bool, mesh,
     rec["comm_matches_hlo"] = (rec["comm_matches_hlo"]
                                and pred_psums == rec["all_reduces"])
     return rec
+
+
+def assert_plan_comm(plan, ops, n: int, density: bool, mesh,
+                     engine: str = "banded") -> dict:
+    """The plan IR's comm record asserted EQUAL to XLA's lowered
+    collective accounting — plan->predict->assert for the autotuner
+    (quest_tpu/plan.py): `plan.comm` was priced by pure host math;
+    here the sharded program actually lowers over `mesh` and its
+    StableHLO collective counts/bytes must match the plan's numbers
+    exactly (scripts/check_plan_golden.py gates this on the golden
+    circuits; raises AssertionError with both sides on any drift).
+    Returns the lowered-schedule record for further inspection."""
+    comm = plan.comm
+    if comm is None:
+        raise AssertionError(
+            "plan carries no comm record (built without devices=) — "
+            "autotune with devices/mesh before asserting")
+    rec = sharded_schedule(ops, n, density, mesh, engine=engine)
+    checks = (
+        ("comm_exchanges", "collective_exchanges"),
+        ("comm_collective_permutes", "collective_permutes"),
+        ("comm_all_to_alls", "all_to_alls"),
+        ("comm_bytes", "ici_bytes_per_device"),
+    )
+    for pk, lk in checks:
+        if comm[pk] != rec[lk]:
+            raise AssertionError(
+                f"plan comm prediction drifted from the lowered HLO: "
+                f"plan.{pk}={comm[pk]} != lowered {lk}={rec[lk]} "
+                f"(engine={engine}, devices={rec['devices']}, "
+                f"strategy plan={comm['comm_strategy']!r} "
+                f"lowered={rec['comm_strategy']!r})")
+    if comm["comm_strategy"] != rec["comm_strategy"]:
+        raise AssertionError(
+            f"plan comm strategy {comm['comm_strategy']!r} != the "
+            f"lowered program's {rec['comm_strategy']!r}")
+    return rec

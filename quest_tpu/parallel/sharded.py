@@ -529,6 +529,51 @@ def engine_flat(ops: Sequence, n: int, density: bool, local_n: int,
     return flat
 
 
+def comm_plan_record(ops: Sequence, n: int, density: bool,
+                     devices: int) -> dict:
+    """The plan IR's 'comm' record (quest_tpu/plan.py; re-emitted
+    bit-for-bit by Circuit.plan_stats): the comm planner's PREDICTED
+    collective schedule for the banded/fused sharded engines over
+    `devices`, built through the SAME policy home they execute
+    (engine_flat + the comm predictor) so the report cannot drift from
+    the lowered program. Pure host math — no mesh, no compile."""
+    from quest_tpu import precision
+    from quest_tpu.ops import fusion as F
+
+    if devices < 2 or devices & (devices - 1):
+        raise ValueError(
+            f"devices must be a power of two >= 2, got {devices}")
+    g = devices.bit_length() - 1
+    local_n = n - g
+    if local_n < 1:
+        raise ValueError(
+            f"register too small to shard over {devices} devices "
+            f"(ref E_DISTRIB_QUREG_TOO_SMALL)")
+    cinfo: dict = {}
+    bands = _shard_bands(n, local_n)
+    flat_r = engine_flat(ops, n, density, local_n,
+                         bands=bands, comm_info=cinfo)
+    items = cinfo.get("items")
+    if items is None:
+        items = F.plan(flat_r, n, bands=bands)
+    rdt = precision.real_dtype_of(precision.get_default_dtype())
+    topo = C.topology(devices)
+    ici_b = topo.ici_bits(devices) if topo.hierarchical else None
+    rec = C.comm_stats(C.predict_exchanges_items(items, local_n, ici_b),
+                       num_devices=devices,
+                       bytes_per_real=np.dtype(rdt).itemsize,
+                       topo=topo)
+    rec.update({
+        "devices": devices,
+        "comm_strategy": cinfo.get("strategy", "plain"),
+        "comm_plan_enabled": C.plan_enabled(),
+        "comm_topology": topo.describe(devices),
+        "relabel_events": sum(1 for op in flat_r
+                              if op.kind == "relabel"),
+    })
+    return rec
+
+
 def pergate_flat(ops: Sequence, n: int, density: bool, local_n: int,
                  lazy: bool = False,
                  comm_info: Optional[dict] = None) -> List:

@@ -290,6 +290,21 @@ class ServeEngine:
             return "closed"
         return self._state
 
+    def plan(self, circuit, *, batch: Optional[int] = None,
+             density: bool = False, dtype=None):
+        """The priced ProgramPlan this engine would dispatch `circuit`
+        under (plan.autotune through the persistent plan cache —
+        docs/PLANNING.md): pure host introspection, no compile, no
+        queue. `batch`/`density`/`dtype` mirror submit's request shape
+        (dtype default f32, the submit plane default)."""
+        import numpy as np
+
+        from quest_tpu import plan as P
+        return P.autotune(circuit,
+                          state_kind="density" if density else "pure",
+                          dtype=np.float32 if dtype is None else dtype,
+                          batch=batch)
+
     def submit(self, circuit, state=None, shots: Optional[int] = None, *,
                key=None, deadline_s: Optional[float] = None,
                observable: Optional[Callable] = None,

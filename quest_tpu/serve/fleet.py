@@ -244,13 +244,25 @@ class ServeFleet:
     def replicas(self) -> int:
         return len(self._engines)
 
+    def plan(self, circuit, *, batch: Optional[int] = None,
+             density: bool = False, dtype=None):
+        """ServeEngine.plan for the fleet: one priced ProgramPlan covers
+        every replica (plans are content-addressed per circuit + mode,
+        not per replica — docs/PLANNING.md)."""
+        return self._engines[0].plan(circuit, batch=batch,
+                                     density=density, dtype=dtype)
+
     def stats(self) -> dict:
         """Per-replica health: state, queued depth, restart budget left
-        — the figure an operator reads next to the fleet metrics."""
+        — the figure an operator reads next to the fleet metrics —
+        plus the process-wide plan-cache counters (hits vs searches:
+        a warm-restarted fleet shows zero searches, docs/PLANNING.md)."""
+        from quest_tpu import plan as P
         with self._lock:
             pressure = self._pressure_locked()
         return {
             "pressure": pressure,
+            "plan_cache": P.cache_stats(),
             "replicas": [
                 {"name": e.name, "state": e.state, "pending": e._pending,
                  "restarts_remaining": e._supervisor.remaining}

@@ -70,10 +70,16 @@ def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
     so a raw-key workload warmed with typed keys would still
     cold-compile its first real request.
 
-    Returns {"programs": {label: compile_s}, "total_s": float} where
+    Returns {"programs": {label: compile_s}, "plans": {label: plan
+    summary}, "plan_cache": counter deltas, "total_s": float} where
     label is "c{i}:b{bucket}" in grid order — per-program compile+warm
     wall seconds, so operators can see what the persistent .jax_cache
-    saved (a disk hit re-traces in milliseconds)."""
+    saved (a disk hit re-traces in milliseconds). "plans" records the
+    priced autotuner's verdict per apply-kind circuit (engine, total_ms,
+    source — docs/PLANNING.md): with a warm plan cache every source is
+    'cache' and the "plan_cache" searches delta is 0, the same
+    load-not-search contract the compile cache gives the programs
+    (scripts/check_plan_golden.py pins both on a warm restart)."""
     import jax
     import numpy as np
 
@@ -98,7 +104,11 @@ def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
         raise RejectedError(
             f"Invalid operation: cannot warm a {state} ServeEngine "
             f"(docs/RESILIENCE.md)")
+    from quest_tpu import plan as P
+
     report: Dict[str, float] = {}
+    plans: Dict[str, dict] = {}
+    stats0 = P.cache_stats()
     t_all = time.perf_counter()
     for i, c in enumerate(circuits):
         if kind is None:
@@ -106,6 +116,27 @@ def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
             c_kind = "traj" if noisy else "apply"
         else:
             c_kind = kind
+        # re-price the circuit through the persistent plan cache BEFORE
+        # compiling: a warm restart loads every plan from disk (zero
+        # searches), a cold one prices and stores for the next start.
+        # Loud-not-fatal: an unpriceable circuit (traced operands,
+        # dynamic ops) still warms its programs
+        if c_kind == "apply":
+            try:
+                pl = P.autotune(c, state_kind="density" if density
+                                else "pure", dtype=dtype)
+                plans[f"c{i}"] = {"engine": pl.engine,
+                                  "source": pl.source,
+                                  "total_ms": pl.cost.get("total_ms")}
+            except Exception as e:
+                import sys
+                print(f"[quest_tpu.serve] warmup could not price "
+                      f"circuit c{i}: {e!r}", file=sys.stderr, flush=True)
+                plans[f"c{i}"] = {"engine": None, "source": "error",
+                                  "total_ms": None}
+        else:
+            plans[f"c{i}"] = {"engine": None, "source": "unpriced:traj",
+                              "total_ms": None}
         n = c.num_qubits * 2 if density else c.num_qubits
         warmed = set()
         for b in buckets:
@@ -142,7 +173,10 @@ def warmup(engine, circuits, buckets: Optional[Sequence[int]] = None,
                 zeros = np.zeros((b, 2, 1 << n), dtype=dtype)
                 jax.block_until_ready(fn(zeros))
             report[f"c{i}:b{b}"] = time.perf_counter() - t0
+    stats1 = P.cache_stats()
     return {"programs": report,
+            "plans": plans,
+            "plan_cache": {k: stats1[k] - stats0[k] for k in stats1},
             "total_s": time.perf_counter() - t_all}
 
 

@@ -174,6 +174,11 @@ def expectation(ansatz: Callable, n: int, all_codes, coeffs=None,
         return E.expec_traced(amps, jnp.asarray(coeffs, amps.dtype),
                               plan).astype(amps.dtype)
 
+    # geometry tags for the priced sweep-chunk helper: sweep(chunk=
+    # "auto") sizes its bucket from the capacity model without being
+    # handed the register size (quest_tpu/plan.py sweep_chunk)
+    energy.num_qubits = n
+    energy.real_dtype = rdt.str
     ansatz_key = getattr(ansatz, "program_key", None)
     if ansatz_key is not None:
         # VALUE identity of the whole energy program: an ansatz that
@@ -243,7 +248,10 @@ def sweep(fn: Callable, param_batch, chunk: int = None):
     of the batched execution engine (docs/BATCHING.md): ONE compiled
     vmapped program per bucket, re-used across chunks, instead of a
     Python loop of single evaluations. `chunk` bounds live memory
-    (each vmapped evaluation holds chunk x 2^n amplitudes); batch
+    (each vmapped evaluation holds chunk x 2^n amplitudes);
+    chunk='auto' prices it from the capacity model instead
+    (plan.sweep_chunk — the largest bucket whose live amplitudes fit
+    the HBM budget, docs/PLANNING.md); batch
     sizes BUCKET like Circuit.compiled_batched (env.batch_bucket,
     QUEST_BATCH_BUCKET) so mixed sweep sizes share one jit cache
     entry — the pad evaluations re-run the first parameter set and are
@@ -297,6 +305,19 @@ def sweep(fn: Callable, param_batch, chunk: int = None):
             raise ValueError(
                 "every param_batch leaf must share the leading batch "
                 f"axis: got shapes {[tuple(l.shape) for l in leaves]}")
+    if chunk == "auto":
+        # priced chunk (quest_tpu/plan.py): the largest bucket whose
+        # live amplitudes fit the capacity model's HBM budget — opt-in,
+        # so chunk=None keeps the one-vmap legacy behavior exactly
+        nq = getattr(fn, "num_qubits", None)
+        if nq is None:
+            raise ValueError(
+                "chunk='auto' needs fn.num_qubits (set by "
+                "variational.expectation); pass an explicit chunk for "
+                "a bare ansatz function")
+        from quest_tpu import plan as P
+        chunk = P.sweep_chunk(total, int(nq),
+                              dtype=getattr(fn, "real_dtype", "f4"))
     per_call = total if chunk is None else max(1, min(int(chunk), total))
     bucket = batch_bucket(per_call)
     if chunk is None and bucket > total:

@@ -17,6 +17,10 @@ flagged debts plus this round's pipeline knob):
   exchange   QUEST_EXCHANGE_SLICES 1 vs 4 on the sharded fused step —
              the PR 8 ICI-overlap debt (needs >= 2 devices; recorded
              as skipped otherwise)
+  autotune   the priced plan chooser's pick vs every forced engine
+             (QUEST_APPLY_AUTOROUTE 1 vs 0) — whether the CPU cost
+             model ranks engines the way silicon does (ISSUE 16,
+             docs/PLANNING.md)
 
 Every experiment runs in a SUBPROCESS: the kernel knobs are
 import-once/keyed, so a fresh process per value is the only schedule
@@ -152,6 +156,54 @@ elif mode == "sharded":
         dci_slices=os.environ.get("QUEST_EXCHANGE_SLICES_DCI", "0"),
         topology=os.environ.get("QUEST_COMM_TOPOLOGY", ""),
         ms_per_application=round(dt * 1e3, 2))
+elif mode == "autotune":
+    # ISSUE 16 satellite: the priced chooser on real silicon — plan
+    # search wall time, the chosen engine, and chooser-pick vs every
+    # forced engine on the headline circuit (the CPU cost model only
+    # has to RANK right; this leg measures whether it did)
+    import bench
+    from quest_tpu import plan as P
+    from quest_tpu.ops import pallas_band as PB
+    from quest_tpu.state import basis_planes
+    c = bench._build_circuit(n)
+    t0 = time.perf_counter()
+    plan = P.autotune(c, persist=False)
+    search_ms = (time.perf_counter() - t0) * 1e3
+
+    def time_engine(fn):
+        amps = basis_planes(0, n=n, rdt=jnp.float32)
+        amps = fn(amps)
+        sync(amps)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            amps = fn(amps)
+        sync(amps)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    forced = {"pergate": c.compiled(n, False, donate=True),
+              "banded": c.compiled_banded(n, False, donate=True)}
+    if PB.usable(n):
+        fused = c.compiled_fused(n, False, donate=True,
+                                 interpret=interpret)
+        forced["fused"] = (lambda a: fused(
+            a.reshape(2, -1, PB.LANES)).reshape(2, -1))
+    ms = {}
+    for name, fn in forced.items():
+        try:
+            ms[name] = round(time_engine(fn), 3)
+        except Exception as e:
+            ms[name] = f"failed: {e!r}"[:120]
+    timed = {k: v for k, v in ms.items() if isinstance(v, float)}
+    chosen = ms.get(plan.engine)
+    out(mode=mode, n=n,
+        autoroute=os.environ.get("QUEST_APPLY_AUTOROUTE", "1"),
+        engine=plan.engine, incumbent=plan.incumbent,
+        candidates=len(plan.candidates),
+        search_ms=round(search_ms, 2),
+        forced_ms=ms,
+        chooser_ranked_right=(
+            chosen == min(timed.values()) if timed and
+            isinstance(chosen, float) else None))
 else:
     raise SystemExit(f"unknown mode {mode!r}")
 """
@@ -247,6 +299,15 @@ def main():
                     "QUEST_COMM_TOPOLOGY": "hosts=2"},
                reps=reps, interpret=interpret)
         for v in ("0", "4")}
+
+    # 7. the priced plan chooser (ISSUE 16 satellite): chooser pick vs
+    # every forced engine, with the auto-route knob on and off — on
+    # chip this validates that the CPU-side cost model RANKS engines
+    # the way silicon does (docs/PLANNING.md §pricing)
+    report["autotune"] = {
+        v: run("autotune", n, env={"QUEST_APPLY_AUTOROUTE": v},
+               reps=reps, interpret=interpret)
+        for v in ("1", "0")}
 
     print("[ab-silicon] " + json.dumps(report), flush=True)
     print(json.dumps(report, indent=1))

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""CI smoke gate for the plan autotuner (docs/PLANNING.md): fails if
+the priced chooser can regress a golden circuit, if its comm
+predictions drift from the lowered HLO, or if the persistent plan
+cache stops making a warm restart a LOAD instead of a search.
+
+Gates:
+  * INCUMBENT-NEVER-WORSE on every golden circuit (the headline
+    rotation block, the fusion-resistant chain, the deep-global
+    sharded testbed; unsharded and over the 8-device shard geometry):
+    the chosen plan's priced total_ms must sit <= the incumbent
+    candidate's — incumbent-wins-ties means a violation is a broken
+    tie-break, the same contract check_comm_golden.py holds for
+    choose_plan;
+  * PLAN == HLO on the comm axis: the autotuned plan's predicted
+    collective schedule for the deep-global circuit over an 8-device
+    mesh must equal the lowered StableHLO's collective accounting
+    exactly (introspect.assert_plan_comm — the plan->predict->assert
+    discipline, tests/test_comm.py's contract lifted to the IR);
+  * WARM RESTART IS A LOAD: prices a serve-warmup grid cold (fresh
+    plan-cache dir), then re-prices REBUILT equal circuits — the
+    simulated process restart — and requires zero plan searches (every
+    plan loads content-addressed from disk) and zero compile-cache
+    misses (the persistent compile cache's half of the same contract;
+    the in-process zero-RETRACE pin under CompileAuditor lives in
+    tests/test_plan.py).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+# the goldens must not move under a user's ambient knobs
+for _k in ("QUEST_COMM_TOPOLOGY", "QUEST_APPLY_AUTOROUTE",
+           "QUEST_PLAN_CACHE", "QUEST_PLAN_CACHE_DIR"):
+    os.environ.pop(_k, None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEVICES = 8
+
+
+def _golden_circuits(bench):
+    return (
+        ("headline16", bench._build_circuit(16), None),
+        ("chain16", bench._build_chain_circuit(16), None),
+        ("deepglobal", bench._build_deep_global_circuit(6, 6), None),
+        ("headline16-sharded", bench._build_circuit(16), DEVICES),
+        ("deepglobal-sharded", bench._build_deep_global_circuit(6, 6),
+         DEVICES),
+    )
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    import bench
+    from quest_tpu.precision import enable_compile_cache
+    from quest_tpu import plan as P
+    from quest_tpu.env import AMP_AXIS
+    from quest_tpu.parallel import introspect as I
+    from jax.sharding import Mesh
+
+    ok = True
+    rec = {}
+
+    # gate 1: incumbent-never-worse, every golden circuit
+    for name, c, devices in _golden_circuits(bench):
+        plan = P.autotune(c, devices=devices, persist=False)
+        chosen = plan.cost["total_ms"]
+        inc = plan.candidates[plan.incumbent]["total_ms"]
+        rec[name] = {"engine": plan.engine, "incumbent": plan.incumbent,
+                     "chosen_ms": chosen, "incumbent_ms": inc}
+        if chosen > inc:
+            print(f"REGRESSION: {name}: chosen plan "
+                  f"{plan.engine!r} priced at {chosen} ms ABOVE the "
+                  f"incumbent {plan.incumbent!r} at {inc} ms — "
+                  f"incumbent-wins-ties is broken", file=sys.stderr)
+            ok = False
+
+    # gate 2: the plan's comm predictions == lowered StableHLO
+    c = bench._build_deep_global_circuit(6, 6)
+    mesh = Mesh(np.array(jax.devices()[:DEVICES]), (AMP_AXIS,))
+    plan = P.autotune(c, mesh=mesh, persist=False)
+    try:
+        lowered = I.assert_plan_comm(plan, c.ops, 6, False, mesh,
+                                     engine="banded")
+        rec["plan_vs_hlo"] = {
+            "exchanges": plan.comm["comm_exchanges"],
+            "bytes": plan.comm["comm_bytes"],
+            "matches": bool(lowered["comm_matches_hlo"]),
+        }
+        if not lowered["comm_matches_hlo"]:
+            print("REGRESSION: lowered schedule's own predictor "
+                  "parity (comm_matches_hlo) is false", file=sys.stderr)
+            ok = False
+    except AssertionError as e:
+        print(f"REGRESSION: {e}", file=sys.stderr)
+        ok = False
+
+    # gate 3: warm restart is a load — zero searches, zero compiles
+    from quest_tpu.serve import metrics
+    from quest_tpu.serve.engine import ServeEngine
+    from quest_tpu.serve.warmup import warmup
+    with tempfile.TemporaryDirectory() as d:
+        os.environ["QUEST_PLAN_CACHE_DIR"] = d
+        # the XLA side of the warm-restart contract: min_compile_secs=0
+        # so even this gate's millisecond programs persist to disk —
+        # the rebuilt circuits' re-traces must all be disk hits
+        enable_compile_cache(path=os.path.join(d, "xla"),
+                             min_compile_secs=0.0)
+        with ServeEngine(max_batch=2) as eng:
+            cold = warmup(eng, [bench._build_circuit(4),
+                                bench._build_chain_circuit(4)],
+                          buckets=(1, 2))
+            # the simulated restart: REBUILT equal circuits (fresh
+            # objects — no instance-level caches to hide behind), warm
+            # plan cache + warm XLA compile cache on disk. A re-trace
+            # still happens (fresh jit functions); what must be ZERO is
+            # fresh XLA compiles (every lookup a disk hit — the
+            # compile-cache listener's miss counter) and fresh plan
+            # searches
+            P.reset_cache_stats()
+            misses0 = metrics.snapshot()["counters"].get(
+                "compile_cache_misses", 0)
+            warm = warmup(eng, [bench._build_circuit(4),
+                                bench._build_chain_circuit(4)],
+                          buckets=(1, 2))
+            miss_delta = metrics.snapshot()["counters"].get(
+                "compile_cache_misses", 0) - misses0
+        os.environ.pop("QUEST_PLAN_CACHE_DIR", None)
+        rec["warmup"] = {"cold": cold["plan_cache"],
+                         "warm": warm["plan_cache"],
+                         "warm_compile_misses": miss_delta}
+        if cold["plan_cache"]["searches"] < 2:
+            print(f"REGRESSION: cold warmup should have priced 2 "
+                  f"circuits, searched {cold['plan_cache']['searches']}",
+                  file=sys.stderr)
+            ok = False
+        if warm["plan_cache"]["searches"] != 0:
+            print(f"REGRESSION: warm-cache warmup ran "
+                  f"{warm['plan_cache']['searches']} plan search(es); "
+                  f"a warm restart must LOAD every plan from disk",
+                  file=sys.stderr)
+            ok = False
+        if warm["plan_cache"]["hits"] < 2:
+            print(f"REGRESSION: warm-cache warmup hit only "
+                  f"{warm['plan_cache']['hits']} of 2 plans",
+                  file=sys.stderr)
+            ok = False
+        if miss_delta != 0:
+            print(f"REGRESSION: warm-cache warmup took "
+                  f"{miss_delta} compile-cache miss(es); the persistent "
+                  f"compile cache must make a warm restart compile 0 "
+                  f"fresh programs", file=sys.stderr)
+            ok = False
+
+    print(json.dumps(rec))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -532,6 +532,49 @@ def test_fused_pipeline_knob_is_keyed_with_flips():
         k.parse(k.malformed)
 
 
+def test_plan_knob_registry_coverage(tmp_path):
+    """QUEST_APPLY_AUTOROUTE / QUEST_PLAN_CACHE coverage of the
+    registry rules (ISSUE 16): the auto-route knob is KEYED (it selects
+    which compiled program apply() resolves to), so a registry read on
+    a jit-reachable path passes QL001; the cache knob is RUNTIME
+    (autotune reads it outside every compiled path); direct os.environ
+    reads of either fire QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_APPLY_AUTOROUTE"):
+                return amps
+            return amps * 2
+
+        def configure():
+            a = os.environ.get("QUEST_APPLY_AUTOROUTE")
+            b = os.environ.get("QUEST_PLAN_CACHE")
+            return a, b
+    """, name="planknobs.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 2 and all("bypasses" in v.message for v in q4), vs
+
+
+def test_autoroute_knob_is_keyed_with_flips():
+    """The auto-route knob must stay keyed (flipping it mid-process
+    must resolve to a fresh compiled program, never a stale cached
+    route — it is part of engine_mode_key and hence of every plan-cache
+    content key) and flip-auditable, and its parser must reject
+    malformed input loudly."""
+    from quest_tpu.env import KNOBS
+    k = KNOBS["QUEST_APPLY_AUTOROUTE"]
+    assert k.scope == "keyed" and k.layer == "planner"
+    assert k.flips == ("1", "0")
+    assert k.default is True
+    with pytest.raises(ValueError):
+        k.parse(k.malformed)
+
+
 def test_serve_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
     serve knobs are RUNTIME scope — read once at ServeEngine

@@ -1,0 +1,459 @@
+"""The plan IR + priced autotuner + persistent plan cache
+(quest_tpu/plan.py, docs/PLANNING.md).
+
+Bit-compat: `Circuit.plan_stats()` now assembles a ProgramPlan and
+re-emits the historical dict — same keys, same insertion order, same
+values — so every existing golden keeps gating the same numbers.
+Pricing: `plan.autotune` returns a priced plan for every
+(engine x state kind x mesh) combination with INCUMBENT-WINS-TIES — the
+pre-autotuner dispatch is always a candidate and only loses to a
+strictly cheaper plan, so no golden circuit can regress by construction
+(scripts/check_plan_golden.py gates the same contract in CI).
+Durability: plans serialize -> load by value; a corrupted or
+stale-version cache entry is skipped LOUDLY to a fresh price, never
+silently consumed (the checkpoint discipline); a warmed serve restart
+re-prices from disk with zero plan searches and re-traces nothing.
+Routing: above PERGATE_COMPILE_WARN_OPS `Circuit.apply` auto-routes
+through the banded engine (QUEST_APPLY_AUTOROUTE) — bit-identical to
+the per-gate oracle on permutation/phase gates, legacy warn-only when
+the knob is off.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from bench import (_build_chain_circuit, _build_circuit,
+                   _build_deep_global_circuit)
+from quest_tpu import plan as P
+from quest_tpu import circuit as circuit_mod
+from quest_tpu.circuit import PERGATE_COMPILE_WARN_OPS, Circuit
+from quest_tpu.state import to_dense
+from .helpers import max_mesh_devices
+
+
+def _small_circuit(n: int = 6) -> Circuit:
+    c = Circuit(n).h(0)
+    for q in range(n - 1):
+        c.cnot(q, q + 1)
+    return c.rz(2, 0.25).rx(1, 0.5).cz(0, 3)
+
+
+def _permutation_circuit(n: int = 5, reps: int = 3) -> Circuit:
+    """Permutation / +-1-phase gates only (x/cnot/swap/cz) — the family
+    the banded engine applies BIT-identically to the per-gate oracle in
+    f32. Kept small: the autoroute tests lower the threshold instead of
+    paying the pathological per-gate compile the route exists to avoid
+    (a 68-op pergate chain takes MINUTES to compile on XLA-CPU)."""
+    c = Circuit(n)
+    for r in range(reps):
+        c.x(r % n).cnot(r % n, (r + 1) % n)
+        c.swap((r + 2) % n, (r + 3) % n).cz(r % n, (r + 2) % n)
+    return c
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache_stats():
+    P.reset_cache_stats()
+    yield
+    P.reset_cache_stats()
+
+
+@pytest.fixture
+def plan_cache(tmp_path, monkeypatch):
+    """Point the persistent plan cache at a private tmp dir."""
+    monkeypatch.setenv("QUEST_PLAN_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("QUEST_PLAN_CACHE", raising=False)
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# the IR: plan_stats bit-compat + build_plan
+# ---------------------------------------------------------------------------
+
+
+def test_plan_stats_emits_the_historical_shape():
+    """The IR's stats() re-emits the pre-IR dict: exact key ORDER
+    (goldens iterate it), conditional fused/batched/comm sections."""
+    from quest_tpu.ops import pallas_band as PB
+    devices = max_mesh_devices()
+    c = _small_circuit(6)
+    rec = c.plan_stats(batch=3, devices=devices)
+    want = ["scheduled", "flat_ops", "planned_ops", "scheduler", "banded"]
+    if PB.usable(6):
+        want.append("fused")
+    want += ["batched", "f64", "comm"]
+    assert list(rec) == want
+    assert rec["flat_ops"] >= len(c.ops)
+    assert rec["banded"]["full_state_passes"] >= 1
+    assert rec["comm"]["devices"] == devices
+    assert rec["batched"]["bucket"] == 4      # 3 rounds up on pow2 grid
+    # no-devices / no-batch variants drop exactly those sections
+    rec2 = c.plan_stats()
+    assert "comm" not in rec2 and "batched" not in rec2
+
+
+def test_build_plan_is_the_one_home_of_plan_stats():
+    c = _small_circuit(6)
+    plan = P.build_plan(c, batch=2)
+    assert plan.source == "build" and plan.engine == plan.incumbent
+    assert plan.stats() == c.plan_stats(batch=2)
+    assert plan.candidates == {} and plan.cost == {}
+
+
+def test_pauli_sum_plan_stats_rides_the_same_idiom():
+    from quest_tpu.ops.expec import PauliSum, plan_stats
+    spec = PauliSum.of([[3, 0, 3], [1, 1, 0]], [0.5, -1.0], 3)
+    assert spec.plan_stats() == plan_stats(spec.codes, 3)
+
+
+def test_plan_stats_rejects_dynamic_circuits():
+    c = Circuit(3).h(0)
+    c.measure(0)
+    with pytest.raises(Exception):
+        c.plan_stats()
+    with pytest.raises(Exception):
+        P.autotune(c, persist=False)
+
+
+# ---------------------------------------------------------------------------
+# the priced autotuner
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("state_kind", ["pure", "density"])
+@pytest.mark.parametrize("sharded", [False, True])
+def test_autotune_prices_every_engine_family(state_kind, sharded):
+    """A priced ProgramPlan for every (engine x state kind x mesh)
+    combination: chosen engine selectable, cost populated, incumbent
+    always a candidate, comm section present exactly when sharded."""
+    devices = max_mesh_devices() if sharded else None
+    c = _small_circuit(6)
+    plan = P.autotune(c, state_kind=state_kind, devices=devices,
+                      persist=False)
+    assert plan.source == "search"
+    assert plan.engine in plan.candidates
+    assert plan.candidates[plan.engine]["selectable"]
+    assert plan.incumbent in plan.candidates
+    assert plan.cost["total_ms"] >= 0
+    assert plan.density == (state_kind == "density")
+    assert plan.n == (12 if state_kind == "density" else 6)
+    if sharded:
+        assert plan.engine.startswith("sharded-")
+        assert plan.comm is not None
+        assert plan.cost["comm_elem_bytes"] >= 0
+    else:
+        assert plan.comm is None
+        assert plan.engine in ("pergate", "banded", "fused")
+    for name, cand in plan.candidates.items():
+        assert cand["total_ms"] >= 0, name
+        assert {"est_ms_lo", "est_ms_hi", "hbm_passes", "compile_ops",
+                "comm_ms", "selectable"} <= set(cand), name
+
+
+def test_autotune_incumbent_never_worse_on_goldens():
+    """The CI gate's contract in-suite: on every golden circuit the
+    chosen plan's priced cost sits <= the incumbent candidate's —
+    incumbent-wins-ties means a violation is a broken tie-break."""
+    goldens = [(_build_circuit(16), None),
+               (_build_chain_circuit(16), None),
+               (_build_deep_global_circuit(6, 6), None),
+               (_build_deep_global_circuit(6, 6), max_mesh_devices())]
+    for c, devices in goldens:
+        plan = P.autotune(c, devices=devices, persist=False)
+        chosen = plan.cost["total_ms"]
+        inc = plan.candidates[plan.incumbent]["total_ms"]
+        assert chosen <= inc, (plan.engine, plan.incumbent, chosen, inc)
+
+
+def test_autotune_advisory_candidates_are_never_selected():
+    """Knob-owned alternatives (the other scheduler stream, non-winning
+    comm strategies) are priced for visibility but marked
+    selectable=False — the autotuner must not override user knobs."""
+    c = _build_deep_global_circuit(6, 6)
+    plan = P.autotune(c, devices=max_mesh_devices(), persist=False)
+    advisory = {k: v for k, v in plan.candidates.items()
+                if not v["selectable"]}
+    assert advisory, sorted(plan.candidates)
+    assert plan.engine not in advisory
+
+
+def test_autotune_validates_inputs():
+    c = _small_circuit(4)
+    with pytest.raises(ValueError, match="state_kind"):
+        P.autotune(c, state_kind="mixed", persist=False)
+    import jax
+    from jax.sharding import Mesh
+    from quest_tpu.env import AMP_AXIS
+    ndev = max_mesh_devices()
+    mesh = Mesh(np.array(jax.devices()[:ndev]), (AMP_AXIS,))
+    with pytest.raises(ValueError, match="not both"):
+        P.autotune(c, mesh=mesh, devices=ndev, persist=False)
+    plan = P.autotune(c, mesh=mesh, persist=False)
+    assert plan.devices == ndev
+
+
+def test_autotune_comm_prediction_matches_lowered_hlo():
+    """plan -> predict -> assert lifted to the IR: the autotuned plan's
+    collective schedule equals the lowered StableHLO accounting."""
+    import jax
+    from jax.sharding import Mesh
+    from quest_tpu.env import AMP_AXIS
+    from quest_tpu.parallel import introspect as I
+    ndev = max_mesh_devices()
+    c = _build_deep_global_circuit(6, 6)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), (AMP_AXIS,))
+    plan = P.autotune(c, mesh=mesh, persist=False)
+    lowered = I.assert_plan_comm(plan, c.ops, 6, False, mesh,
+                                 engine="banded")
+    assert lowered["comm_matches_hlo"]
+
+
+def test_explain_carries_the_unified_plan_line():
+    c = _small_circuit(5)
+    out = c.explain()
+    assert "plan: engine=" in out
+    assert "docs/PLANNING.md" in out
+    plan = P.autotune(c, persist=False)
+    assert f"engine={plan.engine}" in out
+
+
+# ---------------------------------------------------------------------------
+# content addressing + the persistent cache
+# ---------------------------------------------------------------------------
+
+
+def test_plan_key_is_value_addressed():
+    """Equal circuits (fresh objects) share a key; a changed operand
+    value, dtype or device count is a DIFFERENT plan; batch keys on the
+    resolved bucket, not the raw size."""
+    kw = dict(density=False, dtype=np.float32, batch=None, devices=None)
+    k1 = P.plan_key(_small_circuit(6), **kw)
+    k2 = P.plan_key(_small_circuit(6), **kw)
+    assert k1 == k2 and isinstance(k1, str)
+    c3 = _small_circuit(6).rx(0, 0.125)
+    assert P.plan_key(c3, **kw) != k1
+    assert P.plan_key(_small_circuit(6), density=True, dtype=np.float32,
+                      batch=None, devices=None) != k1
+    assert P.plan_key(_small_circuit(6), density=False, dtype=np.float64,
+                      batch=None, devices=None) != k1
+    assert P.plan_key(_small_circuit(6), density=False, dtype=np.float32,
+                      batch=None, devices=max_mesh_devices()) != k1
+    b3 = P.plan_key(_small_circuit(6), density=False, dtype=np.float32,
+                    batch=3, devices=None)
+    b4 = P.plan_key(_small_circuit(6), density=False, dtype=np.float32,
+                    batch=4, devices=None)
+    assert b3 == b4 and b3 != k1     # pow2 bucket folding
+
+
+def test_plan_roundtrips_through_the_cache_by_value(plan_cache):
+    """serialize -> load equality: the loaded plan is the stored plan
+    (source flipped to 'cache'), and a second autotune is a disk HIT
+    with zero searches."""
+    c = _small_circuit(6)
+    plan = P.autotune(c)
+    assert plan.source == "search"
+    stats = P.cache_stats()
+    assert stats["searches"] == 1 and stats["stores"] == 1
+    loaded = P.load_plan(plan.key)
+    assert loaded is not None and loaded.source == "cache"
+    assert dataclasses.replace(loaded, source="search") == plan
+    again = P.autotune(_small_circuit(6))   # REBUILT equal circuit
+    assert again.source == "cache"
+    assert again.engine == plan.engine
+    assert P.cache_stats()["searches"] == 1  # no second search
+
+
+def test_corrupt_cache_entry_skipped_loudly(plan_cache, capsys):
+    """One flipped byte on disk -> LOUD skip (stderr + corrupt counter)
+    and a fresh search; the damaged entry is never silently consumed."""
+    c = _small_circuit(6)
+    plan = P.autotune(c)
+    path = os.path.join(str(plan_cache), f"plan-{plan.key}.json")
+    meta = json.load(open(path))
+    meta["engine"] = "pergate" if meta["engine"] != "pergate" else "banded"
+    json.dump(meta, open(path, "w"))       # digest now mismatches
+    P.reset_cache_stats()
+    again = P.autotune(_small_circuit(6))
+    err = capsys.readouterr().err
+    assert "CORRUPT" in err and "docs/PLANNING.md" in err
+    assert again.source == "search"
+    st = P.cache_stats()
+    assert st["corrupt"] == 1 and st["searches"] == 1
+    # the fresh price re-stored a good entry: next load is a clean hit
+    assert P.autotune(_small_circuit(6)).source == "cache"
+
+
+def test_stale_version_entry_skipped_loudly(plan_cache, capsys):
+    c = _small_circuit(6)
+    plan = P.autotune(c)
+    path = os.path.join(str(plan_cache), f"plan-{plan.key}.json")
+    meta = json.load(open(path))
+    meta["version"] = P.PLAN_FORMAT_VERSION + 1
+    json.dump(meta, open(path, "w"))
+    P.reset_cache_stats()
+    assert P.autotune(_small_circuit(6)).source == "search"
+    err = capsys.readouterr().err
+    assert "STALE" in err and "version" in err
+    assert P.cache_stats()["stale"] == 1
+
+
+def test_unreadable_json_is_corrupt_not_fatal(plan_cache, capsys):
+    c = _small_circuit(6)
+    plan = P.autotune(c)
+    path = os.path.join(str(plan_cache), f"plan-{plan.key}.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    P.reset_cache_stats()
+    assert P.autotune(_small_circuit(6)).source == "search"
+    assert "CORRUPT" in capsys.readouterr().err
+    assert P.cache_stats()["corrupt"] == 1
+
+
+def test_cache_respects_the_knob_and_keyed_mode(plan_cache, monkeypatch):
+    """QUEST_PLAN_CACHE=0 bypasses the disk entirely; a keyed-knob flip
+    is a DIFFERENT plan identity (engine_mode_key in the content key)."""
+    c = _small_circuit(6)
+    k_on = P.plan_key(c, density=False, dtype=np.float32, batch=None,
+                      devices=None)
+    monkeypatch.setenv("QUEST_PLAN_CACHE", "0")
+    assert P.autotune(c).source == "search"
+    assert P.autotune(c).source == "search"       # still no cache
+    st = P.cache_stats()
+    assert st["hits"] == 0 and st["stores"] == 0 and st["searches"] == 2
+    monkeypatch.delenv("QUEST_PLAN_CACHE")
+    monkeypatch.setenv("QUEST_SCHEDULE", "0")     # keyed knob flip
+    assert P.plan_key(c, density=False, dtype=np.float32, batch=None,
+                      devices=None) != k_on
+
+
+# ---------------------------------------------------------------------------
+# the warm serve restart (plans + programs both load, nothing re-traces)
+# ---------------------------------------------------------------------------
+
+
+def test_warmed_serve_restart_is_a_load(plan_cache, compile_auditor):
+    """A warmed engine re-warmed over the same grid: every plan loads
+    from disk (zero searches) and nothing re-traces (the zero-retrace
+    acceptance gate under CompileAuditor)."""
+    from quest_tpu.serve import metrics
+    from quest_tpu.serve.engine import ServeEngine
+    from quest_tpu.serve.warmup import warmup
+    c1, c2 = _small_circuit(4), _build_chain_circuit(4)
+    with ServeEngine(max_batch=2, registry=metrics.Registry()) as eng:
+        cold = warmup(eng, [c1, c2], buckets=(1, 2))
+        assert cold["plan_cache"]["searches"] >= 2
+        assert cold["plan_cache"]["stores"] >= 2
+        assert all(p["source"] in ("search", "cache")
+                   for p in cold["plans"].values())
+        P.reset_cache_stats()
+        with compile_auditor as aud:
+            warm = warmup(eng, [c1, c2], buckets=(1, 2))
+        aud.assert_no_retrace("warm-cache serve warmup")
+        assert warm["plan_cache"]["searches"] == 0
+        assert warm["plan_cache"]["hits"] >= 2
+        assert all(p["source"] == "cache" for p in warm["plans"].values())
+
+
+def test_serve_engine_and_fleet_expose_the_plan(plan_cache):
+    from quest_tpu.serve import metrics
+    from quest_tpu.serve.engine import ServeEngine
+    from quest_tpu.serve.fleet import ServeFleet
+    c = _small_circuit(4)
+    with ServeEngine(max_batch=2, registry=metrics.Registry()) as eng:
+        plan = eng.plan(c)
+        assert isinstance(plan, P.ProgramPlan)
+        assert plan.engine in plan.candidates
+    with ServeFleet(replicas=1, max_batch=2,
+                    registry=metrics.Registry()) as fl:
+        plan = fl.plan(c)
+        assert isinstance(plan, P.ProgramPlan)
+        assert set(fl.stats()["plan_cache"]) == set(P.cache_stats())
+
+
+# ---------------------------------------------------------------------------
+# apply auto-route (the PR-13 footgun, closed)
+# ---------------------------------------------------------------------------
+
+
+def test_apply_autoroutes_large_circuits_bit_identically(monkeypatch):
+    """Above PERGATE_COMPILE_WARN_OPS, apply() dispatches the banded
+    engine — bit-identical to the per-gate oracle on permutation/phase
+    gates in f32 (docs/PLANNING.md documents eps-closeness for the
+    general gate set). The threshold is lowered so the test exercises
+    the SAME routing predicate without paying the pathological
+    per-gate compile the route exists to avoid."""
+    c = _permutation_circuit()
+    monkeypatch.setattr(circuit_mod, "PERGATE_COMPILE_WARN_OPS", 8)
+    assert len(c.ops) > 8
+    q = qt.init_debug_state(qt.create_qureg(5))
+    monkeypatch.setenv("QUEST_APPLY_AUTOROUTE", "0")
+    monkeypatch.setattr(circuit_mod, "_pergate_warned", False)
+    legacy = to_dense(c.apply(qt.init_debug_state(qt.create_qureg(5)),
+                              donate=False))
+    assert circuit_mod._pergate_warned      # warn-only path still warns
+    monkeypatch.setenv("QUEST_APPLY_AUTOROUTE", "1")
+    routed = to_dense(c.apply(q, donate=False))
+    np.testing.assert_array_equal(np.asarray(routed), np.asarray(legacy))
+
+
+def test_apply_autoroute_general_gates_stay_close(monkeypatch):
+    """Rotation gates are eps-close (not bit-equal) across the route —
+    pin the tolerance so the auto-route can't drift semantically."""
+    monkeypatch.setattr(circuit_mod, "PERGATE_COMPILE_WARN_OPS", 8)
+    c = Circuit(4)
+    for r in range(4):
+        c.rx(r % 4, 0.1 * r).cnot(r % 4, (r + 1) % 4).rz((r + 2) % 4, 0.05)
+    assert len(c.ops) > 8
+    monkeypatch.setenv("QUEST_APPLY_AUTOROUTE", "0")
+    legacy = to_dense(c.apply(qt.init_debug_state(qt.create_qureg(4)),
+                              donate=False))
+    monkeypatch.setenv("QUEST_APPLY_AUTOROUTE", "1")
+    routed = to_dense(c.apply(qt.init_debug_state(qt.create_qureg(4)),
+                              donate=False))
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(legacy),
+                               atol=1e-6, rtol=0)
+
+
+def test_apply_small_circuits_never_reroute():
+    """At or below the threshold the dispatch is untouched — the knob
+    only governs the compile-footgun regime."""
+    c = _small_circuit(4)
+    assert len(c.ops) <= PERGATE_COMPILE_WARN_OPS
+    out = to_dense(c.apply(qt.init_debug_state(qt.create_qureg(4)),
+                           donate=False))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+# ---------------------------------------------------------------------------
+# priced sweep chunking (variational chunk='auto')
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_chunk_is_a_bounded_pow2_bucket():
+    chunk = P.sweep_chunk(1000, 4)
+    assert 1 <= chunk <= 1000
+    assert chunk & (chunk - 1) == 0          # pow2 bucket
+    assert P.sweep_chunk(3, 4) <= 4
+    assert P.sweep_chunk(1, 30) == 1         # huge state -> tiny chunk
+
+
+def test_variational_sweep_auto_chunk():
+    from quest_tpu import variational as V
+    def ansatz(amps, params):
+        return V.rx(amps, 3, 0, params[0])
+    energy = V.expectation(ansatz, 3, [[3, 0, 0]], [1.0])
+    assert energy.num_qubits == 3            # the chunk='auto' contract
+    batch = [np.array([0.1 * i], dtype=np.float32) for i in range(5)]
+    auto = np.asarray(V.sweep(energy, batch, chunk="auto"))
+    ref = np.asarray(V.sweep(energy, batch))
+    np.testing.assert_allclose(auto, ref, atol=1e-6, rtol=0)
+
+    def bare(p):
+        return p.sum()
+    with pytest.raises(ValueError, match="num_qubits"):
+        V.sweep(bare, batch, chunk="auto")

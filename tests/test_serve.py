@@ -515,7 +515,7 @@ def test_warmup_reports_compile_seconds_and_prevents_cold_start(
     c = _circuit_a()
     with _engine(max_wait_ms=0, max_batch=4) as eng:
         rep = warmup(eng, [c], buckets=[1])
-        assert set(rep) == {"programs", "total_s"}
+        assert set(rep) == {"programs", "plans", "plan_cache", "total_s"}
         assert rep["programs"] and all(
             isinstance(v, float) and v >= 0 for v in rep["programs"].values())
         s = _random_states(1, seed=23)[0]
