@@ -3,9 +3,14 @@
 quest-lint (``quest_tpu.analysis.lint``) enforces the compiled-path
 invariants that code review kept re-finding by hand (QL001-QL004:
 cache-key completeness, i32 kernel hygiene, tracer leaks, loud knob
-parsing); the audit harness (``quest_tpu.analysis.audit``) checks the
-dynamic halves — zero unexpected retraces over a golden circuit set and
-actual cache misses when a registered knob flips.
+parsing) plus the concurrency + memory-safety invariants of the
+threaded serve/durable stack (QL005-QL009: _GUARDED_BY lock
+discipline, use-after-donate, blocking-under-lock, atomic-write
+discipline, fault-site catalog integrity); the audit harness
+(``quest_tpu.analysis.audit``) checks the dynamic halves — zero
+unexpected retraces over a golden circuit set, actual cache misses
+when a registered knob flips, and an acyclic lock acquisition-order
+graph (LockOrderAuditor).
 
 CLI: ``python -m quest_tpu.analysis [paths ...]`` (defaults to the
 repository's quest_tpu/, scripts/ and tests/; exits non-zero on any

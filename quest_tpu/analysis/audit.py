@@ -293,3 +293,144 @@ def audit_eager_worker(run_gate: Callable[[], None],
                 f"flipping {knob.name} did not miss the injected eager "
                 f"worker's jit cache: its static arguments omit the "
                 f"mode key (the PR-1 stale-eager-worker bug shape)")
+
+
+# ---------------------------------------------------------------------------
+# lock-order auditing (the dynamic half of quest-lint QL005/QL007)
+# ---------------------------------------------------------------------------
+
+
+class LockOrderError(AssertionError):
+    """Two audited locks were acquired in opposite orders by different
+    threads: a latent ABBA deadlock the static rules cannot see."""
+
+
+class _AuditedLock:
+    """Transparent proxy over a Lock/RLock/Condition that reports every
+    acquire/release to its LockOrderAuditor. Forwards everything else
+    (`wait`/`notify` on a wrapped Condition still work: during `wait`
+    the blocked thread acquires nothing, so the held-stack stays
+    truthful for ordering purposes)."""
+
+    def __init__(self, auditor: "LockOrderAuditor", name: str, inner):
+        self._auditor = auditor
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._auditor._note_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._auditor._note_release(self._name)
+
+    def __enter__(self) -> "_AuditedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+class LockOrderAuditor:
+    """Records the acquisition-order graph of every wrapped lock and
+    fails on a cycle.
+
+        auditor = LockOrderAuditor()
+        engine._cond = auditor.wrap("engine", engine._cond)
+        fleet._lock = auditor.wrap("fleet", fleet._lock)
+        ... run the workload ...
+        auditor.assert_acyclic()
+
+    Every `acquire` of lock B while a thread already holds lock A adds
+    the directed edge A -> B; a cycle in that graph means two threads
+    can acquire the same pair in opposite orders — the ABBA deadlock.
+    Same-name re-entry (the ServeFleet RLock contract from PR 11) is
+    counted, not edged: a reentrant self-acquire cannot deadlock.
+    Thread-safe; the held-stack is thread-local."""
+
+    _GUARDED_BY = {"_mu": ("edges", "reentries", "acquisitions")}
+
+    def __init__(self):
+        import threading
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self.edges: Dict[str, set] = {}           # A -> {B acquired under A}
+        self.reentries: Dict[str, int] = {}       # name -> self-reacquires
+        self.acquisitions: Dict[str, int] = {}    # name -> total acquires
+
+    def wrap(self, name: str, inner) -> _AuditedLock:
+        with self._mu:
+            self.edges.setdefault(name, set())
+        return _AuditedLock(self, name, inner)
+
+    def _held(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, name: str) -> None:
+        stack = self._held()
+        with self._mu:
+            self.acquisitions[name] = self.acquisitions.get(name, 0) + 1
+            if name in stack:
+                self.reentries[name] = self.reentries.get(name, 0) + 1
+            else:
+                for held in set(stack):
+                    self.edges.setdefault(held, set()).add(name)
+        stack.append(name)
+
+    def _note_release(self, name: str) -> None:
+        stack = self._held()
+        # release orders can interleave (Condition.wait releases out of
+        # band); drop the innermost matching entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A lock-name cycle ['a', 'b', 'a'] if one exists, else None."""
+        with self._mu:
+            edges = {k: sorted(v) for k, v in self.edges.items()}
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in edges}
+        path: List[str] = []
+
+        def visit(n: str) -> Optional[List[str]]:
+            color[n] = GREY
+            path.append(n)
+            for nxt in edges.get(n, ()):
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    return path[path.index(nxt):] + [nxt]
+                if c == WHITE:
+                    got = visit(nxt)
+                    if got:
+                        return got
+            color[n] = BLACK
+            path.pop()
+            return None
+
+        for n in sorted(edges):
+            if color.get(n, WHITE) == WHITE:
+                got = visit(n)
+                if got:
+                    return got
+        return None
+
+    def assert_acyclic(self) -> None:
+        cycle = self.find_cycle()
+        if cycle:
+            raise LockOrderError(
+                f"lock acquisition-order cycle {' -> '.join(cycle)}: "
+                f"two threads can take these locks in opposite orders "
+                f"and deadlock; impose one global order "
+                f"(docs/ANALYSIS.md §lock-order)")

@@ -27,8 +27,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m quest_tpu.analysis",
         description="quest-lint: static analyzer for quest_tpu's "
-                    "compiled-path invariants (QL001-QL004; "
-                    "docs/ANALYSIS.md)")
+                    "compiled-path and concurrency invariants "
+                    "(QL001-QL009; docs/ANALYSIS.md)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint (default: the "
                          "repo's quest_tpu/, scripts/ and tests/)")
@@ -55,7 +55,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     violations = run_lint(paths, rules=rules)
 
     if args.format == "json":
-        print(json.dumps([vars(v) for v in violations], indent=2))
+        # stable machine-readable schema: exactly these keys, in this
+        # order, sorted by (path, line, col, rule) like the text form —
+        # CI annotators and scripts/lint.sh --format=json rely on it
+        print(json.dumps([{"rule": v.rule, "path": v.path,
+                           "line": v.line, "col": v.col,
+                           "message": v.message}
+                          for v in violations], indent=2))
     else:
         for v in violations:
             print(v.render(root=os.getcwd()))

@@ -31,6 +31,28 @@ per file with `# quest-lint: disable-file=RULE`):
   QL004  knobs parse loudly — every QUEST_* read in package code
          routes through env.knob_value()'s validating parser, and
          every QUEST_* name read anywhere is registered in env.KNOBS.
+  QL005  lock discipline — a class that owns a threading lock declares
+         a `_GUARDED_BY` table (lock attr -> guarded attrs); guarded
+         attributes may only be touched inside `with self.<lock>` or
+         from private methods the intra-class call graph proves are
+         only reached under it. `# quest-lint: disable=QL005(reason)`
+         escapes are themselves flagged when they suppress nothing.
+  QL006  use-after-donate — calling a donate_argnums-carrying compiled
+         entry (the compiled*/jit dispatch family) consumes the
+         argument buffer; any later use of the donated binding in the
+         same function is the PR-13 deleted-input bug class.
+  QL007  blocking-under-lock — no device syncs (block_until_ready /
+         .item() / np.asarray), time.sleep, subprocess, or file I/O
+         while holding a declared serve/fleet lock (lexically or via
+         a lock-held private method): the watchdog-deadlock class.
+  QL008  atomic-write discipline — write-mode open() in the
+         persistence modules (checkpoint chains, plan cache) must ride
+         the temp+rename commit idiom; a bare final-path write is a
+         torn-resume bug.
+  QL009  fault-site integrity — every literal fired through
+         faults.check()/._fault() names a catalog site, and every
+         faults.SITES entry has >= 1 firing call site in the package
+         and >= 1 test arming it.
 
 The jit-reachability analysis is a conservative intra-package call
 graph: roots are functions decorated with jax.jit (directly or through
@@ -47,8 +69,9 @@ import ast
 import dataclasses
 import io
 import os
+import re
 import tokenize
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 RULES = {
     "QL001": "cache-key completeness: compiled-path knob reads must be "
@@ -58,6 +81,16 @@ RULES = {
              "jit-reachable code",
     "QL004": "knobs parse loudly: QUEST_* reads route through the "
              "registry's validating parser",
+    "QL005": "lock discipline: _GUARDED_BY attributes are only touched "
+             "under their declared lock (or from lock-held methods)",
+    "QL006": "use-after-donate: a donated dispatch input must not be "
+             "used again in the same function",
+    "QL007": "blocking-under-lock: no device syncs, sleeps, subprocess "
+             "or file I/O while holding a serve/fleet lock",
+    "QL008": "atomic-write discipline: persistence-module writes ride "
+             "the temp+rename commit idiom",
+    "QL009": "fault-site integrity: fired sites are cataloged, every "
+             "catalog site is fired and armed by a test",
 }
 
 _DISABLE_MARK = "quest-lint:"
@@ -74,6 +107,37 @@ _HOF_NAMES = {"map", "scan", "fori_loop", "while_loop", "cond", "switch",
 
 # conversions that force a traced value onto the host (QL003)
 _CONVERSIONS = {"float", "int", "bool", "complex"}
+
+# suppression grammar: RULE or RULE(reason). Reason-carrying
+# suppressions are AUDITED — one that suppresses nothing is itself
+# flagged (QL005's reviewed-escape contract); bare ones keep the
+# original fire-and-forget semantics.
+_SUPP_RE = re.compile(r"(QL\d{3})\s*(?:\(([^)]*)\))?")
+
+# QL005: lock constructors recognized in __init__, and the reserved
+# _GUARDED_BY key for single-owner-thread (lock-free by contract)
+# attributes. A "|"-joined key ("_lock|_cond") means entering a `with`
+# on ANY of the named attributes counts as holding the scope
+# (Condition(self._lock) wraps the same lock).
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_OWNER_KEY = "<owner-thread>"
+
+# QL006: compiled-entry factories whose donate=True result consumes its
+# input buffer, per the circuit/sharded dispatch family's contract
+_DONATING_FACTORIES = {
+    "compiled", "compiled_banded", "compiled_fused", "compiled_sharded",
+    "compiled_sharded_banded", "compiled_sharded_fused",
+}
+
+# QL008: the modules whose on-disk artifacts power crash recovery —
+# every write-mode open here must ride the temp+rename commit idiom
+_PERSISTENCE_MODULES = {
+    "quest_tpu.checkpoint", "quest_tpu.plan",
+    "quest_tpu.resilience.durable",
+}
+
+# QL009: fault-site-shaped string literals ("serve.dispatch")
+_SITE_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +168,33 @@ class _EnvRead:
 
 
 @dataclasses.dataclass
+class _AttrAccess:
+    """One `self.<attr>` touch inside a class body (QL005)."""
+    attr: str
+    line: int
+    col: int
+    method: Optional[str]   # enclosing function qualname
+    write: bool
+    locks: FrozenSet[str]   # self-lock names lexically held at the site
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    """Per-class index for the lock-discipline rules (QL005/QL007)."""
+    name: str
+    line: int
+    guarded_by: Optional[Dict[str, Tuple[str, ...]]] = None
+    guarded_line: int = 0
+    guard_parse_error: Optional[str] = None
+    lock_attrs: Dict[str, int] = dataclasses.field(default_factory=dict)
+    methods: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[_AttrAccess] = dataclasses.field(default_factory=list)
+    # (caller root method, callee bare name, locks held at site, line)
+    self_calls: List[Tuple[str, str, FrozenSet[str], int]] = \
+        dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class _FuncInfo:
     qualname: str
     line: int
@@ -114,6 +205,15 @@ class _FuncInfo:
     jit_root: bool = False
     kernel_root: bool = False
     parent: Optional[str] = None       # enclosing function qualname
+    node: Optional[ast.AST] = None     # the def node (QL006 re-walk)
+    # QL006: local names bound to donate-carrying compiled entries
+    # (name -> donated positional indices), and the taint sites where
+    # such an entry consumed a binding: (binding, line, col)
+    donating: Dict[str, Tuple[int, ...]] = dataclasses.field(
+        default_factory=dict)
+    donate_taints: List[Tuple[str, int, int]] = dataclasses.field(
+        default_factory=list)
+    has_rename: bool = False           # os.rename/os.replace (QL008)
     # names with positive evidence of being tracers: assigned from a
     # jnp/lax call, or non-static parameters of a jit-root function
     traced_names: Set[str] = dataclasses.field(default_factory=set)
@@ -145,8 +245,21 @@ class _FileModel:
         self.conversion_sites: List[Tuple[ast.AST, Optional[str]]] = []
         self.kernel_sites: List[Tuple[ast.AST, Optional[str]]] = []
         self.uses_pallas = "pallas" in source
-        self.suppressed_lines: Dict[int, Set[str]] = {}
-        self.suppressed_file: Set[str] = set()
+        # line -> {rule: reason-or-None}; file-level: rule -> (reason, line)
+        self.suppressed_lines: Dict[int, Dict[str, Optional[str]]] = {}
+        self.suppressed_file: Dict[str, Tuple[Optional[str], int]] = {}
+        # QL005/QL007 class index; QL007 candidate blocking calls:
+        # (node, func, locks held, class name, human label)
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.blocking_sites: List[Tuple[ast.Call, Optional[str],
+                                        FrozenSet[str], str, str]] = []
+        # QL008: write-mode opens (node, func qualname)
+        self.write_opens: List[Tuple[ast.Call, Optional[str]]] = []
+        # QL009: fired/armed fault-site literals + the scanned catalog
+        self.fault_fires: List[Tuple[str, int, int]] = []
+        self.fault_arms: Set[str] = set()
+        self.site_strings: Set[str] = set()
+        self.sites_catalog: Optional[Tuple[Tuple[str, ...], int]] = None
         self._scan_suppressions()
 
     def _scan_suppressions(self) -> None:
@@ -161,21 +274,27 @@ class _FileModel:
                     continue
                 body = text[len(_DISABLE_MARK):].strip()
                 if body.startswith("disable-file="):
-                    rules = body[len("disable-file="):]
-                    self.suppressed_file.update(
-                        r.strip() for r in rules.split(",") if r.strip())
+                    spec = body[len("disable-file="):]
+                    for rule, reason in _SUPP_RE.findall(spec):
+                        self.suppressed_file[rule] = (
+                            reason or None, tok.start[0])
                 elif body.startswith("disable="):
-                    rules = body[len("disable="):]
-                    self.suppressed_lines.setdefault(
-                        tok.start[0], set()).update(
-                        r.strip() for r in rules.split(",") if r.strip())
+                    spec = body[len("disable="):]
+                    # trailing comment guards its own line; a comment-
+                    # only line guards the line below it
+                    line = tok.start[0]
+                    if not tok.line[:tok.start[1]].strip():
+                        line += 1
+                    entry = self.suppressed_lines.setdefault(line, {})
+                    for rule, reason in _SUPP_RE.findall(spec):
+                        entry[rule] = reason or None
         except tokenize.TokenError:        # pragma: no cover - parse guard
             pass
 
     def suppressed(self, rule: str, line: int) -> bool:
         if rule in self.suppressed_file:
             return True
-        return rule in self.suppressed_lines.get(line, set())
+        return rule in self.suppressed_lines.get(line, {})
 
 
 def _module_name_for(path: str, root: str) -> Optional[str]:
@@ -247,6 +366,68 @@ def _static_names_from_jit(call: ast.Call) -> Set[str]:
     return out
 
 
+def _parse_guarded_by(node: ast.AST):
+    """Parse a `_GUARDED_BY` class annotation: a dict literal mapping a
+    lock attribute name (``"_lock"``, the alias form ``"_lock|_cond"``
+    for a Condition wrapping the same Lock, or the reserved
+    ``"<owner-thread>"`` for single-owner lock-free state) to a
+    tuple/list/set of guarded attribute names.  Returns
+    ``(mapping, error)`` — exactly one is None."""
+    if not isinstance(node, ast.Dict):
+        return None, "_GUARDED_BY must be a dict literal"
+    out: Dict[str, Tuple[str, ...]] = {}
+    for k, v in zip(node.keys, node.values):
+        key = _const_str(k) if k is not None else None
+        if key is None:
+            return None, "_GUARDED_BY keys must be string literals"
+        if not isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            return None, (f"_GUARDED_BY[{key!r}] must be a tuple/list/set "
+                          "of attribute-name literals")
+        attrs: List[str] = []
+        for e in v.elts:
+            s = _const_str(e)
+            if s is None:
+                return None, (f"_GUARDED_BY[{key!r}] must contain only "
+                              "string literals")
+            attrs.append(s)
+        out[key] = tuple(attrs)
+    return out, None
+
+
+def _donate_positions_of(call: ast.AST) -> Tuple[int, ...]:
+    """Donated positional indices of the compiled entry a call
+    expression builds, or () when it donates nothing.  Recognizes the
+    circuit compile factories (`compiled*(..., donate=True)` — they
+    donate position 0, the amplitude planes) and literal
+    `jax.jit(..., donate_argnums=...)`.  A conditional
+    `donate_argnums=(0,) if donate else ()` is deliberately treated as
+    non-donating: the call sites guard themselves."""
+    if not isinstance(call, ast.Call):
+        return ()
+    leaf = (_dotted(call.func) or "").split(".")[-1]
+    if leaf in _DONATING_FACTORIES:
+        for kw in call.keywords:
+            if kw.arg == "donate" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return (0,)
+        return ()
+    if leaf == "jit":
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                vals = tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+                if len(vals) == len(v.elts):
+                    return vals
+        return ()
+    return ()
+
+
 class _Collector(ast.NodeVisitor):
     """One pass over a file: functions, call edges, env reads, and the
     QL002/QL003 site indexes."""
@@ -254,6 +435,8 @@ class _Collector(ast.NodeVisitor):
     def __init__(self, model: _FileModel):
         self.m = model
         self.stack: List[str] = []      # function qualname stack
+        self.class_stack: List[_ClassInfo] = []
+        self.lock_stack: List[str] = []  # self-lock names lexically held
 
     # -- imports ----------------------------------------------------------
     def visit_Import(self, node: ast.Import) -> None:
@@ -274,11 +457,68 @@ class _Collector(ast.NodeVisitor):
                 self.m.from_imports[local] = (node.module, alias.name)
         self.generic_visit(node)
 
+    # -- classes (QL005/QL007 lock index) ---------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qual = ".".join([c.name for c in self.class_stack] + [node.name]) \
+            if self.class_stack else node.name
+        ci = _ClassInfo(name=qual, line=node.lineno)
+        for stmt in node.body:
+            tgt = val = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tgt, val = stmt.targets[0].id, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.value is not None:
+                tgt, val = stmt.target.id, stmt.value
+            if tgt == "_GUARDED_BY":
+                ci.guarded_line = stmt.lineno
+                ci.guarded_by, ci.guard_parse_error = \
+                    _parse_guarded_by(val)
+        self.m.classes[qual] = ci
+        self.class_stack.append(ci)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _handle_with(self, node) -> None:
+        pushed = 0
+        for item in node.items:
+            d = _dotted(item.context_expr)
+            if d and d.startswith("self.") and d.count(".") == 1:
+                self.lock_stack.append(d.split(".", 1)[1])
+                pushed += 1
+        self.generic_visit(node)
+        if pushed:
+            del self.lock_stack[-pushed:]
+
+    visit_With = _handle_with
+    visit_AsyncWith = _handle_with
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.class_stack and self.stack \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            self.class_stack[-1].accesses.append(_AttrAccess(
+                node.attr, node.lineno, node.col_offset,
+                self.stack[-1], isinstance(node.ctx, (ast.Store, ast.Del)),
+                frozenset(self.lock_stack)))
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # QL009 arming evidence: site-shaped string literals
+        v = node.value
+        if isinstance(v, str) and 2 < len(v) < 64 and "." in v \
+                and _SITE_RE.match(v):
+            self.m.site_strings.add(v)
+
     # -- functions --------------------------------------------------------
     def _handle_func(self, node) -> None:
         qual = ".".join(self.stack + [node.name]) if self.stack else node.name
         info = _FuncInfo(qualname=qual, line=node.lineno,
-                         parent=self.stack[-1] if self.stack else None)
+                         parent=self.stack[-1] if self.stack else None,
+                         node=node)
+        if self.class_stack and not self.stack:
+            self.class_stack[-1].methods.add(node.name)
         a = node.args
         info.params = [x.arg for x in
                        (list(getattr(a, "posonlyargs", [])) + list(a.args)
@@ -432,7 +672,106 @@ class _Collector(ast.NodeVisitor):
                     "astype", "rem", "div") or leaf in _I64_NAMES:
             self.m.kernel_sites.append((node, cur))
 
+        head = dotted.split(".")[0] if dotted else ""
+
+        # QL008: temp+rename evidence and write-mode opens
+        if cur and head == "os" and leaf in ("rename", "replace"):
+            self.m.funcs[cur].has_rename = True
+        if dotted == "open":
+            mode = _const_str(node.args[1]) if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = _const_str(kw.value) or mode
+            if mode and any(c in mode for c in "wax+"):
+                self.m.write_opens.append((node, cur))
+        elif leaf in ("write_text", "write_bytes") and "." in dotted:
+            self.m.write_opens.append((node, cur))
+
+        # QL005: self-method call edges with their lexical lock context
+        if self.class_stack and self.stack and dotted.startswith("self.") \
+                and dotted.count(".") == 1:
+            self.class_stack[-1].self_calls.append(
+                (self.stack[0], dotted.split(".", 1)[1],
+                 frozenset(self.lock_stack), node.lineno))
+
+        # QL007: candidate blocking calls inside lock-owning classes
+        if self.class_stack and self.stack:
+            label = self._blocking_label(dotted, leaf, head)
+            if label:
+                self.m.blocking_sites.append(
+                    (node, cur, frozenset(self.lock_stack),
+                     self.class_stack[-1].name, label))
+
+        # QL009: fired / armed fault-site literals
+        s0 = _const_str(node.args[0]) if node.args else None
+        if s0:
+            if leaf == "check" and dotted.endswith(".check"):
+                recv = dotted[:-len(".check")]
+                rmod = self.m.import_alias.get(recv, recv)
+                if rmod.split(".")[-1] == "faults":
+                    self.m.fault_fires.append(
+                        (s0, node.lineno, node.col_offset))
+            elif dotted == "self._fault":
+                self.m.fault_fires.append(
+                    (s0, node.lineno, node.col_offset))
+            elif leaf == "inject":
+                self.m.fault_arms.add(s0)
+            elif leaf == "parse_plan":
+                for part in s0.split(";"):
+                    site = part.split(":", 1)[0].strip()
+                    if site:
+                        self.m.fault_arms.add(site)
+
+        # QL006: a call through a donate-carrying compiled entry taints
+        # the bindings it consumes
+        if cur and isinstance(node.func, ast.Name):
+            positions = self._donating_positions(node.func.id)
+            if positions:
+                f = self.m.funcs[cur]
+                end = getattr(node, "end_lineno", node.lineno)
+                for p in positions:
+                    if p < len(node.args):
+                        b = _dotted(node.args[p])
+                        if b:
+                            f.donate_taints.append(
+                                (b, node.lineno, node.col_offset, end))
+
         self.generic_visit(node)
+
+    def _blocking_label(self, dotted: str, leaf: str,
+                        head: str) -> Optional[str]:
+        """Human label when the call blocks (QL007), else None."""
+        if leaf == "block_until_ready":
+            return "jax.block_until_ready (device sync)"
+        if dotted == "time.sleep" or (
+                dotted == "sleep"
+                and self.m.from_imports.get("sleep", ("", ""))[0]
+                == "time"):
+            return "time.sleep"
+        mod = self.m.import_alias.get(head, head)
+        if mod.split(".")[0] == "subprocess" and "." in dotted:
+            return f"{dotted} (subprocess)"
+        if dotted == "open":
+            return "open() file I/O"
+        if leaf == "item" and "." in dotted:
+            return ".item() (device sync)"
+        if dotted in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array"):
+            return f"{dotted} (host materialization)"
+        return None
+
+    def _donating_positions(self, name: str) -> Tuple[int, ...]:
+        """Donated positional indices when `name` is locally bound to a
+        donate-carrying compiled entry (scope chain, like locals)."""
+        scope = self.stack[-1] if self.stack else None
+        while scope:
+            info = self.m.funcs.get(scope)
+            if info is None:
+                break
+            if name in info.donating:
+                return info.donating[name]
+            scope = info.parent
+        return ()
 
     def _jax_numeric_call(self, node: ast.AST) -> bool:
         """Whether `node` is a call into jax/jnp/lax (its result is a
@@ -457,6 +796,14 @@ class _Collector(ast.NodeVisitor):
                     for t in targets:
                         if isinstance(t, ast.Name):
                             f.local_callables[t.id] = name
+            # QL006: `fn = circ.compiled_fused(..., donate=True)` /
+            # `fn = jax.jit(g, donate_argnums=(0,))` binds a
+            # buffer-consuming entry
+            positions = _donate_positions_of(value)
+            if positions:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        f.donating[t.id] = positions
         if not self._jax_numeric_call(value):
             return
         for t in targets:
@@ -467,6 +814,30 @@ class _Collector(ast.NodeVisitor):
 
     def visit_Assign(self, node: ast.Assign) -> None:
         self._handle_assign_value(node.targets, node.value)
+        # QL005: lock attributes created in __init__
+        if self.class_stack and self.stack \
+                and self.stack[0] == "__init__" \
+                and isinstance(node.value, ast.Call):
+            leaf = (_dotted(node.value.func) or "").split(".")[-1]
+            if leaf in _LOCK_FACTORIES:
+                for t in node.targets:
+                    d = _dotted(t)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        self.class_stack[-1].lock_attrs[
+                            d.split(".", 1)[1]] = node.lineno
+        # QL009: the module-level fault-site catalog (faults.SITES)
+        if not self.stack and not self.class_stack \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SITES" \
+                and isinstance(node.value, (ast.Tuple, ast.List)) \
+                and os.path.basename(self.m.path) == "faults.py":
+            elts = node.value.elts
+            vals = tuple(e.value for e in elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+            if vals and len(vals) == len(elts):
+                self.m.sites_catalog = (vals, node.lineno)
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
@@ -791,6 +1162,322 @@ def _check_ql004(models: Dict[str, _FileModel],
 
 
 # ---------------------------------------------------------------------------
+# QL005 — lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _lock_groups(ci: _ClassInfo) -> Dict[str, FrozenSet[str]]:
+    """guarded-by key -> the set of lock attr names that satisfy it
+    (the `"_lock|_cond"` alias form accepts either)."""
+    return {key: frozenset(key.split("|"))
+            for key in (ci.guarded_by or {}) if key != _OWNER_KEY}
+
+
+def _held_methods(ci: _ClassInfo, group: FrozenSet[str]) -> Set[str]:
+    """Methods provably only reached with a lock of `group` held:
+    greatest fixed point over the intra-class call graph.  Seeded with
+    private helpers that have at least one internal call site; a method
+    is demoted when any call site lacks the lock and the caller is not
+    itself held.  Public methods never qualify — external callers
+    don't hold the lock."""
+    callees = {c for (_caller, c, _locks, _ln) in ci.self_calls}
+    held = {name for name in ci.methods
+            if name.startswith("_") and not name.startswith("__")
+            and name in callees}
+    changed = True
+    while changed:
+        changed = False
+        for (caller, callee, locks, _ln) in ci.self_calls:
+            if callee not in held:
+                continue
+            if locks & group:
+                continue
+            if caller in held:
+                continue
+            held.discard(callee)
+            changed = True
+    return held
+
+
+def _check_ql005(models: Dict[str, _FileModel],
+                 out: List[Violation]) -> None:
+    for mod, m in models.items():
+        for ci in m.classes.values():
+            if ci.guard_parse_error:
+                out.append(Violation(
+                    "QL005", m.path, ci.guarded_line, 0,
+                    f"malformed _GUARDED_BY on {ci.name}: "
+                    f"{ci.guard_parse_error}"))
+                continue
+            if ci.guarded_by is None:
+                # classes that own a lock must declare what it guards
+                if ci.lock_attrs:
+                    lock, line = sorted(ci.lock_attrs.items(),
+                                        key=lambda kv: kv[1])[0]
+                    out.append(Violation(
+                        "QL005", m.path, line, 0,
+                        f"{ci.name} creates self.{lock} but declares no "
+                        f"_GUARDED_BY: list the attributes the lock "
+                        f"guards (see docs/ANALYSIS.md)"))
+                continue
+            groups = _lock_groups(ci)
+            guarded: Dict[str, FrozenSet[str]] = {}
+            for key, attrs in ci.guarded_by.items():
+                if key == _OWNER_KEY:
+                    for a in attrs:
+                        guarded[a] = frozenset()
+                    continue
+                locks = groups[key]
+                if not locks & set(ci.lock_attrs):
+                    out.append(Violation(
+                        "QL005", m.path, ci.guarded_line, 0,
+                        f"_GUARDED_BY key {key!r} on {ci.name} names no "
+                        f"lock created in __init__ "
+                        f"(have: {sorted(ci.lock_attrs) or 'none'})"))
+                    continue
+                for a in attrs:
+                    guarded[a] = locks
+            held_cache: Dict[FrozenSet[str], Set[str]] = {}
+            declared = set(guarded) | set(ci.lock_attrs)
+            for acc in ci.accesses:
+                if acc.method and acc.method.split(".")[0] == "__init__":
+                    continue  # construction happens-before publication
+                locks = guarded.get(acc.attr)
+                if locks is None:
+                    # completeness: writes to undeclared shared attrs
+                    if acc.write and acc.attr not in declared \
+                            and not acc.attr.startswith("__"):
+                        out.append(Violation(
+                            "QL005", m.path, acc.line, acc.col,
+                            f"{ci.name}.{acc.attr} is written outside "
+                            f"__init__ but missing from _GUARDED_BY: "
+                            f"declare its lock (or put it under "
+                            f"'<owner-thread>' if single-owner)"))
+                    continue
+                if not locks:
+                    continue  # <owner-thread>: trusted single-owner
+                if acc.locks & locks:
+                    continue
+                root = acc.method.split(".")[0] if acc.method else None
+                if locks not in held_cache:
+                    held_cache[locks] = _held_methods(ci, locks)
+                if root in held_cache[locks]:
+                    continue
+                kind = "write to" if acc.write else "read of"
+                out.append(Violation(
+                    "QL005", m.path, acc.line, acc.col,
+                    f"unlocked {kind} {ci.name}.{acc.attr}: "
+                    f"_GUARDED_BY says hold self.{sorted(locks)[0]} "
+                    f"(wrap in `with self.{sorted(locks)[0]}:` or call "
+                    f"from a lock-held helper)"))
+
+
+# ---------------------------------------------------------------------------
+# QL006 — use-after-donate
+# ---------------------------------------------------------------------------
+
+
+def _first_use_after_donate(f: _FuncInfo, binding: str, taint_line: int,
+                            end_line: int) -> Optional[Tuple[int, int]]:
+    """(line, col) of the first Load of `binding` (or its root name)
+    after the donating call, unless a rebind/del of the name between
+    the taint and the use clears it (`amps = fn(amps)` is the blessed
+    idiom).  Conservative per-function, line-ordered."""
+    root = binding.split(".")[0]
+    stores: List[int] = []
+    loads: List[Tuple[int, int]] = []
+    for n in ast.walk(f.node):
+        if isinstance(n, ast.Name) and n.id == root:
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                stores.append(n.lineno)
+            elif n.lineno > end_line:
+                # the donated binding itself, or any dotted use of it
+                loads.append((n.lineno, n.col_offset))
+        elif isinstance(n, ast.Attribute) \
+                and isinstance(n.ctx, (ast.Store, ast.Del)) \
+                and _dotted(n) == binding:
+            stores.append(n.lineno)
+    for line, col in sorted(loads):
+        if any(taint_line <= s <= line for s in stores):
+            return None  # rebound before (or at) this use: cleared
+        if "." in binding:
+            # dotted binding (state.amps): only a matching dotted load
+            # counts — the root object itself stays valid
+            continue
+        return (line, col)
+    if "." in binding:
+        # re-walk for the exact dotted expression in Load context
+        for n in ast.walk(f.node):
+            if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                    and n.lineno > end_line and _dotted(n) == binding:
+                if not any(taint_line <= s <= n.lineno for s in stores):
+                    return (n.lineno, n.col_offset)
+    return None
+
+
+def _check_ql006(models: Dict[str, _FileModel],
+                 out: List[Violation]) -> None:
+    for mod, m in models.items():
+        for f in m.funcs.values():
+            if not f.donate_taints or f.node is None:
+                continue
+            for (binding, line, col, end) in f.donate_taints:
+                hit = _first_use_after_donate(f, binding, line, end)
+                if hit:
+                    out.append(Violation(
+                        "QL006", m.path, hit[0], hit[1],
+                        f"use of {binding} after it was donated to a "
+                        f"compiled entry at line {line}: the buffer is "
+                        f"deleted on dispatch (the PR-13 run_evolution "
+                        f"bug); copy before the call or rebind the "
+                        f"result"))
+
+
+# ---------------------------------------------------------------------------
+# QL007 — blocking calls under a serve/fleet lock
+# ---------------------------------------------------------------------------
+
+
+def _check_ql007(models: Dict[str, _FileModel],
+                 out: List[Violation]) -> None:
+    for mod, m in models.items():
+        for (node, func, locks, cls, label) in m.blocking_sites:
+            ci = m.classes.get(cls)
+            if ci is None or not ci.lock_attrs:
+                continue
+            own = set(ci.lock_attrs)
+            held = locks & own
+            root = func.split(".")[0] if func else None
+            if not held and root is not None:
+                # call-graph propagation: a private helper only ever
+                # entered with the lock held blocks just the same
+                for group in (set(_lock_groups(ci).values())
+                              or {frozenset(own)}):
+                    if root in _held_methods(ci, group):
+                        held = group & own
+                        break
+            if not held:
+                continue
+            if root == "__init__":
+                continue
+            lock = sorted(held)[0]
+            out.append(Violation(
+                "QL007", m.path, node.lineno, node.col_offset,
+                f"{label} while holding self.{lock} in {cls}: every "
+                f"other thread contending for the lock stalls behind "
+                f"this call (the watchdog-deadlock class); move it "
+                f"outside the critical section"))
+
+
+# ---------------------------------------------------------------------------
+# QL008 — atomic-write discipline in persistence modules
+# ---------------------------------------------------------------------------
+
+
+def _check_ql008(models: Dict[str, _FileModel],
+                 out: List[Violation]) -> None:
+    for mod, m in models.items():
+        if m.module not in _PERSISTENCE_MODULES:
+            continue
+        for (node, func) in m.write_opens:
+            chain = _enclosing_chain(m, func)
+            # the temp+rename idiom: any function on the enclosing
+            # chain whose subtree performs os.replace/os.rename makes
+            # the write crash-atomic (write tmp, fsync, rename)
+            safe = any(m.funcs[q].has_rename for q in chain
+                       if q in m.funcs)
+            if not safe and func is not None:
+                # nested helpers: the top-level enclosing def may carry
+                # the rename while the helper does the open
+                top = chain[-1] if chain else func
+                info = m.funcs.get(top)
+                if info is not None and info.node is not None:
+                    safe = any(
+                        isinstance(n, ast.Call)
+                        and (_dotted(n.func) or "") in
+                        ("os.rename", "os.replace")
+                        for n in ast.walk(info.node))
+            if safe:
+                continue
+            out.append(Violation(
+                "QL008", m.path, node.lineno, node.col_offset,
+                f"bare write in {m.module} outside a temp+rename "
+                f"scope: a crash mid-write leaves a torn file the "
+                f"resume path will read (PR-12 gang-tmp class); write "
+                f"to a tmp name and os.replace() into place"))
+
+
+# ---------------------------------------------------------------------------
+# QL009 — fault-site catalog integrity
+# ---------------------------------------------------------------------------
+
+
+def _is_test_file(m: _FileModel, root: str) -> bool:
+    rel = os.path.relpath(m.path, root)
+    base = os.path.basename(m.path)
+    return rel.split(os.sep)[0] == "tests" and (
+        base.startswith("test_") or base == "conftest.py")
+
+
+def _site_catalog(models: Dict[str, _FileModel]):
+    """(sites, path, line) from the scanned faults.py, else from the
+    importable package (single-file lint runs still validate literals
+    against the real catalog), else None."""
+    for m in models.values():
+        if m.sites_catalog is not None:
+            return m.sites_catalog[0], m.path, m.sites_catalog[1]
+    try:
+        from quest_tpu.resilience import faults as _faults
+        return tuple(_faults.SITES), None, 0
+    except Exception:                      # pragma: no cover - import guard
+        return None
+
+
+def _check_ql009(models: Dict[str, _FileModel], root: str,
+                 out: List[Violation]) -> None:
+    cat = _site_catalog(models)
+    if cat is None:                        # pragma: no cover - import guard
+        return
+    sites, cat_path, cat_line = cat
+    known = set(sites)
+    fires: Dict[str, int] = {}
+    arms: Set[str] = set()
+    have_tests = False
+    for mod, m in models.items():
+        if _is_test_file(m, root):
+            have_tests = True
+            arms |= m.fault_arms
+            arms |= {s for s in m.site_strings if s in known}
+        for (site, line, col) in m.fault_fires:
+            fires[site] = fires.get(site, 0) + 1
+            if site not in known:
+                out.append(Violation(
+                    "QL009", m.path, line, col,
+                    f"fault site {site!r} is not in faults.SITES: a "
+                    f"typo here makes the injection plan silently "
+                    f"never fire; add it to the catalog or fix the "
+                    f"literal"))
+    # coverage legs only when the catalog itself and the test tree are
+    # both in scope (single-file runs stay literal-validation only)
+    if cat_path is None or not have_tests:
+        return
+    for site in sites:
+        if site not in fires:
+            out.append(Violation(
+                "QL009", cat_path, cat_line, 0,
+                f"catalog site {site!r} has no firing call site "
+                f"(faults.check/self._fault literal) anywhere in the "
+                f"tree: dead catalog entries rot into armed-but-"
+                f"silent pins"))
+        if site not in arms:
+            out.append(Violation(
+                "QL009", cat_path, cat_line, 0,
+                f"catalog site {site!r} is never armed by any test "
+                f"(no inject()/parse_plan()/literal in tests/): the "
+                f"failure path it guards is untested"))
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -855,10 +1542,52 @@ def run_lint(paths: Sequence[str],
         _check_ql003(models, reach, violations)
     if "QL004" in active:
         _check_ql004(models, violations)
+    if "QL005" in active:
+        _check_ql005(models, violations)
+    if "QL006" in active:
+        _check_ql006(models, violations)
+    if "QL007" in active:
+        _check_ql007(models, violations)
+    if "QL008" in active:
+        _check_ql008(models, violations)
+    if "QL009" in active:
+        _check_ql009(models, root, violations)
 
     by_path = {m.path: m for m in models.values()}
-    kept = [v for v in violations
-            if not (v.path in by_path
-                    and by_path[v.path].suppressed(v.rule, v.line))]
+    used: Set[Tuple[str, int, str]] = set()
+    kept: List[Violation] = []
+    for v in violations:
+        m = by_path.get(v.path)
+        if m is not None and m.suppressed(v.rule, v.line):
+            if v.rule in m.suppressed_lines.get(v.line, {}):
+                used.add((v.path, v.line, v.rule))
+            else:
+                used.add((v.path, -1, v.rule))
+            continue
+        kept.append(v)
+    # audited escapes: a reasoned `disable=QLnnn(reason)` that
+    # suppresses nothing is itself flagged — stale escapes are how
+    # real violations sneak back in. Bare (reasonless) suppressions
+    # keep the old fire-and-forget semantics.
+    for m in by_path.values():
+        for line, entry in m.suppressed_lines.items():
+            for rule, reason in entry.items():
+                if reason is None or rule not in active:
+                    continue
+                if (m.path, line, rule) not in used:
+                    kept.append(Violation(
+                        rule, m.path, line, 0,
+                        f"unused suppression disable={rule}({reason}): "
+                        f"no {rule} violation on this line; remove the "
+                        f"stale escape"))
+        for rule, (reason, line) in m.suppressed_file.items():
+            if reason is None or rule not in active:
+                continue
+            if (m.path, -1, rule) not in used:
+                kept.append(Violation(
+                    rule, m.path, line, 0,
+                    f"unused suppression disable-file={rule}({reason}): "
+                    f"no {rule} violation in this file; remove the "
+                    f"stale escape"))
     kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return kept

@@ -927,8 +927,13 @@ def save_sharded(qureg: Qureg, directory: str,
     ocp = _orbax()
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    with open(os.path.join(directory, _META_NAME), "w") as f:
+    # temp+rename so a crash mid-write can never leave a torn meta the
+    # resume path would half-parse (quest-lint QL008)
+    meta_path = os.path.join(directory, _META_NAME)
+    tmp = meta_path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(_meta(qureg), f)
+    os.replace(tmp, meta_path)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(directory, _ORBAX_DIR), {"amps": qureg.amps},
                force=True)

@@ -178,6 +178,8 @@ class FaultPlan:
     Thread-safe: hit counters mutate under one lock (client threads hit
     sharded.dispatch while the serve worker hits the serve.* sites)."""
 
+    _GUARDED_BY = {"_lock": ("_rules",)}
+
     def __init__(self):
         self._rules: Dict[str, List[_Rule]] = {}
         self._lock = threading.Lock()
@@ -199,12 +201,14 @@ class FaultPlan:
             raise ValueError(f"times must be >= 1, got {times}")
         if p is not None and not (0.0 <= p <= 1.0):
             raise ValueError(f"p must be in [0, 1], got {p}")
-        self._rules.setdefault(site, []).append(
-            _Rule(site, error, after_n, every_n, times, p, match, seed))
+        with self._lock:
+            self._rules.setdefault(site, []).append(
+                _Rule(site, error, after_n, every_n, times, p, match, seed))
         return self
 
     @property
     def empty(self) -> bool:
+        # quest-lint: disable=QL005(truthiness of a dict is one atomic read)
         return not self._rules
 
     def fired(self, site: Optional[str] = None) -> int:
@@ -215,6 +219,7 @@ class FaultPlan:
             return sum(r.fired for r in rules)
 
     def check(self, site: str, ctx: dict) -> None:
+        # quest-lint: disable=QL005(lock-free fast path: dict.get is atomic, plans arm before workers start)
         rules = self._rules.get(site)
         if not rules:
             return

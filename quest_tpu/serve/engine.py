@@ -171,6 +171,19 @@ class ServeEngine:
     fault-site context it fires (`ctx["replica"]`) so fleet soaks can
     target one replica deterministically (docs/SERVING.md §fleet)."""
 
+    # the one engine lock: `_cond` wraps `_lock`, so holding either
+    # names the same mutex (quest-lint QL005, docs/ANALYSIS.md)
+    _GUARDED_BY = {
+        "_lock|_cond": ("_queues", "_pending", "_inflight", "_drainers",
+                        "_closed", "_stop", "_failure_cause", "_state",
+                        "_active", "_active_failed", "_worker_gen",
+                        "_worker", "_watch", "_watch_seq", "_watchdog"),
+        # the breaker map is worker-generation-owned: only the live
+        # worker (or the watchdog superseding a provably-stuck one)
+        # touches it, never two threads at once
+        "<owner-thread>": ("_breakers",),
+    }
+
     def __init__(self, *, max_wait_ms: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  max_batch: Optional[int] = None,
@@ -286,8 +299,10 @@ class ServeEngine:
     @property
     def state(self) -> str:
         """'running' | 'failed' (restart budget exhausted) | 'closed'."""
+        # quest-lint: disable=QL005(observability fast path: racy flag read, never blocks behind a dispatch)
         if self._closed:
             return "closed"
+        # quest-lint: disable=QL005(same racy-read contract as _closed above)
         return self._state
 
     def plan(self, circuit, *, batch: Optional[int] = None,
@@ -563,7 +578,8 @@ class ServeEngine:
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        self._worker.join(timeout=timeout_s)
+            worker = self._worker   # snapshot: supervision may respawn
+        worker.join(timeout=timeout_s)
 
     def __enter__(self) -> "ServeEngine":
         return self
@@ -1233,6 +1249,7 @@ class ServeEngine:
         import jax
 
         t_pop = time.monotonic()
+        # quest-lint: disable=QL005(racy generation read IS the supersession design)
         gen0 = self._worker_gen     # breaker-success guard (watchdog)
         n = (q.circuit.num_qubits * 2 if q.density
              else q.circuit.num_qubits)
@@ -1257,6 +1274,7 @@ class ServeEngine:
         if _F.ACTIVE:
             self._fault("serve.dispatch", reqs=reqs)
         out_dev = jax.block_until_ready(fn(batch))
+        # quest-lint: disable=QL005(racy generation read IS the supersession design)
         if primary and gen0 == self._worker_gen:
             # generation-guarded like every other stale-worker mutation:
             # a slow-but-not-stuck launch that unsticks AFTER the
@@ -1306,6 +1324,7 @@ class ServeEngine:
         import jax.numpy as jnp
 
         t_pop = time.monotonic()
+        # quest-lint: disable=QL005(racy generation read IS the supersession design)
         gen0 = self._worker_gen     # breaker-success guard (watchdog)
         n = q.circuit.num_qubits
         total = sum(r.shots for r in reqs)
@@ -1412,6 +1431,7 @@ class ServeEngine:
                     dead.add(i)
                     self._fail_request(r, e)
             launches += 1
+        # quest-lint: disable=QL005(racy generation read IS the supersession design)
         if primary and gen0 == self._worker_gen:
             # the apply path's stale-worker breaker guard, same rationale
             br.record_success()

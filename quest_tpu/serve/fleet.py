@@ -137,6 +137,13 @@ class ServeFleet:
     to the process-wide one and is SHARED with every replica, so one
     snapshot/scrape covers the fleet."""
 
+    # the fleet RLock (reentrant: shed-eviction callbacks re-enter it)
+    # and what it guards (quest-lint QL005, docs/ANALYSIS.md)
+    _GUARDED_BY = {
+        "_lock": ("_affinity", "_pending", "_tenant_pending", "_seq",
+                  "_rr", "_failed_noted", "_closed", "_failure_cause"),
+    }
+
     def __init__(self, replicas: Optional[int] = None, *,
                  tenant_quota=None,
                  shed_threshold: Optional[float] = None,
@@ -219,6 +226,7 @@ class ServeFleet:
     def state(self) -> str:
         """'running' while any replica serves | 'failed' (every replica
         exhausted its restart budget) | 'closed'."""
+        # quest-lint: disable=QL005(observability fast path: racy flag read, engine.state contract)
         if self._closed:
             return "closed"
         if any(e.state == "running" for e in self._engines):
@@ -706,7 +714,9 @@ class ServeFleet:
         once every future has resolved typed (never hangs)."""
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
-        if self._closed:
+        with self._lock:
+            closed = self._closed
+        if closed:
             raise RejectedError(
                 "Invalid operation: fleet closed — drain() after "
                 "ServeFleet.close() (docs/SERVING.md §fleet).")

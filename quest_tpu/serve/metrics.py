@@ -58,6 +58,7 @@ class Counter:
     """A monotonically increasing integer metric (thread-safe)."""
 
     __slots__ = ("name", "_value", "_lock")
+    _GUARDED_BY = {"_lock": ("_value",)}
 
     def __init__(self, name: str):
         self.name = name
@@ -70,6 +71,7 @@ class Counter:
 
     @property
     def value(self) -> int:
+        # quest-lint: disable=QL005(single int attr load is atomic under the GIL)
         return self._value
 
 
@@ -78,6 +80,7 @@ class Gauge:
     count, queue depth — anything that goes DOWN as well as up."""
 
     __slots__ = ("name", "_value", "_lock")
+    _GUARDED_BY = {"_lock": ("_value",)}
 
     def __init__(self, name: str):
         self.name = name
@@ -98,6 +101,7 @@ class Gauge:
 
     @property
     def value(self) -> float:
+        # quest-lint: disable=QL005(single float attr load is atomic under the GIL)
         return self._value
 
 
@@ -109,6 +113,7 @@ class Histogram:
     time, never on the record path)."""
 
     __slots__ = ("name", "_recent", "_count", "_sum", "_lock")
+    _GUARDED_BY = {"_lock": ("_recent", "_count", "_sum")}
 
     def __init__(self, name: str):
         self.name = name
@@ -126,6 +131,7 @@ class Histogram:
 
     @property
     def count(self) -> int:
+        # quest-lint: disable=QL005(single int attr load is atomic under the GIL)
         return self._count
 
     @property
@@ -134,6 +140,7 @@ class Histogram:
         reads over (count, sum) let a caller derive time-in-phase
         without touching slot internals — bench.py's durable overhead
         fraction reads `durable_checkpoint_s` this way."""
+        # quest-lint: disable=QL005(single float attr load is atomic under the GIL)
         return self._sum
 
     def summary(self) -> Dict[str, float]:
@@ -156,6 +163,8 @@ class Registry:
     """A named set of counters and histograms. Metric creation is
     get-or-create by name, so call sites never coordinate; `snapshot()`
     is the one read API (stable schema, JSON-serializable)."""
+
+    _GUARDED_BY = {"_lock": ("_counters", "_gauges", "_histograms")}
 
     def __init__(self):
         self._counters: Dict[str, Counter] = {}

@@ -10,9 +10,11 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
+# extra flags pass straight through to the analyzer:
+#   scripts/lint.sh --rules QL005,QL007 --format json
 echo "== quest-lint (python -m quest_tpu.analysis) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m quest_tpu.analysis quest_tpu/ scripts/ tests/ || rc=1
+    python -m quest_tpu.analysis quest_tpu/ scripts/ tests/ "$@" || rc=1
 
 echo "== ruff (errors-only baseline) =="
 if command -v ruff >/dev/null 2>&1; then

@@ -81,6 +81,25 @@ def test_resume_bit_identity_banded(tmp_path):
     assert ckpt.step_dirs(d) == []
 
 
+def test_step_fault_mid_run_resumes_bit_identical(tmp_path):
+    """A durable.step failure (the one catalog site no test armed —
+    found by quest-lint QL009) kills the run mid-step, between stamps;
+    the chain must resume bit-identical from the last stamped step."""
+    c = qft_circuit(9)
+    q0 = qt.init_debug_state(qt.create_qureg(9))
+    ref = run_durable(c, q0, str(tmp_path / "ref"), every=2,
+                      engine="banded")
+    d = str(tmp_path / "pre")
+    plan = FaultPlan().inject("durable.step", after_n=5, times=1)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedFault):
+            run_durable(c, q0, d, every=2, engine="banded")
+    assert plan.fired() == 1
+    assert ckpt.step_dirs(d), "mid-step crash left no checkpoint"
+    out = run_durable(c, q0, d, every=2, engine="banded")
+    np.testing.assert_array_equal(amps_of(out), amps_of(ref))
+
+
 @pytest.mark.slow
 def test_resume_bit_identity_fused_interpret(tmp_path):
     # slow-marked (~20 s: three interpret-mode Pallas executions of a

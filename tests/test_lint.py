@@ -47,7 +47,17 @@ def test_tree_is_lint_clean():
 
 
 def test_rule_catalog_is_stable():
-    assert set(RULES) == {"QL001", "QL002", "QL003", "QL004"}
+    assert set(RULES) == {"QL001", "QL002", "QL003", "QL004",
+                          "QL005", "QL006", "QL007", "QL008", "QL009"}
+
+
+def test_rule_subset_filtering_is_backward_compatible():
+    """run_lint(rules=[...]) restricts to exactly the named rules —
+    pre-QL005 callers passing the original four keep their behavior."""
+    paths = [os.path.join(REPO, "quest_tpu", "serve", "metrics.py")]
+    only4 = run_lint(paths, rules=["QL001", "QL002", "QL003", "QL004"])
+    assert not [v for v in only4 if v.rule not in
+                {"QL001", "QL002", "QL003", "QL004"}]
 
 
 # ---------------------------------------------------------------------------
@@ -930,6 +940,396 @@ def test_cli_exit_codes(tmp_path):
     good = pkg / "good.py"
     good.write_text("X = 1\n")
     assert main([str(good)]) == 0
+
+
+def test_cli_json_format_schema(tmp_path, capsys):
+    """--format=json emits the stable machine schema: a list of
+    {rule, path, line, col, message} dicts, same order as the text
+    output."""
+    import json as _json
+
+    from quest_tpu.analysis.cli import main
+    pkg = tmp_path / "quest_tpu"
+    pkg.mkdir()
+    bad = pkg / "bad.py"
+    bad.write_text("import os\n\n"
+                   "def f():\n"
+                   "    return os.environ.get('QUEST_NOT_A_KNOB')\n")
+    assert main(["--format", "json", str(bad)]) == 1
+    records = _json.loads(capsys.readouterr().out)
+    assert records and all(
+        list(r) == ["rule", "path", "line", "col", "message"]
+        for r in records)
+    assert records[0]["rule"] == "QL004"
+    assert records[0]["line"] == 4
+    # clean path: an empty list, still valid JSON
+    good = pkg / "good.py"
+    good.write_text("X = 1\n")
+    assert main(["--format", "json", str(good)]) == 0
+    assert _json.loads(capsys.readouterr().out) == []
+
+
+# ---------------------------------------------------------------------------
+# QL005-QL009: the concurrency + memory-safety rules (each must FIRE)
+# ---------------------------------------------------------------------------
+
+
+def test_ql005_catches_unlocked_touch_of_guarded_attr(tmp_path):
+    """The lock-discipline core: a _GUARDED_BY attribute written
+    outside `with self._lock` fires; the locked path and a private
+    helper only ever called under the lock stay clean."""
+    vs = _lint_fixture(tmp_path, """
+        import threading
+
+        class Engine:
+            _GUARDED_BY = {"_lock": ("_pending", "_closed")}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = 0
+                self._closed = False
+
+            def submit(self):
+                self._pending += 1        # unlocked write
+
+            def ok_locked(self):
+                with self._lock:
+                    self._pending -= 1
+                    self._bump()
+
+            def _bump(self):
+                self._closed = True       # held helper: clean
+    """)
+    rules = [(v.rule, v.line) for v in vs]
+    assert ("QL005", 13) in rules, vs
+    assert not [v for v in vs if v.rule == "QL005" and v.line > 13], vs
+
+
+def test_ql005_requires_a_declaration_on_lock_owners(tmp_path):
+    """A class creating a lock with no _GUARDED_BY fires (the
+    annotation is load-bearing: without it the rule has nothing to
+    prove); an undeclared shared write under a declared class fires
+    the completeness leg."""
+    vs = _lint_fixture(tmp_path, """
+        import threading
+
+        class Bare:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+
+        class Partial:
+            _GUARDED_BY = {"_lock": ("_q",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+                self._other = 0
+
+            def poke(self):
+                with self._lock:
+                    self._other = 1       # written, not declared
+    """)
+    msgs = [v.message for v in vs if v.rule == "QL005"]
+    assert any("declares no _GUARDED_BY" in m for m in msgs), vs
+    assert any("missing from _GUARDED_BY" in m for m in msgs), vs
+
+
+def test_ql005_owner_thread_and_alias_groups(tmp_path):
+    """The grammar's two special forms: '<owner-thread>' attrs are
+    trusted lock-free, and a 'a|b' key accepts either lock name (the
+    engine's Condition-wraps-Lock shape)."""
+    vs = _lint_fixture(tmp_path, """
+        import threading
+
+        class Engine:
+            _GUARDED_BY = {
+                "_lock|_cond": ("_pending",),
+                "<owner-thread>": ("_stats",),
+            }
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._pending = 0
+                self._stats = {}
+
+            def via_cond(self):
+                with self._cond:
+                    self._pending += 1
+
+            def owner_only(self):
+                self._stats["x"] = 1
+    """)
+    assert not [v for v in vs if v.rule == "QL005"], vs
+
+
+def test_ql005_unused_reasoned_suppression_is_flagged(tmp_path):
+    """A reasoned escape that suppresses nothing is itself a violation
+    (stale escapes are how bugs sneak back); a bare suppression keeps
+    the original fire-and-forget semantics."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+
+        def fine():
+            # quest-lint: disable=QL004(reads a registered knob, honest)
+            return 1
+
+        def also_fine():
+            # quest-lint: disable=QL004
+            return 2
+    """)
+    assert [v.rule for v in vs] == ["QL004"], vs
+    assert "unused suppression" in vs[0].message
+
+
+def test_ql006_catches_the_pr13_donate_bug(tmp_path):
+    """Re-introduction of the PR-13 run_evolution bug: planes handed to
+    a donate=True compiled entry, then read again — the buffer was
+    deleted on dispatch."""
+    vs = _lint_fixture(tmp_path, """
+        def run(circ, state):
+            fn = circ.compiled_fused(batch=4, donate=True)
+            out = fn(state.amps)
+            return out + state.amps
+    """)
+    assert [(v.rule, v.line) for v in vs] == [("QL006", 5)], vs
+
+
+def test_ql006_rebind_and_jit_literal_forms(tmp_path):
+    """`amps = fn(amps)` (the blessed rebind idiom) is clean; a literal
+    jax.jit(..., donate_argnums=(0,)) loop with a post-loop use of the
+    donated name fires."""
+    vs = _lint_fixture(tmp_path, """
+        import jax
+
+        def clean(circ, amps):
+            fn = circ.compiled_banded(donate=True)
+            for _ in range(3):
+                amps = fn(amps)
+            return amps
+
+        def bad(g, planes):
+            jfn = jax.jit(g, donate_argnums=(0,))
+            out = jfn(planes)
+            return out, planes.sum()
+    """)
+    assert [(v.rule, v.line) for v in vs] == [("QL006", 13)], vs
+
+
+def test_ql007_catches_blocking_under_lock(tmp_path):
+    """time.sleep inside a held lock scope fires; the same call after
+    the scope closes is clean; a private helper only entered with the
+    lock held fires through the call graph."""
+    vs = _lint_fixture(tmp_path, """
+        import threading
+        import time
+
+        class Engine:
+            _GUARDED_BY = {"_lock": ("_q",)}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.1)
+                time.sleep(0.1)           # outside: clean
+
+            def drain(self):
+                with self._lock:
+                    self._flush()
+
+            def _flush(self):
+                time.sleep(0.5)           # held helper: propagated
+    """)
+    assert [(v.rule, v.line) for v in vs] \
+        == [("QL007", 14), ("QL007", 22)], vs
+
+
+def test_ql008_catches_bare_write_in_persistence_module(tmp_path):
+    """A bare open(..., 'w') in a checkpoint-chain module fires (torn
+    resume); the temp+os.replace idiom is clean."""
+    pkg = tmp_path / "quest_tpu"
+    pkg.mkdir(parents=True)
+    f = pkg / "checkpoint.py"
+    f.write_text(textwrap.dedent("""
+        import json
+        import os
+
+        def save_meta(directory, meta):
+            with open(os.path.join(directory, "meta.json"), "w") as fh:
+                json.dump(meta, fh)
+
+        def save_meta_atomic(directory, meta):
+            path = os.path.join(directory, "meta.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(meta, fh)
+            os.replace(tmp, path)
+    """))
+    vs = run_lint([str(f)], root=str(tmp_path))
+    assert [(v.rule, v.line) for v in vs] == [("QL008", 6)], vs
+
+
+def test_ql009_catches_a_literal_outside_the_catalog(tmp_path):
+    """A faults.check site literal that is not in faults.SITES fires —
+    a typo'd site arms a plan that silently never fires."""
+    vs = _lint_fixture(tmp_path, """
+        from quest_tpu.resilience import faults
+
+        def hot(x):
+            if faults.ACTIVE:
+                faults.check("serve.not_a_real_site", x=x)
+            return x
+    """)
+    assert [(v.rule, v.line) for v in vs] == [("QL009", 6)], vs
+
+
+def test_ql009_catches_unfired_and_unarmed_catalog_entries(tmp_path):
+    """Coverage legs over a synthetic tree: a catalog site with no
+    firing call site and no arming test fires twice (dead entry +
+    untested path); the covered site is clean."""
+    res = tmp_path / "quest_tpu" / "resilience"
+    res.mkdir(parents=True)
+    (res / "faults.py").write_text(
+        'SITES = ("serve.dispatch", "serve.ghost")\n')
+    eng = tmp_path / "quest_tpu" / "engine.py"
+    eng.write_text(textwrap.dedent("""
+        from quest_tpu.resilience import faults
+
+        def dispatch(x):
+            faults.check("serve.dispatch", x=x)
+            return x
+    """))
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_faults.py").write_text(
+        "def test_dispatch(plan):\n"
+        "    plan.inject('serve.dispatch', times=1)\n")
+    vs = run_lint([str(tmp_path / "quest_tpu"), str(tdir)],
+                  root=str(tmp_path))
+    ghost = [v for v in vs if v.rule == "QL009"]
+    assert len(ghost) == 2, vs
+    assert all("serve.ghost" in v.message for v in ghost), vs
+
+
+# ---------------------------------------------------------------------------
+# lint perf budget: 9 rules ride the single parse/index pass
+# ---------------------------------------------------------------------------
+
+
+def test_nine_rule_run_stays_within_perf_budget():
+    """One shared parse + collector pass serves all 9 rules: the full
+    run must stay within 1.5x the 4-rule wall time (plus fixed slack
+    for timer noise) so tier-1 doesn't creep as rules accumulate."""
+    import time as _time
+
+    paths = [os.path.join(REPO, "quest_tpu")]
+    legacy = ["QL001", "QL002", "QL003", "QL004"]
+
+    def timed(rules):
+        best = float("inf")
+        for _ in range(2):
+            t0 = _time.perf_counter()
+            run_lint(paths, rules=rules)
+            best = min(best, _time.perf_counter() - t0)
+        return best
+
+    t4 = timed(legacy)
+    t9 = timed(None)
+    assert t9 <= 1.5 * t4 + 0.75, (
+        f"9-rule run {t9:.2f}s exceeds 1.5x the 4-rule run "
+        f"{t4:.2f}s: a rule is re-parsing or re-walking the tree "
+        f"outside the shared collector pass")
+
+
+# ---------------------------------------------------------------------------
+# lock-order audit: the dynamic half of QL005/QL007
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_auditor_catches_seeded_inversion():
+    """Two threads taking {a, b} in opposite orders leave a cycle in
+    the acquisition graph — caught even though this interleaving never
+    actually deadlocked (the threads run sequentially here)."""
+    import threading
+
+    aud = audit.LockOrderAuditor()
+    a = aud.wrap("a", threading.Lock())
+    b = aud.wrap("b", threading.Lock())
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    cycle = aud.find_cycle()
+    assert cycle and cycle[0] == cycle[-1]
+    with pytest.raises(audit.LockOrderError):
+        aud.assert_acyclic()
+
+
+def test_lock_order_auditor_counts_reentry_without_edges():
+    """The ServeFleet RLock re-entry contract (PR 11): a same-lock
+    reacquire is tallied as a reentry, never as a self-edge."""
+    import threading
+
+    aud = audit.LockOrderAuditor()
+    r = aud.wrap("fleet", threading.RLock())
+    with r:
+        with r:
+            pass
+    assert aud.reentries.get("fleet") == 1
+    assert aud.acquisitions.get("fleet") == 2
+    assert aud.find_cycle() is None
+    aud.assert_acyclic()
+
+
+def test_fleet_workload_lock_order_is_acyclic():
+    """The real stack under audit: wrap the fleet lock, every replica's
+    engine lock, and the shared metrics-registry locks, run a
+    multi-program workload through ServeFleet, and assert the recorded
+    acquisition-order graph is acyclic (the checked claim behind the
+    RLock re-entry comment in fleet.py)."""
+    import threading
+
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.serve import ServeFleet, metrics
+
+    rng = np.random.default_rng(7)
+    n = 4
+    states = rng.standard_normal((8, 2, 1 << n)).astype(np.float32)
+    states /= np.sqrt((states ** 2).sum(axis=(1, 2), keepdims=True))
+    ca = Circuit(n).h(0).cnot(0, 1).rz(2, 0.25)
+    cb = Circuit(n).h(1).cnot(1, 2).rx(3, 0.5)
+
+    aud = audit.LockOrderAuditor()
+    reg = metrics.Registry()
+    reg._lock = aud.wrap("registry", reg._lock)
+    with ServeFleet(replicas=2, registry=reg, max_wait_ms=2,
+                    max_batch=4, backoff_base_s=0.0) as fl:
+        fl._lock = aud.wrap("fleet", fl._lock)
+        for i, e in enumerate(fl._engines):
+            wrapped = aud.wrap(f"engine{i}", e._cond)
+            e._cond = wrapped
+        futs = [fl.submit(ca if i % 2 == 0 else cb, state=states[i])
+                for i in range(8)]
+        fl.drain(timeout_s=300)
+        for f in futs:
+            f.result(timeout=60)
+    assert aud.acquisitions, "no audited acquisitions recorded"
+    aud.assert_acyclic()
 
 
 # ---------------------------------------------------------------------------
