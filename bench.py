@@ -36,6 +36,7 @@ import os
 import sys
 import time
 import traceback
+from typing import Optional
 
 import jax
 import numpy as np
@@ -697,6 +698,12 @@ HEADLINE_JSON_KEYS = frozenset({
     "plan_warm_ms", "plan_cache_cold", "plan_cache_warm",
     "plan_chosen_ms", "plan_forced_pergate_ms", "plan_forced_banded_ms",
     "plan_forced_fused_ms",
+    "fleet_proc_metric", "fleet_proc_unit", "fleet_proc_requests",
+    "fleet_proc_cores", "fleet_proc_host_parallelism",
+    "fleet_proc_rps_1", "fleet_proc_rps_2",
+    "fleet_proc_rps_4", "fleet_proc_speedup_4", "fleet_proc_efficiency",
+    "fleet_proc_p50_ms", "fleet_proc_p99_ms", "fleet_proc_kill_p99_ms",
+    "fleet_proc_kill_p99_delta_ms", "fleet_proc_kill_lost",
 })
 
 
@@ -1037,16 +1044,187 @@ def _measure_fleet(replicas: int = 2, max_batch: int = 32,
     }
 
 
+def _parallelism_spin(q, iters: int = 20_000_000) -> None:
+    """Child body for `_measure_host_parallelism` — module-level so the
+    spawn start method can pickle it (fork under a live multithreaded
+    JAX runtime is deadlock-prone)."""
+    t0 = time.perf_counter()
+    x = 0
+    for i in range(iters):
+        x += i
+    q.put(time.perf_counter() - t0)
+
+
+def _measure_host_parallelism(nproc: int = 2) -> float:
+    """The host's REAL parallel capacity for `nproc` busy processes:
+    wall-clock speedup of `nproc` concurrent pure-CPU spin loops over
+    one. On dedicated hardware this is ~min(nproc, cores); on the
+    shared/quota'd VMs CI runs on it is routinely far below nproc even
+    when `os.cpu_count()` claims enough cores (this box reports 2 cores
+    but delivers ~1.35x) — so the fleet sweep normalizes its scaling
+    efficiency against THIS measured ceiling, not the advertised core
+    count. Same honesty contract as the PR-11 thread-fleet numbers:
+    report what the host can do, never gate on what it can't."""
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+
+    q = ctx.Queue()
+    p = ctx.Process(target=_parallelism_spin, args=(q,))
+    p.start()
+    p.join()
+    solo = q.get()
+    ps = [ctx.Process(target=_parallelism_spin, args=(q,))
+          for _ in range(nproc)]
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join()
+    duo_wall = time.perf_counter() - t0
+    for _ in range(nproc):
+        q.get()
+    return max(1.0, nproc * solo / duo_wall)
+
+
+def _measure_proc_fleet(max_batch: int = 8,
+                        n_requests: Optional[int] = None):
+    """The PR-18 process-fleet sweep (docs/SERVING.md §process-fleet):
+    a closed-loop trajectory-sampling stream through
+    `ServeFleet(process=True)` — every replica its own interpreter
+    behind the serve/ipc.py boundary — swept over replicas ∈ {1, 2, 4}.
+    Shots-mode requests are the fair probe for the boundary: per
+    request the worker burns real compute while only a key and a small
+    sample block cross the pipe, so the sweep measures process-parallel
+    serving, not pickle bandwidth (a state-plane stream at this size is
+    IPC-dominated and would misprice ANY multi-process design).
+
+      * SCALING — req/s per replica count plus the 4-vs-1 speedup and
+        the efficiency normalized to the MEASURED host-parallelism
+        ceiling (`_measure_host_parallelism`), not os.cpu_count():
+        thread replicas priced BELOW 1x on this path (the PR-11
+        measurement that motivated the process boundary), and a
+        quota'd CI host prices multi-process scaling below its
+        advertised cores — both denominators are reported so the
+        trajectory file carries the honest context.
+      * LATENCY — per-request e2e p50/p99 at the widest sweep point,
+        stamped via done-callbacks so result-collection order can't
+        skew the sample.
+      * KILL RECOVERY — the 2-replica stream re-run with one worker
+        SIGKILLed (kill -9, no goodbye frame) after ~1/3 of results
+        have landed: the proxy's heartbeat watchdog must respawn and
+        resubmit so ZERO requests are lost (fleet_proc_kill_lost == 0
+        is the scripts/check_fleet_golden.py gate) and the only damage
+        is a p99 spike (fleet_proc_kill_p99_delta_ms reports it)."""
+    import signal as _signal
+
+    from quest_tpu.serve import ServeFleet, metrics, warmup
+
+    platform = jax.devices()[0].platform
+    n = 20 if platform in ("tpu", "axon") else 9
+    shots = 256
+    if n_requests is None:
+        n_requests = 192 if platform in ("tpu", "axon") else 48
+    cores = os.cpu_count() or 1
+    host_par = _measure_host_parallelism(2)
+    _log(f"host parallelism: {host_par:.2f}x over 2 processes "
+         f"({cores} advertised cores)")
+    circ = _build_circuit(n)
+    circ.depolarising(0, 0.01)     # a channel: trajectories must branch
+
+    def stream(fleet, kill_at: Optional[int] = None):
+        """One closed-loop pass; returns (req/s, sorted latencies_s,
+        lost). `kill_at` SIGKILLs the first replica's worker once that
+        many results have landed."""
+        done_t = [None] * n_requests
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(n_requests):
+            f = fleet.submit(circ, shots=shots, key=jax.random.key(i))
+            f.add_done_callback(
+                lambda f, i=i: done_t.__setitem__(
+                    i, time.perf_counter()))
+            futs.append((time.perf_counter(), f))
+        if kill_at is not None:
+            while sum(t is not None for t in done_t) < kill_at:
+                time.sleep(0.005)
+            os.kill(fleet._engines[0].worker_pid(), _signal.SIGKILL)
+        lost = 0
+        for _, f in futs:
+            try:
+                f.result(timeout=600)
+            except Exception:
+                lost += 1
+        rps = n_requests / (time.perf_counter() - t0)
+        lats = sorted(done_t[i] - futs[i][0]
+                      for i in range(n_requests) if done_t[i] is not None)
+        return rps, lats, lost
+
+    def pctl(lats, q):
+        return 1e3 * lats[min(len(lats) - 1,
+                              int(round(q * (len(lats) - 1))))]
+
+    rps_by_r = {}
+    p50 = p99 = base2_p99 = 0.0
+    for r in (1, 2, 4):
+        with ServeFleet(replicas=r, process=True, max_wait_ms=2,
+                        max_batch=max_batch,
+                        registry=metrics.Registry()) as fleet:
+            warmup(fleet, [circ])
+            stream(fleet)                    # warm pass pays compiles
+            rps, lats, _ = stream(fleet)
+        rps_by_r[r] = rps
+        if r == 2:
+            base2_p99 = pctl(lats, 0.99)
+        if r == 4:
+            p50, p99 = pctl(lats, 0.50), pctl(lats, 0.99)
+        _log(f"proc fleet x{r}: {rps:.1f} req/s")
+
+    with ServeFleet(replicas=2, process=True, max_wait_ms=2,
+                    max_batch=max_batch,
+                    registry=metrics.Registry()) as fleet:
+        warmup(fleet, [circ])
+        stream(fleet)
+        _, kill_lats, kill_lost = stream(fleet, kill_at=n_requests // 3)
+    kill_p99 = pctl(kill_lats, 0.99)
+    _log(f"proc fleet kill: p99 {kill_p99:.1f} ms vs {base2_p99:.1f} ms "
+         f"baseline, {kill_lost} lost")
+
+    speedup = rps_by_r[4] / rps_by_r[1]
+    return {
+        "fleet_proc_metric": (f"process fleet req/s @ {n}q "
+                              f"{shots}-shot x{{1,2,4}} replicas "
+                              f"({platform}, {cores} cores)"),
+        "fleet_proc_unit": "req/s",
+        "fleet_proc_requests": n_requests,
+        "fleet_proc_cores": cores,
+        "fleet_proc_host_parallelism": round(host_par, 2),
+        "fleet_proc_rps_1": round(rps_by_r[1], 1),
+        "fleet_proc_rps_2": round(rps_by_r[2], 1),
+        "fleet_proc_rps_4": round(rps_by_r[4], 1),
+        "fleet_proc_speedup_4": round(speedup, 2),
+        "fleet_proc_efficiency": round(
+            speedup / min(4.0, max(host_par, 1.0)), 2),
+        "fleet_proc_p50_ms": round(p50, 3),
+        "fleet_proc_p99_ms": round(p99, 3),
+        "fleet_proc_kill_p99_ms": round(kill_p99, 3),
+        "fleet_proc_kill_p99_delta_ms": round(kill_p99 - base2_p99, 3),
+        "fleet_proc_kill_lost": kill_lost,
+    }
+
+
 def fleet_main():
     """`python bench.py fleet` — the multi-replica fleet scenario alone,
-    one JSON line of fleet_* keys (docs/SERVING.md §fleet)."""
+    one JSON line of fleet_* keys (docs/SERVING.md §fleet), plus the
+    PR-18 process-fleet replica sweep (§process-fleet)."""
     from quest_tpu.env import ensure_live_backend
     ensure_live_backend()
     rec = _measure_fleet()
+    rec.update(_measure_proc_fleet())
     print(json.dumps(rec))
     if not (rec["fleet_failover_unresolved"] == 0
             and rec["fleet_shed_lowest_only"]
-            and rec["fleet_durable_resume_bitexact"]):
+            and rec["fleet_durable_resume_bitexact"]
+            and rec["fleet_proc_kill_lost"] == 0):
         raise SystemExit(1)
 
 

@@ -522,6 +522,38 @@ _KNOB_LIST = (
              "affinity routing, fleet-level failover; default: 2; "
              "docs/SERVING.md §fleet)",
          malformed="0"),
+    Knob("QUEST_FLEET_PROC", _bool01("QUEST_FLEET_PROC"), False,
+         scope="runtime", layer="serve",
+         doc="ServeFleet replica backend: 1 = supervised worker "
+             "PROCESSES behind the serve.ipc dispatch boundary (own "
+             "interpreter + JAX runtime per replica — req/s scales "
+             "with cores), 0 = in-process worker threads (default; "
+             "docs/SERVING.md §process-fleet)",
+         malformed="2"),
+    Knob("QUEST_FLEET_MIN_REPLICAS",
+         _int_range("QUEST_FLEET_MIN_REPLICAS", 1), 1,
+         scope="runtime", layer="serve",
+         doc="elastic-autoscaler floor: the fleet never scales below "
+             "this many live replicas (serve/autoscaler.py; default: "
+             "1; docs/SERVING.md §process-fleet)",
+         malformed="0"),
+    Knob("QUEST_FLEET_MAX_REPLICAS",
+         _int_range("QUEST_FLEET_MAX_REPLICAS", 1), 4,
+         scope="runtime", layer="serve",
+         doc="elastic-autoscaler ceiling: the fleet never scales above "
+             "this many live replicas (serve/autoscaler.py; default: "
+             "4; docs/SERVING.md §process-fleet)",
+         malformed="0"),
+    Knob("QUEST_HEARTBEAT_S", _parse_pos_float("QUEST_HEARTBEAT_S"),
+         0.25,
+         scope="runtime", layer="serve",
+         doc="process-replica heartbeat cadence in seconds: each "
+             "worker ships health + a registry snapshot per beat, and "
+             "the proxy declares the worker LOST (kill + respawn "
+             "under the restart budget) after 4 missed beats "
+             "(serve/ipc.py; default: 0.25; docs/SERVING.md "
+             "§process-fleet)",
+         malformed="0"),
     Knob("QUEST_SERVE_TENANT_QUOTA", _parse_tenant_quota,
          _default_tenant_quota,
          scope="runtime", layer="serve",

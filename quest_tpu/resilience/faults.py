@@ -106,6 +106,22 @@ SITES = (
                             # fleet.failover (the decision point), so
                             # chaos plans can fail the hop itself, e.g.
                             # mid-durable-failover
+    "fleet.spawn",          # process-replica spawn (serve.ipc — both
+                            # the initial boot and every supervised
+                            # respawn; ctx: replica, respawn) — an
+                            # armed error emulates exec/fork failure so
+                            # soaks can prove spawn loss burns the
+                            # process supervisor budget and fails over
+    "ipc.send",             # one framed message leaving the proxy for
+                            # its worker process (ctx: replica, type) —
+                            # an armed error emulates a broken pipe
+                            # mid-submit; the proxy must fail the
+                            # request typed, never strand its future
+    "ipc.recv",             # one framed message arriving from the
+                            # worker process (ctx: replica, type) — an
+                            # armed error emulates a torn/poisoned
+                            # frame; the proxy treats it as worker loss
+                            # (kill + respawn under budget)
 )
 
 

@@ -10,6 +10,13 @@ the engine gives up and transitions to its loud FAILED state
 in-flight requests, failing dispatched ones, completing every future on
 give-up — lives in the engine (`ServeEngine._worker_main`).
 
+The policy is deliberately mechanism-agnostic: `serve.ipc.ReplicaProxy`
+applies the SAME class to a supervised worker PROCESS (heartbeat loss or
+pipe EOF is its "crash"; respawn+resubmit its "restart"; budget
+exhaustion its transition to FAILED, which hands the proxy's incomplete
+requests to the fleet's failover requeue — docs/SERVING.md
+§process-fleet). One restart-budget story covers both boundaries.
+
 Exponential backoff with deterministic jitter: restart k sleeps
 `base * 2^(k-1)` capped at `cap`, plus a seeded-uniform jitter slice so
 a crash-looping worker neither hot-spins nor thunders in lockstep with

@@ -26,6 +26,8 @@ from quest_tpu.serve.warmup import default_buckets, warmup  # noqa: F401,E402
 _LAZY = {
     "ServeEngine": ("quest_tpu.serve.engine", "ServeEngine"),
     "ServeFleet": ("quest_tpu.serve.fleet", "ServeFleet"),
+    "ReplicaProxy": ("quest_tpu.serve.ipc", "ReplicaProxy"),
+    "Autoscaler": ("quest_tpu.serve.autoscaler", "Autoscaler"),
     "RejectedError": ("quest_tpu.serve.admission", "RejectedError"),
     "DeadlineExceeded": ("quest_tpu.serve.admission", "DeadlineExceeded"),
     "ShedError": ("quest_tpu.serve.admission", "ShedError"),

@@ -1,0 +1,232 @@
+"""Elastic autoscaling for the process fleet: pressure in, replicas out.
+
+PR 18's process-backed `ServeFleet` (docs/SERVING.md §process-fleet)
+makes replicas cheap to add and safe to remove: `add_replica()` spawns
+a supervised worker process whose warm boot is a plan-cache LOAD, and
+`remove_replica()` drains the emptiest replica behind a tombstone so
+no in-flight ticket dangles. This module is the control loop that
+decides WHEN — the spot-native shape where capacity follows measured
+load instead of a static `replicas=` guess.
+
+The policy is deliberately boring (boring is debuggable at 3am):
+
+  * SIGNALS — each `tick()` reads the fleet's own instruments, not
+    wall-clock guesses: `stats()["pressure"]` (queued depth plus
+    open-breaker backlog over healthy capacity — the same number the
+    shed path keys on), the delta of the `shed_requests` counter since
+    the previous tick, and the count of FAILED replicas pending
+    nothing. A tick is one pure function of (signals, streak state) ->
+    one of "up" / "down" / None, so tests drive the loop
+    deterministically without threads or sleeps.
+  * HYSTERESIS — one hot tick never scales. Pressure must sit at or
+    above `high_water` (or any shedding occur) for `up_ticks`
+    CONSECUTIVE ticks to grow, and at or below `low_water` for
+    `down_ticks` consecutive ticks to shrink; any tick in the neutral
+    band resets both streaks. Growing is eager (shed traffic is lost
+    revenue), shrinking is lazy (a respawn costs a JAX runtime boot) —
+    so `down_ticks` defaults higher than `up_ticks`.
+  * COOLDOWN — after any scaling action the loop holds for
+    `cooldown_ticks` ticks. A fresh replica takes a few beats to
+    absorb backlog; without the hold, the still-high pressure from
+    the pre-scale queue would trigger a second spawn for the same
+    burst (the classic thrash).
+  * BOUNDS — the live replica count stays inside
+    [`QUEST_FLEET_MIN_REPLICAS`, `QUEST_FLEET_MAX_REPLICAS`] no matter
+    what the signals say. `remove_replica`'s own refusal to drop the
+    last live replica is the belt to this suspender.
+
+`tick()` is the unit of behavior; `start()`/`stop()` merely run it on
+a daemon-thread metronome for production use. Scaling actions ride the
+fleet's counters (`fleet_scale_ups` / `fleet_scale_downs`) and this
+module's gauges (`autoscaler_pressure`, `autoscaler_up_streak`,
+`autoscaler_down_streak`) so the scrape shows why capacity moved.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Autoscaler:
+    """The control loop over one `ServeFleet`.
+
+    Thread-safety: `tick()` may be called from tests AND from the
+    `start()` thread; `_lock` serializes whole ticks so streak state
+    never interleaves. Fleet calls (`stats`, `add_replica`,
+    `remove_replica`) happen inside the tick but take no Autoscaler
+    state with them — the fleet has its own lock discipline.
+    """
+
+    _GUARDED_BY = {
+        "_lock": ("_up_streak", "_down_streak", "_cooldown",
+                  "_last_shed", "_ticks", "_actions"),
+        # the metronome thread handle is touched only by the caller
+        # driving start()/stop() — single-owner by contract
+        "<owner-thread>": ("_thread",),
+    }
+
+    def __init__(self, fleet, *,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 high_water: float = 0.75,
+                 low_water: float = 0.15,
+                 up_ticks: int = 2,
+                 down_ticks: int = 5,
+                 cooldown_ticks: int = 3,
+                 interval_s: float = 1.0) -> None:
+        from quest_tpu.env import knob_value
+        if min_replicas is None:
+            min_replicas = knob_value("QUEST_FLEET_MIN_REPLICAS")
+        if max_replicas is None:
+            max_replicas = knob_value("QUEST_FLEET_MAX_REPLICAS")
+        min_replicas = int(min_replicas)
+        max_replicas = int(max_replicas)
+        if min_replicas > max_replicas:
+            raise ValueError(
+                f"Invalid operation: QUEST_FLEET_MIN_REPLICAS="
+                f"{min_replicas} > QUEST_FLEET_MAX_REPLICAS="
+                f"{max_replicas} — the autoscaler's bounds must form "
+                f"a non-empty range (docs/CONFIG.md).")
+        if not (0.0 <= low_water < high_water):
+            raise ValueError(
+                f"Invalid operation: need 0 <= low_water < high_water, "
+                f"got low_water={low_water}, high_water={high_water} — "
+                f"an inverted band would scale up and down on the same "
+                f"tick (docs/SERVING.md §process-fleet).")
+        self.fleet = fleet
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.high_water = float(high_water)
+        self.low_water = float(low_water)
+        self.up_ticks = max(1, int(up_ticks))
+        self.down_ticks = max(1, int(down_ticks))
+        self.cooldown_ticks = max(0, int(cooldown_ticks))
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown = 0
+        self._last_shed = self._shed_total()
+        self._ticks = 0
+        self._actions: list = []    # (tick, "up"|"down") audit trail
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signals -----------------------------------------------------------
+
+    def _shed_total(self) -> int:
+        snap = self.fleet.registry.snapshot()
+        return int(snap["counters"].get("shed_requests", 0))
+
+    # -- the decision ------------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """One control-loop step: read signals, update streaks, maybe
+        scale. Returns "up" / "down" when a scaling action happened
+        this tick, else None — tests assert convergence by driving
+        this directly."""
+        with self._lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> Optional[str]:
+        self._ticks += 1
+        stats = self.fleet.stats()
+        pressure = float(stats["pressure"])
+        live = [r for r in stats["replicas"] if not r["retired"]]
+        shed_now = self._shed_total()
+        shed_delta = shed_now - self._last_shed
+        self._last_shed = shed_now
+
+        reg = self.fleet.registry
+        reg.gauge("autoscaler_pressure").set(pressure)
+
+        hot = pressure >= self.high_water or shed_delta > 0
+        cold = pressure <= self.low_water and shed_delta == 0
+        self._up_streak = self._up_streak + 1 if hot else 0
+        self._down_streak = self._down_streak + 1 if cold else 0
+        reg.gauge("autoscaler_up_streak").set(self._up_streak)
+        reg.gauge("autoscaler_down_streak").set(self._down_streak)
+
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+
+        n = len(live)
+        if (self._up_streak >= self.up_ticks and n < self.max_replicas):
+            self.fleet.add_replica()
+            self._after_action("up")
+            return "up"
+        if (self._down_streak >= self.down_ticks
+                and n > self.min_replicas):
+            # a short drain: the victim is the emptiest replica, so
+            # this returns fast; a slow drain must not wedge the loop —
+            # the fleet rolls an overdue drain back (no accepted work
+            # is ever lost to a scale-down) and this tick records no
+            # action, so the streak re-arms a later attempt
+            try:
+                self.fleet.remove_replica(timeout_s=self.interval_s)
+            except TimeoutError:
+                return None
+            self._after_action("down")
+            return "down"
+        return None
+
+    def _after_action(self, kind: str) -> None:
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown = self.cooldown_ticks
+        self._actions.append((self._ticks, kind))
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The loop's observable state — what an operator (or the
+        convergence gate in scripts/check_fleet_golden.py) reads."""
+        with self._lock:
+            return {
+                "ticks": self._ticks,
+                "up_streak": self._up_streak,
+                "down_streak": self._down_streak,
+                "cooldown": self._cooldown,
+                "actions": list(self._actions),
+                "bounds": (self.min_replicas, self.max_replicas),
+                "band": (self.low_water, self.high_water),
+            }
+
+    # -- the production metronome ------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        """Run `tick()` every `interval_s` on a daemon thread until
+        `stop()`. Idempotent; returns self so it chains off the
+        constructor."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    # a flapping fleet (mid-close, all-FAILED) must not
+                    # kill the metronome; the next tick re-reads state
+                    continue
+
+        self._thread = threading.Thread(
+            target=loop, name="quest-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, 2 * self.interval_s))
+            self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -305,6 +305,24 @@ class ServeEngine:
         # quest-lint: disable=QL005(same racy-read contract as _closed above)
         return self._state
 
+    def health(self) -> dict:
+        """One-call liveness summary — state, queued depth, not-CLOSED
+        breaker count, restart budget left. This is what a process
+        replica's heartbeat frame carries back to its proxy every
+        `QUEST_HEARTBEAT_S` (serve/worker_main.py), and what the fleet
+        mirrors for routing — kept as a public method so the wire
+        contract does not lean on engine privates. Racy reads by
+        design, same contract as `state`: a health probe must never
+        queue behind a dispatch."""
+        from quest_tpu.resilience.breaker import CLOSED as _closed_s
+        return {
+            "state": self.state,
+            "pending": self._pending,  # quest-lint: disable=QL005(observability fast path: racy read, never blocks behind a dispatch)
+            "open_breakers": sum(1 for br in list(self._breakers.values())
+                                 if br.state != _closed_s),
+            "restarts_remaining": self._supervisor.remaining,
+        }
+
     def plan(self, circuit, *, batch: Optional[int] = None,
              density: bool = False, dtype=None):
         """The priced ProgramPlan this engine would dispatch `circuit`

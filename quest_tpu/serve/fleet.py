@@ -141,10 +141,12 @@ class ServeFleet:
     # and what it guards (quest-lint QL005, docs/ANALYSIS.md)
     _GUARDED_BY = {
         "_lock": ("_affinity", "_pending", "_tenant_pending", "_seq",
-                  "_rr", "_failed_noted", "_closed", "_failure_cause"),
+                  "_rr", "_failed_noted", "_closed", "_failure_cause",
+                  "_retired", "_requeue_cap"),
     }
 
     def __init__(self, replicas: Optional[int] = None, *,
+                 process: Optional[bool] = None,
                  tenant_quota=None,
                  shed_threshold: Optional[float] = None,
                  priorities: Optional[int] = None,
@@ -155,6 +157,9 @@ class ServeFleet:
             replicas = knob_value("QUEST_SERVE_REPLICAS")
         if int(replicas) < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if process is None:
+            process = knob_value("QUEST_FLEET_PROC")
+        self.process = bool(process)
         if tenant_quota is None:
             tenant_quota = knob_value("QUEST_SERVE_TENANT_QUOTA")
         if isinstance(tenant_quota, int):
@@ -183,10 +188,18 @@ class ServeFleet:
             raise ValueError(
                 f"durable_mesh list has {len(meshes)} entries for "
                 f"{replicas} replicas")
+        if self.process and any(m is not None for m in meshes):
+            raise ValueError(
+                "process replicas build their own mesh from their own "
+                "environment; durable_mesh= is a thread-replica option "
+                "(docs/SERVING.md §process-fleet)")
+        self._engine_kw = dict(engine_kw)
         self._engines: List[ServeEngine] = [
-            ServeEngine(registry=self.registry, name=f"r{i}",
-                        durable_mesh=meshes[i], **engine_kw)
+            self._make_replica(i, durable_mesh=meshes[i])
             for i in range(int(replicas))]
+        # replicas retired by the elastic scale-down path: closed but
+        # kept in _engines as tombstones so ticket indices never dangle
+        self._retired: set = set()
         # the requeue bound: a request may hop at most once past every
         # replica and once more (the survivor it lands on may fail
         # later too) before it fails typed — failover can never loop
@@ -220,6 +233,20 @@ class ServeFleet:
         self._m_spill = self.registry.counter("fleet_affinity_spills")
         self._m_pressure = self.registry.gauge("fleet_pressure")
 
+    def _make_replica(self, idx: int, durable_mesh=None):
+        """One replica at index `idx`: an in-process ServeEngine, or —
+        under `process=True` / QUEST_FLEET_PROC — a serve.ipc
+        ReplicaProxy fronting a supervised worker process with its own
+        interpreter and JAX runtime (docs/SERVING.md §process-fleet).
+        Both expose the same engine surface; the fleet logic above
+        never branches on the backend again."""
+        if self.process:
+            from quest_tpu.serve.ipc import ReplicaProxy
+            return ReplicaProxy(registry=self.registry, name=f"r{idx}",
+                                **self._engine_kw)
+        return ServeEngine(registry=self.registry, name=f"r{idx}",
+                           durable_mesh=durable_mesh, **self._engine_kw)
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -250,7 +277,11 @@ class ServeFleet:
 
     @property
     def replicas(self) -> int:
-        return len(self._engines)
+        """Live (non-retired) replica count — what the elastic
+        autoscaler grows and shrinks; scale-down tombstones stay in
+        `_engines` so in-flight ticket indices never dangle."""
+        with self._lock:
+            return len(self._engines) - len(self._retired)
 
     def plan(self, circuit, *, batch: Optional[int] = None,
              density: bool = False, dtype=None):
@@ -268,13 +299,16 @@ class ServeFleet:
         from quest_tpu import plan as P
         with self._lock:
             pressure = self._pressure_locked()
+            retired = set(self._retired)
         return {
             "pressure": pressure,
+            "process": self.process,
             "plan_cache": P.cache_stats(),
             "replicas": [
                 {"name": e.name, "state": e.state, "pending": e._pending,
-                 "restarts_remaining": e._supervisor.remaining}
-                for e in self._engines],
+                 "restarts_remaining": e._supervisor.remaining,
+                 "retired": i in retired}
+                for i, e in enumerate(self._engines)],
         }
 
     # -- submit ------------------------------------------------------------
@@ -415,7 +449,7 @@ class ServeFleet:
 
     def _healthy_locked(self) -> List[int]:
         return [i for i, e in enumerate(self._engines)
-                if e.state == "running"]
+                if e.state == "running" and i not in self._retired]
 
     def _pick_replica_locked(self, route_key: tuple,
                              healthy: List[int]) -> int:
@@ -453,9 +487,13 @@ class ServeFleet:
         replicas on a synchronous RejectedError (that replica's queue
         is full or it failed between the pick and the submit). Raises
         only when every healthy replica refused."""
+        with self._lock:
+            retired = set(self._retired)
         order = [idx] + [i for i in range(len(self._engines)) if i != idx]
         last: Optional[BaseException] = None
         for i in order:
+            if i in retired:
+                continue
             eng = self._engines[i]
             if eng.state != "running":
                 continue
@@ -614,6 +652,111 @@ class ServeFleet:
             del self._affinity[k]
         self.registry.gauge("fleet_replicas_healthy").set(
             len(self._healthy_locked()))
+
+    # -- elasticity (serve/autoscaler.py drives these) -----------------------
+
+    def add_replica(self) -> int:
+        """Grow the fleet by one replica (thread or process per the
+        fleet's backend). Returns its index. The spawn happens OUTSIDE
+        the fleet lock — a process boot takes seconds and submits must
+        keep flowing — so two concurrent callers simply add two
+        replicas."""
+        with self._lock:
+            if self._closed:
+                raise RejectedError(
+                    "Invalid operation: add_replica() after "
+                    "ServeFleet.close() (docs/SERVING.md "
+                    "§process-fleet).")
+        eng = self._make_replica(len(self._engines))
+        with self._lock:
+            if self._closed:
+                closed_race = True
+            else:
+                closed_race = False
+                self._engines.append(eng)
+                self._requeue_cap = 2 * len(self._engines)
+                live = len(self._engines) - len(self._retired)
+                self.registry.gauge("fleet_replicas").set(live)
+                self.registry.gauge("fleet_replicas_healthy").set(
+                    len(self._healthy_locked()))
+        if closed_race:
+            eng.close(timeout_s=5.0)
+            raise RejectedError(
+                "Invalid operation: fleet closed while the new replica "
+                "was booting (docs/SERVING.md §process-fleet).")
+        self.registry.counter("fleet_scale_ups").inc()
+        return len(self._engines) - 1
+
+    def remove_replica(self, timeout_s: Optional[float] = 30.0) -> int:
+        """Shrink the fleet by one replica: the least-loaded running
+        one retires — new requests stop routing to it immediately, its
+        queued requests DRAIN (never shed by a scale-down), then it
+        closes. Returns the retired index. Refuses to remove the last
+        live replica."""
+        with self._lock:
+            if self._closed:
+                raise RejectedError(
+                    "Invalid operation: remove_replica() after "
+                    "ServeFleet.close() (docs/SERVING.md "
+                    "§process-fleet).")
+            healthy = self._healthy_locked()
+            if len(healthy) <= 1:
+                raise ValueError(
+                    "cannot retire the last live replica — scale-down "
+                    "floors at 1 (QUEST_FLEET_MIN_REPLICAS governs the "
+                    "autoscaler's own floor)")
+            # least-loaded retires (cheapest drain); newest breaks ties
+            # so long-lived warm replicas keep their affinity pins
+            idx = min(healthy,
+                      key=lambda i: (self._engines[i]._pending, -i))
+            self._retired.add(idx)
+            for k in [k for k, v in self._affinity.items() if v == idx]:
+                del self._affinity[k]
+            live = len(self._engines) - len(self._retired)
+            self.registry.gauge("fleet_replicas").set(live)
+            self.registry.gauge("fleet_replicas_healthy").set(
+                len(self._healthy_locked()))
+        eng = self._engines[idx]
+        try:
+            eng.drain(timeout_s=timeout_s)
+        except RejectedError:
+            pass        # already failed/closed: nothing left to drain
+        except TimeoutError:
+            # the drain window expired with requests still incomplete:
+            # closing now would resolve them rejected, and a scale-down
+            # must NEVER lose accepted work — roll the retirement back
+            # (routing resumes) and let the caller retry a later tick
+            with self._lock:
+                self._retired.discard(idx)
+                live = len(self._engines) - len(self._retired)
+                self.registry.gauge("fleet_replicas").set(live)
+                self.registry.gauge("fleet_replicas_healthy").set(
+                    len(self._healthy_locked()))
+            raise TimeoutError(
+                f"scale-down of replica {idx} aborted: its drain did "
+                f"not complete within timeout_s={timeout_s} — the "
+                f"retirement rolled back so no accepted request is "
+                f"lost (docs/SERVING.md §process-fleet)")
+        eng.close(timeout_s=timeout_s)
+        self.registry.counter("fleet_scale_downs").inc()
+        return idx
+
+    def scrape(self) -> str:
+        """One Prometheus exposition for the whole fleet. Thread
+        replicas share the fleet registry, so this is its scrape;
+        process replicas keep their registries in their own
+        interpreters, so the fleet merges the per-replica heartbeat
+        snapshots into the fleet-level metrics (docs/SERVING.md
+        §process-fleet: counters/gauges sum, histogram quantiles take
+        the worst replica — the alerting-conservative merge)."""
+        if not self.process:
+            return self.registry.scrape()
+        snaps = [self.registry.snapshot()]
+        for e in self._engines:
+            snap = getattr(e, "snapshot", None)
+            if snap is not None:
+                snaps.append(snap())
+        return M.render_snapshot(M.merge_snapshots(snaps))
 
     # -- pressure + shedding -----------------------------------------------
 

@@ -1,0 +1,813 @@
+"""Process-replica IPC: the dispatch boundary that breaks the GIL.
+
+PR 11's bench was honest about thread replicas: on a CPU host two
+ServeEngine worker THREADS price below one, because every replica's
+tracing and dispatch serializes on the one interpreter lock. This
+module is the fix the distributed simulators converge on (mpiQulacs
+arXiv:2203.16044, PennyLane-Lightning MPI arXiv:2508.13615): each
+replica becomes a supervised WORKER PROCESS — its own interpreter, its
+own JAX runtime, its own ServeEngine — fronted by a `ReplicaProxy`
+that duck-types the exact engine surface `ServeFleet` already routes,
+sheds and fails over against (docs/SERVING.md §process-fleet). The
+fleet layer does not know the difference: `ServeFleet(process=True)`
+swaps `ServeEngine` for `ReplicaProxy` and every contract pinned in
+tests/test_fleet.py rides on unchanged.
+
+Wire protocol — a Unix socketpair per replica, carrying length-prefixed
+pickle frames (docs/SERVING.md §process-fleet for the layout):
+
+    +----------------+----------------------------+
+    | 8 bytes, BE    | pickle.dumps(payload) ...  |
+    | payload length | payload["t"] = frame type  |
+    +----------------+----------------------------+
+
+parent -> worker: init, submit, cancel, drain, close
+worker -> parent: hello, result, drained, hb (heartbeat)
+
+Circuits travel as VALUE-KEYED program descriptors: a content digest
+over the op stream plus (first shipment per worker boot) the ops
+themselves. The worker caches rebuilt Circuit objects by digest, so
+repeat submits of an equal-valued circuit hit the worker's on-instance
+compiled-program cache, and — because the PR-15 plan cache and the XLA
+compile cache are content-addressed files on SHARED disk — a warm
+worker boots as a LOAD, never a re-search (tests/test_ipc.py pins the
+concurrent-warmup discipline).
+
+Supervision (the Supervisor policy class, reused verbatim from the
+thread story — resilience/supervisor.py): a worker that stops
+heartbeating for `_HB_MISS` intervals, EOFs its pipe, or reports its
+in-process engine FAILED is killed and respawned under the proxy's
+restart budget, and the proxy RESUBMITS every incomplete request to
+the fresh worker. That resubmit is provably serve-once across the
+process boundary — stronger than the thread contract: a SIGKILLed
+process delivered no result frame for an incomplete request and never
+will, circuit application is pure, and durable jobs re-enter their
+checkpoint-chain resume (docs/RESILIENCE.md §durable) — so even
+requests whose launch had started are safe to re-serve. Budget
+exhausted => the proxy goes FAILED and resolves its incomplete futures
+with the requeue-typed `RejectedError`, which hands them to the
+fleet's existing failover requeue onto surviving replicas.
+
+Fault sites `fleet.spawn` / `ipc.send` / `ipc.recv`
+(resilience.faults) thread through spawn and both pump directions
+behind the one `ACTIVE` flag, so the chaos soak can break pipes and
+fail spawns deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Dict, Optional
+
+from quest_tpu.resilience import faults as _F
+from quest_tpu.resilience.breaker import OPEN
+from quest_tpu.resilience.supervisor import Supervisor
+from quest_tpu.serve import metrics as M
+from quest_tpu.serve.admission import AdmissionController, RejectedError
+
+# frame header: one 8-byte big-endian unsigned length
+_HDR = struct.Struct(">Q")
+# a frame larger than this is a torn/poisoned header, not a payload
+# (the biggest real payload is one batched state plane — far below)
+_MAX_FRAME = 1 << 34
+# heartbeat intervals a worker may miss before it is declared lost
+_HB_MISS = 4
+# seconds the proxy waits for a fresh worker's hello (interpreter +
+# jax import + engine construction; generous — a slow boot is not a
+# dead boot)
+_BOOT_TIMEOUT_S = 120.0
+# extra seconds past the caller's own timeout granted to a drain round
+# trip before the proxy gives up on the reply
+_RPC_SLACK_S = 5.0
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, payload: dict) -> None:
+    """Serialize `payload` and write one length-prefixed frame. Raises
+    OSError on a broken transport and TypeError/pickle.PicklingError on
+    an unpicklable payload — both loud, never a partial frame."""
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(blob)) + blob)
+
+
+def recv_frame(sock: socket.socket) -> dict:
+    """Read one frame. Raises EOFError on a closed transport (including
+    mid-frame — a torn frame is a loss, never a silent retry),
+    socket.timeout on the socket's timeout, ValueError on a poisoned
+    length header."""
+    hdr = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    if n > _MAX_FRAME:
+        raise ValueError(
+            f"ipc frame header claims {n} bytes (> {_MAX_FRAME}): torn "
+            f"or poisoned stream (docs/SERVING.md §process-fleet)")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError(
+                f"ipc peer closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# ---------------------------------------------------------------------------
+# circuit + key wire codecs
+# ---------------------------------------------------------------------------
+
+
+def circuit_digest(circuit) -> str:
+    """Value key for `circuit` on the wire: sha256 over the pickled
+    (num_qubits, ops) stream — equal-valued circuits share one digest,
+    so the worker's rebuilt-Circuit cache (and through it the
+    on-instance compiled-program cache and the content-addressed plan
+    cache) dedupes across clients and across proxy respawns. Cached on
+    the instance, invalidated if more ops are appended."""
+    import hashlib
+    cached = getattr(circuit, "_ipc_digest", None)
+    if cached is not None and cached[0] == len(circuit.ops):
+        return cached[1]
+    blob = pickle.dumps((circuit.num_qubits, circuit.ops),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    dg = hashlib.sha256(blob).hexdigest()
+    circuit._ipc_digest = (len(circuit.ops), dg)
+    return dg
+
+
+def circuit_descriptor(circuit) -> dict:
+    """The full shippable form (first shipment per worker boot)."""
+    return {"num_qubits": circuit.num_qubits, "ops": list(circuit.ops)}
+
+
+def rebuild_circuit(desc: dict):
+    """Worker-side inverse of circuit_descriptor."""
+    from quest_tpu.circuit import Circuit
+    c = Circuit(desc["num_qubits"])
+    c.ops = list(desc["ops"])
+    return c
+
+
+def encode_key(key):
+    """PRNG keys cross the boundary as ('typed', key_data) or ('raw',
+    uint32 array) — the STYLE survives, because it is part of the
+    worker-side program identity (serve/warmup.py)."""
+    if key is None:
+        return None
+    import numpy as np
+    arr = key if hasattr(key, "dtype") else np.asarray(key)
+    try:
+        import jax.dtypes
+        typed = jax.dtypes.issubdtype(arr.dtype, jax.dtypes.prng_key)
+    except (TypeError, AttributeError, ImportError):
+        typed = False
+    if typed:
+        import jax
+        return ("typed", np.asarray(jax.random.key_data(arr)))
+    return ("raw", np.asarray(arr))
+
+
+def decode_key(enc):
+    if enc is None:
+        return None
+    import jax
+    if enc[0] == "typed":
+        return jax.random.wrap_key_data(jax.numpy.asarray(enc[1]))
+    return enc[1]
+
+
+class _BreakerMirror:
+    """Parent-side stand-in for one OPEN worker breaker: the fleet's
+    pressure model only reads `.state != CLOSED`, so mirroring the
+    open COUNT from the heartbeat is exact for pricing."""
+
+    __slots__ = ("state",)
+
+    def __init__(self):
+        self.state = OPEN
+
+
+def wire_exc(exc: BaseException) -> BaseException:
+    """An exception the wire can carry: the instance itself when it
+    pickle-round-trips (our typed admission errors do), else a
+    RejectedError naming the original — a worker error NEVER strands a
+    future for want of picklability."""
+    try:
+        pickle.loads(pickle.dumps(exc))
+        return exc
+    except Exception:
+        return RejectedError(
+            f"Invalid operation: worker-side "
+            f"{type(exc).__name__}: {exc} (unpicklable original — "
+            f"docs/SERVING.md §process-fleet).")
+
+
+# ---------------------------------------------------------------------------
+# the proxy
+# ---------------------------------------------------------------------------
+
+
+class ReplicaProxy:
+    """One supervised worker process behind the ServeEngine duck-type.
+
+    Exposes exactly the surface `ServeFleet` reads off a replica —
+    `submit/drain/close/reap_cancelled/plan/state/name/max_batch/
+    interpret/traj_engine/_pending/_admission/_breakers/_supervisor` —
+    so the fleet's routing, pressure, shed and failover logic runs
+    unchanged over processes (docs/SERVING.md §process-fleet).
+
+    Admission is enforced PROXY-side against the same `max_queue`
+    bound the worker engine runs: the proxy counts every incomplete
+    request (queued or dispatched), the worker counts only queued —
+    the proxy bound is strictly tighter, so a submit the proxy admits
+    is never queue-rejected by the worker (the fleet's `_submit_to`
+    relies on SYNCHRONOUS RejectedError to try the next replica).
+    """
+
+    # what the proxy lock guards (quest-lint QL005, docs/ANALYSIS.md).
+    # _wlock serializes frame WRITES only (one bounded pipe write at a
+    # time) and is never taken with _lock held — the lock-order audit
+    # in tests/test_lint.py pins both orders cycle-free.
+    _GUARDED_BY = {
+        "_lock": ("_inflight", "_payloads", "_pending", "_state",
+                  "_failure_cause", "_last_hb", "_last_snapshot",
+                  "_breakers", "_shipped", "_next_id", "_generation",
+                  "_respawning", "_healthy_noted", "_rpc_waiters"),
+        "_wlock": ("_sock",),
+        # the Popen handle is owned by whichever SINGLE thread holds
+        # the transport: the booting constructor, or the one loss
+        # handler the _respawning flag admits at a time
+        "<owner-thread>": ("_proc",),
+    }
+
+    def __init__(self, *, name: Optional[str] = None,
+                 registry: Optional[M.Registry] = None,
+                 heartbeat_s: Optional[float] = None,
+                 restart_max: Optional[int] = None,
+                 backoff_base_s: float = 0.05,
+                 **engine_kw):
+        from quest_tpu.env import knob_value
+        if heartbeat_s is None:
+            heartbeat_s = knob_value("QUEST_HEARTBEAT_S")
+        if restart_max is None:
+            restart_max = knob_value("QUEST_SERVE_RESTART_MAX")
+        if engine_kw.get("durable_mesh") is not None:
+            raise ValueError(
+                "process replicas build their own mesh from their own "
+                "environment; durable_mesh= is a thread-replica "
+                "option (docs/SERVING.md §process-fleet)")
+        engine_kw.pop("durable_mesh", None)
+        self.name = name or "proc"
+        self.heartbeat_s = float(heartbeat_s)
+        self.registry = registry if registry is not None else M.REGISTRY
+        # mirror the engine-side knob resolution so fleet routing sees
+        # the same max_batch / interpret / traj_engine it would on a
+        # thread replica
+        max_queue = engine_kw.get("max_queue")
+        if max_queue is None:
+            max_queue = knob_value("QUEST_SERVE_MAX_QUEUE")
+        max_batch = engine_kw.get("max_batch")
+        if max_batch is None:
+            max_batch = knob_value("QUEST_SERVE_MAX_BATCH")
+        self.max_batch = int(max_batch)
+        self.interpret = bool(engine_kw.get("interpret", False))
+        self.traj_engine = engine_kw.get("traj_engine")
+        self._engine_kw = dict(engine_kw)
+        self._admission = AdmissionController(max_queue)
+        # the PROCESS restart budget (heartbeat loss / EOF / engine
+        # death), distinct from the worker-internal engine budget
+        self._supervisor = Supervisor(restart_max, base_s=backoff_base_s)
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._inflight: Dict[int, Future] = {}
+        self._payloads: Dict[int, dict] = {}    # rid -> FULL payload
+        self._rpc_waiters: Dict[int, Future] = {}
+        self._pending = 0
+        self._next_id = 0
+        self._state = "running"
+        self._failure_cause: Optional[BaseException] = None
+        self._shipped: set = set()      # digests this worker boot has
+        self._breakers: Dict[tuple, _BreakerMirror] = {}
+        self._last_snapshot: dict = {}
+        self._generation = 0
+        self._respawning = False
+        self._healthy_noted = True      # first result after a respawn
+        self._last_hb = time.monotonic()
+        self._m_losses = self.registry.counter("ipc_worker_losses")
+        self._m_respawns = self.registry.counter("ipc_worker_respawns")
+        self._m_resubmits = self.registry.counter("ipc_resubmits")
+        self._proc: Optional[subprocess.Popen] = None
+        self._sock: Optional[socket.socket] = None
+        self._spawn(respawn=False)
+        self._start_rx(self._generation)
+
+    # -- spawn / transport -------------------------------------------------
+
+    def _spawn(self, respawn: bool) -> None:
+        """Boot one worker process and wait for its hello. Raises on a
+        failed exec or a boot that never says hello — the caller
+        (constructor or loss handler) owns the budget decision."""
+        if _F.ACTIVE:
+            _F.check("fleet.spawn", replica=self.name, respawn=respawn)
+        parent, child = socket.socketpair()
+        env = os.environ.copy()
+        # one interpreter per core is the scaling model: an
+        # oversubscribed intra-op thread pool in every worker would
+        # thrash the host the replicas are meant to share
+        env.setdefault("OMP_NUM_THREADS", "1")
+        env.setdefault("OPENBLAS_NUM_THREADS", "1")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "quest_tpu.serve.worker_main",
+                 "--fd", str(child.fileno())],
+                pass_fds=(child.fileno(),), env=env,
+                stdin=subprocess.DEVNULL)
+        except OSError:
+            parent.close()
+            child.close()
+            raise
+        child.close()
+        try:
+            send_frame(parent, {
+                "t": "init", "name": self.name,
+                "heartbeat_s": self.heartbeat_s,
+                "engine_kw": self._engine_kw})
+            parent.settimeout(_BOOT_TIMEOUT_S)
+            hello = recv_frame(parent)
+            if hello.get("t") != "hello":
+                raise RuntimeError(
+                    f"worker {self.name} booted with {hello!r}, not "
+                    f"hello")
+            if hello.get("error") is not None:
+                raise RuntimeError(
+                    f"worker {self.name} failed to build its engine: "
+                    f"{hello['error']}")
+        except BaseException:
+            parent.close()
+            proc.kill()
+            proc.wait()
+            raise
+        # the rx pump polls at a fraction of the heartbeat so a lost
+        # worker is noticed within one interval
+        parent.settimeout(max(0.05, self.heartbeat_s / 2.0))
+        with self._lock:
+            self._generation += 1
+            self._last_hb = time.monotonic()
+            self._shipped = set()
+            self._healthy_noted = False
+        with self._wlock:
+            self._sock = parent
+        self._proc = proc
+
+    def _start_rx(self, gen: int) -> None:
+        t = threading.Thread(target=self._rx_main, args=(gen,),
+                             name=f"ipc-rx-{self.name}", daemon=True)
+        t.start()
+
+    def _send(self, payload: dict) -> None:
+        """Write one frame to the current worker. OSError/EOF here is a
+        transport loss — the caller decides whether that fails the
+        request or triggers loss handling."""
+        if _F.ACTIVE:
+            _F.check("ipc.send", replica=self.name, type=payload["t"])
+        with self._wlock:
+            sock = self._sock
+            if sock is None:
+                raise OSError("ipc transport is down")
+            # a pipe write is a bounded kernel-buffer copy; serializing
+            # writers here is the framing guarantee (no interleaved
+            # frames), and no code path nests another lock under it
+            # (the rx pump takes it only bare, to peek at the socket)
+            send_frame(sock, payload)
+
+    def _send_submit(self, payload: dict) -> None:
+        """Ship one submit payload, attaching the circuit descriptor on
+        the digest's first trip to THIS worker boot (the value-keyed
+        descriptor discipline — module docstring)."""
+        dg = payload["digest"]
+        with self._lock:
+            first = dg not in self._shipped
+            if first:
+                self._shipped.add(dg)
+        wire = dict(payload)
+        if not first:
+            wire["circ"] = None
+        self._send(wire)
+
+    # -- engine duck-type --------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        """'running' | 'failed' (process restart budget exhausted) |
+        'closed' — the ServeEngine.state contract."""
+        # quest-lint: disable=QL005(observability fast path: racy flag read, engine.state contract)
+        return self._state
+
+    def plan(self, circuit, *, batch: Optional[int] = None,
+             density: bool = False, dtype=None):
+        """ServeEngine.plan for a process replica: plans are
+        content-addressed host artifacts on SHARED disk, so pricing in
+        the parent and loading in the worker are the same plan
+        (docs/PLANNING.md)."""
+        import numpy as np
+
+        from quest_tpu import plan as P
+        return P.autotune(circuit,
+                          state_kind="density" if density else "pure",
+                          dtype=np.float32 if dtype is None else dtype,
+                          batch=batch)
+
+    def submit(self, circuit, state=None, shots: Optional[int] = None, *,
+               key=None, deadline_s: Optional[float] = None,
+               observable=None, density: bool = False,
+               durable_dir: Optional[str] = None,
+               durable_every: Optional[int] = None) -> Future:
+        """ServeEngine.submit over the wire: admission is checked
+        proxy-side (synchronous RejectedError — the fleet's retry
+        contract), the payload ships as a value-keyed descriptor, and
+        the returned future resolves from the worker's result frame."""
+        import numpy as np
+        if (state is None) == (shots is None):
+            raise ValueError(
+                "submit() takes exactly one of state= (apply request) "
+                "or shots= (trajectory request)")
+        dg = circuit_digest(circuit)
+        enc_key = encode_key(key)
+        np_state = None if state is None else np.asarray(state)
+        payload = {
+            "t": "submit", "digest": dg,
+            "circ": circuit_descriptor(circuit),
+            "state": np_state, "shots": shots, "key": enc_key,
+            "observable": observable, "density": bool(density),
+            "durable_dir": durable_dir, "durable_every": durable_every,
+            "deadline_s": deadline_s,
+        }
+        with self._lock:
+            if self._state == "closed":
+                raise RejectedError(
+                    "Invalid operation: submit() after close() — this "
+                    "process replica is shut down (docs/SERVING.md "
+                    "§process-fleet).")
+            if self._state == "failed":
+                raise RejectedError(
+                    f"Invalid operation: process replica {self.name!r} "
+                    f"is FAILED — its respawn budget is exhausted; "
+                    f"last cause: {self._failure_cause!r} "
+                    f"(docs/SERVING.md §process-fleet)."
+                ) from self._failure_cause
+            self._admission.admit(self._pending)
+            rid = self._next_id
+            self._next_id += 1
+            payload["id"] = rid
+            fut: Future = Future()
+            self._inflight[rid] = fut
+            self._payloads[rid] = payload
+            self._pending += 1
+            gen = self._generation
+            respawning = self._respawning
+        if respawning:
+            # the loss handler owns the transport: it will resubmit
+            # every payload in the ledger (ours included) once the
+            # fresh worker is up
+            return fut
+        try:
+            self._send_submit(payload)
+        except (TypeError, AttributeError, pickle.PicklingError) as e:
+            # AttributeError is pickle's voice for a local/lambda
+            # callable ("Can't pickle local object ...")
+            with self._lock:
+                self._drop_locked(rid)
+            raise ValueError(
+                f"process replicas require picklable request payloads "
+                f"(state/key/observable): {e!r} — run this workload on "
+                f"thread replicas (ServeFleet(process=False)) or make "
+                f"the observable a module-level callable "
+                f"(docs/SERVING.md §process-fleet)") from e
+        except OSError as e:
+            # transport died under the submit: the request is already
+            # in the ledger, so it rides the loss handler's resubmit
+            self._on_worker_loss(gen, e)
+        return fut
+
+    def reap_cancelled(self) -> int:
+        """Drop inflight requests whose futures were cancelled (the
+        fleet's shed eviction path) and tell the worker to reap its
+        side. Returns the number dropped."""
+        with self._lock:
+            gone = [rid for rid, f in self._inflight.items()
+                    if f.cancelled()]
+            for rid in gone:
+                self._drop_locked(rid)
+        for rid in gone:
+            try:
+                self._send({"t": "cancel", "id": rid})
+            except OSError:
+                break   # loss handling owns the transport now
+        return len(gone)
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Flush the worker's queues: one drain RPC, bounded by
+        `timeout_s` at the worker plus transport slack here."""
+        with self._lock:
+            if self._state == "closed":
+                raise RejectedError(
+                    "Invalid operation: drain() after close() "
+                    "(docs/SERVING.md §process-fleet).")
+            if self._state == "failed" or self._respawning:
+                return      # futures resolve via fail/resubmit paths
+            rid = self._next_id
+            self._next_id += 1
+            waiter: Future = Future()
+            self._rpc_waiters[rid] = waiter
+        try:
+            self._send({"t": "drain", "id": rid, "timeout_s": timeout_s})
+            wait = (None if timeout_s is None
+                    else timeout_s + _RPC_SLACK_S)
+            reply = waiter.result(timeout=wait)
+        except OSError:
+            return          # worker lost mid-drain; loss handler runs
+        except (TimeoutError, _FutureTimeout):
+            # on 3.10 Future.result raises concurrent.futures'
+            # TimeoutError, a DIFFERENT class from the builtin (they
+            # merge in 3.11) — re-raise as the builtin so callers'
+            # `except TimeoutError` contracts hold
+            raise TimeoutError(
+                f"replica {self.name!r} drain() reply overdue "
+                f"(timeout_s={timeout_s})") from None
+        finally:
+            with self._lock:
+                self._rpc_waiters.pop(rid, None)
+        if not reply.get("ok", False):
+            err = reply.get("error")
+            if isinstance(err, BaseException):
+                raise err
+            raise TimeoutError(str(err))
+
+    def close(self, timeout_s: Optional[float] = None) -> None:
+        """Graceful worker shutdown: drain-and-exit RPC, then
+        terminate/kill as escalation. Idempotent."""
+        with self._lock:
+            if self._state == "closed":
+                return
+            was_failed = self._state == "failed"
+            self._state = "closed"
+            leftovers = list(self._inflight.values())
+            self._inflight.clear()
+            self._payloads.clear()
+            self._pending = 0
+        proc = self._proc
+        if not was_failed and proc is not None:
+            try:
+                self._send({"t": "close", "timeout_s": timeout_s})
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=(timeout_s if timeout_s is not None
+                                   else 30.0))
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        elif proc is not None:
+            proc.kill()
+            proc.wait()
+        with self._wlock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+        for f in leftovers:
+            if not f.done() and f.set_running_or_notify_cancel():
+                f.set_exception(RejectedError(
+                    "Invalid operation: process replica closed with "
+                    "the request incomplete (docs/SERVING.md "
+                    "§process-fleet)."))
+
+    # -- stats -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The worker registry's last heartbeat snapshot (counters/
+        gauges/histograms) — what the fleet's aggregated scrape merges
+        (docs/SERVING.md §process-fleet)."""
+        with self._lock:
+            return dict(self._last_snapshot)
+
+    def worker_pid(self) -> Optional[int]:
+        """The live worker's OS pid (tests SIGKILL/SIGSTOP it)."""
+        proc = self._proc
+        return None if proc is None else proc.pid
+
+    # -- rx pump + supervision ---------------------------------------------
+
+    def _rx_main(self, gen: int) -> None:
+        """One pump per worker generation: results, heartbeats, RPC
+        replies; detects loss (EOF, poisoned frame, heartbeat silence,
+        engine-FAILED heartbeat) and hands off to the loss handler."""
+        while True:
+            with self._lock:
+                if self._generation != gen or self._state == "closed":
+                    return
+                last_hb = self._last_hb
+            with self._wlock:
+                sock = self._sock
+            if sock is None:
+                return
+            try:
+                frame = recv_frame(sock)
+            except socket.timeout:
+                if (time.monotonic() - last_hb
+                        > _HB_MISS * self.heartbeat_s):
+                    self._on_worker_loss(gen, TimeoutError(
+                        f"worker {self.name!r} missed {_HB_MISS} "
+                        f"heartbeats (QUEST_HEARTBEAT_S="
+                        f"{self.heartbeat_s})"))
+                    return
+                continue
+            except (EOFError, OSError, ValueError,
+                    pickle.UnpicklingError) as e:
+                self._on_worker_loss(gen, e)
+                return
+            if _F.ACTIVE:
+                try:
+                    _F.check("ipc.recv", replica=self.name,
+                             type=frame.get("t"))
+                except BaseException as e:  # noqa: BLE001 - typed loss
+                    self.registry.counter("serve_faults_injected").inc()
+                    self._on_worker_loss(gen, e)
+                    return
+            if not self._on_frame(gen, frame):
+                return
+
+    def _on_frame(self, gen: int, frame: dict) -> bool:
+        """Dispatch one worker frame; False ends this pump."""
+        t = frame.get("t")
+        if t == "result":
+            with self._lock:
+                fut = self._inflight.pop(frame["id"], None)
+                self._payloads.pop(frame["id"], None)
+                if fut is not None:
+                    self._pending -= 1
+                note_healthy = not self._healthy_noted
+                self._healthy_noted = True
+            if note_healthy:
+                # first completed request since the (re)spawn: the
+                # worker is serving, refill the crash-loop budget (the
+                # engine's record_success-after-dispatch policy)
+                self._supervisor.record_success()
+            if fut is None or fut.done():
+                return True
+            if not fut.set_running_or_notify_cancel():
+                return True
+            if frame.get("ok"):
+                fut.set_result(frame.get("value"))
+            else:
+                fut.set_exception(frame.get("error"))
+            return True
+        if t == "hb":
+            with self._lock:
+                self._last_hb = time.monotonic()
+                self._last_snapshot = frame.get("snapshot", {})
+                self._breakers = {
+                    ("worker", i): _BreakerMirror()
+                    for i in range(int(frame.get("open_breakers", 0)))}
+            if frame.get("state") == "failed":
+                # the worker's ENGINE exhausted its own budget: the
+                # process is alive but serving nothing — treat as a
+                # worker loss so the respawn gets a fresh engine
+                self._on_worker_loss(gen, RejectedError(
+                    f"worker {self.name!r} engine went FAILED "
+                    f"in-process (docs/SERVING.md §process-fleet)."))
+                return False
+            return True
+        if t == "drained":
+            with self._lock:
+                waiter = self._rpc_waiters.pop(frame["id"], None)
+            if waiter is not None and not waiter.done():
+                waiter.set_result(frame)
+            return True
+        return True     # unknown frame types are forward-compatible
+
+    def _drop_locked(self, rid: int) -> None:
+        if self._inflight.pop(rid, None) is not None:
+            self._pending -= 1
+        self._payloads.pop(rid, None)
+
+    def _on_worker_loss(self, gen: int, cause: BaseException) -> None:
+        """Kill + respawn under the Supervisor budget, resubmitting
+        every incomplete request to the fresh worker (serve-once-safe
+        across a dead process — module docstring); budget exhausted =>
+        FAILED, incomplete futures resolve requeue-typed so the fleet
+        fails them over."""
+        with self._lock:
+            if (self._state != "running" or self._respawning
+                    or self._generation != gen):
+                return
+            self._respawning = True
+            self._breakers = {}
+            # dead worker's RPC replies are never coming; callers time
+            # out on their own slack
+            self._rpc_waiters.clear()
+        self._m_losses.inc()
+        proc = self._proc
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+        with self._wlock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+        while True:
+            with self._lock:
+                if self._state != "running":
+                    self._respawning = False
+                    return
+            delay = self._supervisor.next_backoff()
+            if delay is None:
+                self._fail(cause)
+                return
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                self._spawn(respawn=True)
+                break
+            except BaseException as e:  # noqa: BLE001 - budget loop
+                cause = e
+        self._m_respawns.inc()
+        with self._lock:
+            if self._state != "running":
+                # closed mid-respawn: close() already resolved the
+                # ledger; reap the worker we just booted
+                self._respawning = False
+                proc, self._proc = self._proc, None
+            else:
+                new_gen = self._generation
+                resubmit = [self._payloads[rid]
+                            for rid in sorted(self._payloads)]
+                # snapshot + flag-clear are ATOMIC: a submit landing
+                # after this block sends itself on the new socket, one
+                # landing before it is in the snapshot — no window
+                # where a payload is neither
+                self._respawning = False
+                proc = None
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+            return
+        self._start_rx(new_gen)
+        for payload in resubmit:
+            try:
+                self._send_submit(payload)
+                self._m_resubmits.inc()
+            except OSError as e:
+                self._on_worker_loss(new_gen, e)
+                return
+
+
+    def _fail(self, cause: BaseException) -> None:
+        with self._lock:
+            if self._state != "running":
+                self._respawning = False
+                return
+            self._state = "failed"
+            self._failure_cause = cause
+            self._respawning = False
+            leftovers = list(self._inflight.values())
+            self._inflight.clear()
+            self._payloads.clear()
+            self._pending = 0
+        # requeue-typed (RejectedError, never DeadlineExceeded): the
+        # fleet's failover contract re-serves these on survivors — safe
+        # across a dead process, which delivered no result and never
+        # will (module docstring)
+        for f in leftovers:
+            if not f.done() and f.set_running_or_notify_cancel():
+                f.set_exception(RejectedError(
+                    f"Invalid operation: process replica {self.name!r} "
+                    f"lost its worker past the respawn budget; last "
+                    f"cause: {cause!r} — the fleet requeues this "
+                    f"request on a survivor (docs/SERVING.md "
+                    f"§process-fleet)."))
+
+    def __enter__(self) -> "ReplicaProxy":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -351,6 +351,75 @@ def parse_scrape(text: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# multi-registry aggregation (the process fleet's per-replica scrapes)
+# ---------------------------------------------------------------------------
+
+
+def merge_snapshots(snaps) -> dict:
+    """Fold several `snapshot()` dicts into one — the process fleet's
+    aggregation (docs/SERVING.md §process-fleet): each worker process
+    keeps its own Registry and ships snapshots over the heartbeat;
+    this merge makes one fleet-wide exposition out of them. Counters
+    and gauges SUM across replicas (pending/occupancy gauges are
+    additive; a single-writer gauge like fleet_pressure appears in one
+    snapshot only, so the sum is the identity). Histogram summaries
+    merge as: exact summed `count`, count-weighted `mean`, and the
+    WORST replica's quantiles — an upper bound, which is the
+    conservative direction for latency alerting (exact cross-process
+    quantiles would need the raw reservoirs on the wire every beat)."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, dict] = {}
+    for snap in snaps:
+        if not snap:
+            continue
+        for n, v in snap.get("counters", {}).items():
+            counters[n] = counters.get(n, 0) + v
+        for n, v in snap.get("gauges", {}).items():
+            gauges[n] = gauges.get(n, 0.0) + v
+        for n, s in snap.get("histograms", {}).items():
+            cur = hists.get(n)
+            if cur is None:
+                hists[n] = dict(s)
+                continue
+            total = cur["count"] + s["count"]
+            if total:
+                cur["mean"] = (cur["mean"] * cur["count"]
+                               + s["mean"] * s["count"]) / total
+            cur["count"] = total
+            for q in ("p50", "p95", "p99"):
+                cur[q] = max(cur[q], s[q])
+    return {"counters": dict(sorted(counters.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "histograms": dict(sorted(hists.items()))}
+
+
+def render_snapshot(snap: dict) -> str:
+    """Prometheus text exposition of a `snapshot()`-schema dict — the
+    same format `Registry.scrape()` emits, so `parse_scrape`
+    round-trips it and scripts/serve_stats.py prints it. Histogram
+    `_sum` derives from mean*count (snapshots carry mean, not sum)."""
+    lines = []
+    for n, v in snap.get("counters", {}).items():
+        n = _prom_name(n)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_prom_value(v)}")
+    for n, v in snap.get("gauges", {}).items():
+        n = _prom_name(n)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_value(v)}")
+    for n, s in snap.get("histograms", {}).items():
+        n = _prom_name(n)
+        lines.append(f"# TYPE {n} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(f'{n}{{quantile="{q}"}} '
+                         f"{_prom_value(s.get(key, 0.0))}")
+        lines.append(f"{n}_sum {_prom_value(s.get('mean', 0.0) * s.get('count', 0))}")
+        lines.append(f"{n}_count {int(s.get('count', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # the scrape endpoint: python -m quest_tpu.serve.metrics --port 9464
 # ---------------------------------------------------------------------------
 
@@ -358,7 +427,10 @@ def parse_scrape(text: str) -> dict:
 def serve_scrape(registry: Optional[Registry] = None,
                  host: str = "127.0.0.1", port: int = 0):
     """An HTTP server exposing `registry` (default: the process-wide
-    REGISTRY) at /metrics in Prometheus text format. Returns the
+    REGISTRY) at /metrics in Prometheus text format. `registry` may be
+    anything with a `.scrape() -> str` — a Registry, or a process-mode
+    ServeFleet whose scrape aggregates its per-replica worker
+    snapshots (docs/SERVING.md §process-fleet). Returns the
     ThreadingHTTPServer — callers run `serve_forever()` (the __main__
     below does) or drive it from a daemon thread and `shutdown()` when
     done (tests scrape a real GET this way). port=0 binds an ephemeral

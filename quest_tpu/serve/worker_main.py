@@ -1,0 +1,184 @@
+"""Process-replica worker entry: one interpreter, one engine, one pipe.
+
+`python -m quest_tpu.serve.worker_main --fd N` is what a
+`serve.ipc.ReplicaProxy` execs per replica (docs/SERVING.md
+§process-fleet): fd N is the worker end of the proxy's socketpair. The
+protocol is deliberately thin — everything hard (coalescing,
+supervision of the worker THREAD, breakers, watchdog, durable resume)
+is the ordinary in-process `ServeEngine` this module wraps:
+
+  * read the `init` frame (engine kwargs, heartbeat cadence), build a
+    ServeEngine over a private Registry, answer `hello` (or `hello`
+    with an error string — a boot failure is loud, never a hang).
+  * rx loop: `submit` frames rebuild value-keyed circuit descriptors
+    (cached by digest, so the on-instance compiled-program cache and
+    the shared on-disk plan/XLA caches do their job), feed the engine,
+    and ship each result/error back as a `result` frame; `cancel`
+    reaps; `drain` round-trips the engine's drain; `close` exits.
+  * a heartbeat thread ships engine health (state, pending, open
+    breakers, restart budget) plus a full registry snapshot every
+    `heartbeat_s` — the proxy's liveness signal AND the fleet's
+    per-replica scrape feed in one frame.
+
+Engine-FAILED rejections of queued requests are NOT forwarded: the
+heartbeat reports the failed state, the proxy kills/respawns this
+process and resubmits — forwarding them would race the fleet's
+failover requeue against a proxy that still says 'running'
+(serve/ipc.py's loss handler owns that transition).
+
+A parent EOF means the proxy (or its whole process) died: close the
+engine briefly and exit — an orphaned worker must never outlive its
+fleet. Fault plans arm through the environment (QUEST_FAULT_PLAN is
+inherited), so chaos soaks reach inside worker processes with the
+same grammar they use in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import threading
+from typing import Optional
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="quest_tpu serve fleet worker process (internal: "
+                    "spawned by serve.ipc.ReplicaProxy)")
+    ap.add_argument("--fd", type=int, required=True,
+                    help="inherited socketpair fd to the proxy")
+    args = ap.parse_args(argv)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM,
+                         fileno=args.fd)
+
+    from quest_tpu.serve.ipc import (decode_key, rebuild_circuit,
+                                     recv_frame, send_frame, wire_exc)
+    init = recv_frame(sock)
+    if init.get("t") != "init":
+        return 2
+    name = init.get("name", "proc")
+    heartbeat_s = float(init.get("heartbeat_s", 0.5))
+    wlock = threading.Lock()
+
+    def send(payload: dict) -> None:
+        with wlock:
+            send_frame(sock, payload)
+
+    try:
+        from quest_tpu.serve import metrics as M
+        from quest_tpu.serve.admission import (DeadlineExceeded,
+                                               RejectedError)
+        from quest_tpu.serve.engine import ServeEngine
+        reg = M.Registry()
+        eng = ServeEngine(registry=reg, name=name,
+                          **init.get("engine_kw", {}))
+    except BaseException as e:  # noqa: BLE001 - boot must answer
+        send({"t": "hello", "pid": os.getpid(),
+              "error": f"{type(e).__name__}: {e}"})
+        return 1
+    send({"t": "hello", "pid": os.getpid(), "error": None})
+
+    stop = threading.Event()
+
+    def hb_main() -> None:
+        while not stop.wait(heartbeat_s):
+            try:
+                hb = {"t": "hb", "snapshot": reg.snapshot()}
+                hb.update(eng.health())
+                send(hb)
+            except OSError:
+                return
+
+    threading.Thread(target=hb_main, name="ipc-hb",
+                     daemon=True).start()
+
+    circuits: dict = {}     # digest -> rebuilt Circuit (value-keyed)
+    inner: dict = {}        # rid -> inner engine Future (for cancel)
+
+    def on_done(rid: int, f) -> None:
+        inner.pop(rid, None)
+        if f.cancelled():
+            return          # proxy-initiated reap: nothing to report
+        exc = f.exception()
+        try:
+            if exc is None:
+                import jax
+                send({"t": "result", "id": rid, "ok": True,
+                      "value": jax.device_get(f.result())})
+                return
+            if (isinstance(exc, RejectedError)
+                    and not isinstance(exc, DeadlineExceeded)
+                    and eng.state == "failed"):
+                return      # module docstring: the proxy resubmits
+            send({"t": "result", "id": rid, "ok": False,
+                  "error": wire_exc(exc)})
+        except OSError:
+            pass            # parent gone; the rx loop will EOF out
+
+    def on_submit(msg: dict) -> None:
+        rid = msg["id"]
+        circ = circuits.get(msg["digest"])
+        if circ is None:
+            desc = msg.get("circ")
+            if desc is None:
+                send({"t": "result", "id": rid, "ok": False,
+                      "error": RejectedError(
+                          f"Invalid operation: worker {name!r} has no "
+                          f"circuit for digest {msg['digest'][:12]}… "
+                          f"and the frame carries none (proxy/worker "
+                          f"shipping desync — docs/SERVING.md "
+                          f"§process-fleet).")})
+                return
+            circ = circuits[msg["digest"]] = rebuild_circuit(desc)
+        try:
+            fut = eng.submit(
+                circ, state=msg["state"], shots=msg["shots"],
+                key=decode_key(msg["key"]),
+                deadline_s=msg["deadline_s"],
+                observable=msg["observable"], density=msg["density"],
+                durable_dir=msg["durable_dir"],
+                durable_every=msg["durable_every"])
+        except BaseException as e:  # noqa: BLE001 - typed reply
+            send({"t": "result", "id": rid, "ok": False,
+                  "error": wire_exc(e)})
+            return
+        inner[rid] = fut
+        fut.add_done_callback(lambda f, rid=rid: on_done(rid, f))
+
+    while True:
+        try:
+            msg = recv_frame(sock)
+        except (EOFError, OSError):
+            # the proxy died: never outlive the fleet
+            stop.set()
+            eng.close(timeout_s=5.0)
+            return 0
+        t = msg.get("t")
+        if t == "submit":
+            on_submit(msg)
+        elif t == "cancel":
+            f = inner.get(msg["id"])
+            if f is not None and f.cancel():
+                eng.reap_cancelled()
+        elif t == "drain":
+            try:
+                eng.drain(timeout_s=msg.get("timeout_s"))
+                send({"t": "drained", "id": msg["id"], "ok": True})
+            except BaseException as e:  # noqa: BLE001 - typed reply
+                send({"t": "drained", "id": msg["id"], "ok": False,
+                      "error": wire_exc(e)})
+        elif t == "close":
+            stop.set()
+            try:
+                eng.close(timeout_s=msg.get("timeout_s"))
+            finally:
+                try:
+                    send({"t": "closed"})
+                except OSError:
+                    pass
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

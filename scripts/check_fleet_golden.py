@@ -13,12 +13,20 @@
     `durable.preempt` kill must fire mid-checkpoint-chain, the job
     must resume from the chain (not restart hollow from op 0), and the
     final amplitudes must hash bit-identical to an uninterrupted
-    `run_durable` (`fleet_durable_resume_bitexact`).
+    `run_durable` (`fleet_durable_resume_bitexact`), or
+  * the PROCESS fleet (docs/SERVING.md §process-fleet) breaks one of
+    its three PR-18 contracts — a 2-process fleet must serve results
+    BIT-IDENTICAL to one in-process ServeEngine (the IPC boundary is
+    a transport, never a numerics change); a mid-stream SIGKILL of one
+    worker must lose ZERO accepted requests (heartbeat-loss respawn +
+    resubmit); and the autoscaler must CONVERGE — grow under a held
+    backlog, shrink back to min when it drains, no thrash past the
+    bounds.
 
 The committed contracts live HERE (the CI gate) next to the
 sweep/batch/expec/comm/durable gates; the per-path pins live in
-tests/test_fleet.py — a change that moves either must update both,
-consciously.
+tests/test_fleet.py and tests/test_ipc.py — a change that moves
+either must update both, consciously.
 """
 
 import json
@@ -29,6 +37,113 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def check_process_fleet() -> bool:
+    """The three PR-18 process-fleet gates, run directly (no bench
+    sweep — CI wants the fast fail): bit-identity vs one in-process
+    engine, zero loss under SIGKILL, autoscaler convergence."""
+    import signal
+
+    import jax
+    import numpy as np
+
+    import bench as B
+    from quest_tpu.serve import Autoscaler, ServeEngine, ServeFleet, metrics
+
+    ok = True
+    n = 9
+    n_req = 32
+    circ = B._build_circuit(n)
+    rng = np.random.default_rng(7)
+    states = rng.standard_normal((n_req, 2, 1 << n)).astype(np.float32)
+    states /= np.sqrt((states ** 2).sum(axis=(1, 2), keepdims=True))
+
+    def bitexact(a, b) -> bool:
+        """Recursive bit-identity: shots results are tuples of arrays
+        with per-element shapes, state results plain arrays."""
+        if isinstance(a, (tuple, list)):
+            return (isinstance(b, (tuple, list)) and len(a) == len(b)
+                    and all(bitexact(x, y) for x, y in zip(a, b)))
+        return np.array_equal(np.asarray(jax.device_get(a)),
+                              np.asarray(jax.device_get(b)))
+
+    # gate 1: bit-identity — the same stream through one in-process
+    # engine and through a 2-process fleet must match to the bit
+    with ServeEngine(max_wait_ms=2, max_batch=8,
+                     registry=metrics.Registry()) as eng:
+        refs = [eng.submit(circ, state=states[i]).result(timeout=300)
+                for i in range(n_req)]
+        ref_shots = eng.submit(circ, shots=64,
+                               key=jax.random.key(3)).result(timeout=300)
+    with ServeFleet(replicas=2, process=True, max_wait_ms=2,
+                    max_batch=8, registry=metrics.Registry()) as fleet:
+        outs = [fleet.submit(circ, state=states[i]).result(timeout=300)
+                for i in range(n_req)]
+        out_shots = fleet.submit(
+            circ, shots=64, key=jax.random.key(3)).result(timeout=300)
+        mismatch = sum(not bitexact(r, o) for r, o in zip(refs, outs))
+        if mismatch or not bitexact(ref_shots, out_shots):
+            print(f"REGRESSION: process fleet served {mismatch} "
+                  f"state result(s) (shots match: "
+                  f"{bitexact(ref_shots, out_shots)}) that are "
+                  f"NOT bit-identical to the in-process engine — the "
+                  f"IPC boundary changed numerics", file=sys.stderr)
+            ok = False
+
+        # gate 2: SIGKILL one worker mid-stream — zero accepted
+        # requests may be lost (respawn + resubmit on the proxy, or
+        # requeue onto the survivor)
+        futs = [fleet.submit(circ, state=states[i])
+                for i in range(n_req)]
+        os.kill(fleet._engines[0].worker_pid(), signal.SIGKILL)
+        lost = 0
+        for f in futs:
+            try:
+                f.result(timeout=300)
+            except Exception:
+                lost += 1
+        if lost:
+            print(f"REGRESSION: SIGKILL of one process replica lost "
+                  f"{lost}/{n_req} accepted request(s) — the "
+                  f"heartbeat-loss respawn/resubmit contract broke",
+                  file=sys.stderr)
+            ok = False
+
+    # gate 3: autoscaler convergence — a held backlog must grow the
+    # fleet toward max, the drained fleet must shrink back to min, and
+    # the loop must sit still at both ends (no thrash past the bounds)
+    # shed_threshold at its 1.0 ceiling and a backlog priced under it:
+    # this leg needs the queue to HOLD (the autoscaler's signal), not
+    # shed away. 13 queued / 16 capacity = 0.81 pressure at 1 replica,
+    # 0.41 at 2, 0.27 at 3 — a (0.1, 0.3) band converges at max=3.
+    with ServeFleet(replicas=1, process=True, max_wait_ms=600_000,
+                    max_batch=4 * n_req, max_queue=16,
+                    shed_threshold=1.0,
+                    registry=metrics.Registry()) as fleet:
+        auto = Autoscaler(fleet, min_replicas=1, max_replicas=3,
+                          high_water=0.3, low_water=0.1,
+                          up_ticks=1, down_ticks=2, cooldown_ticks=0)
+        futs = [fleet.submit(circ, state=states[i]) for i in range(13)]
+        grew = [auto.tick() for _ in range(6)]
+        if fleet.replicas != 3 or grew.count("up") != 2:
+            print(f"REGRESSION: autoscaler did not converge up under "
+                  f"backlog (replicas={fleet.replicas}, "
+                  f"actions={auto.stats()['actions']})", file=sys.stderr)
+            ok = False
+        fleet.drain(timeout_s=300)
+        for f in futs:
+            f.result(timeout=300)
+        shrank = [auto.tick() for _ in range(8)]
+        if fleet.replicas != 1 or shrank.count("down") != 2:
+            print(f"REGRESSION: autoscaler did not converge back to "
+                  f"min after drain (replicas={fleet.replicas}, "
+                  f"actions={auto.stats()['actions']})", file=sys.stderr)
+            ok = False
+    if ok:
+        print("process fleet gates: bit-identity, kill-zero-loss, "
+              "autoscaler convergence all hold")
+    return ok
 
 
 def main() -> int:
@@ -72,6 +187,8 @@ def main() -> int:
         print("REGRESSION: the preempted durable-through-serve job is "
               "NOT bit-identical to the uninterrupted run",
               file=sys.stderr)
+        ok = False
+    if not check_process_fleet():
         ok = False
     return 0 if ok else 1
 

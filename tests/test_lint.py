@@ -844,6 +844,68 @@ def test_fleet_knobs_registered_with_loud_parsers():
     assert KNOBS["QUEST_SERVE_PRIORITIES"].default == 2
 
 
+def test_process_fleet_knob_registry_coverage(tmp_path):
+    """QUEST_FLEET_PROC / QUEST_FLEET_{MIN,MAX}_REPLICAS /
+    QUEST_HEARTBEAT_S coverage of the registry rules (ISSUE 18): all
+    four are RUNTIME scope — read once at fleet/autoscaler
+    construction, never inside a compiled path — so a registry read
+    off-jit is clean, the same read on a jit-reachable path fires
+    QL001, and a direct os.environ read fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        def configure_process_fleet():
+            a = knob_value("QUEST_FLEET_PROC")
+            b = knob_value("QUEST_FLEET_MIN_REPLICAS")
+            c = knob_value("QUEST_FLEET_MAX_REPLICAS")
+            d = knob_value("QUEST_HEARTBEAT_S")
+            return a, b, c, d
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_FLEET_PROC"):
+                return amps * 2
+            return amps
+
+        def bypass():
+            return os.environ.get("QUEST_HEARTBEAT_S")
+    """, name="procfleetknobs.py")
+    assert not [v for v in vs if v.line in (7, 8, 9, 10)], vs
+    q1 = [v for v in vs if v.rule == "QL001"]
+    assert len(q1) == 1 and q1[0].line == 15, vs
+    assert "scope='runtime'" in q1[0].message, q1
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and q4[0].line == 20, vs
+    assert "bypasses" in q4[0].message, q4
+
+
+def test_process_fleet_knobs_registered_with_loud_parsers():
+    """The process-fleet knobs are registry-backed with malformed
+    samples that REJECT loudly (docs/CONFIG.md parity rides
+    test_docs.py), and their parsers enforce the documented ranges:
+    PROC is strict 0/1, the replica bounds are >= 1 integers, the
+    heartbeat is a positive float."""
+    from quest_tpu.env import KNOBS
+    for name in ("QUEST_FLEET_PROC", "QUEST_FLEET_MIN_REPLICAS",
+                 "QUEST_FLEET_MAX_REPLICAS", "QUEST_HEARTBEAT_S"):
+        k = KNOBS[name]
+        assert k.scope == "runtime" and k.layer == "serve", k
+        assert k.malformed is not None
+        with pytest.raises(ValueError):
+            k.parse(k.malformed)
+    assert KNOBS["QUEST_FLEET_PROC"].default is False
+    assert KNOBS["QUEST_FLEET_PROC"].parse("1") is True
+    assert KNOBS["QUEST_FLEET_MIN_REPLICAS"].default == 1
+    assert KNOBS["QUEST_FLEET_MAX_REPLICAS"].default == 4
+    with pytest.raises(ValueError):
+        KNOBS["QUEST_FLEET_MIN_REPLICAS"].parse("0")
+    assert KNOBS["QUEST_HEARTBEAT_S"].default == 0.25
+    with pytest.raises(ValueError):
+        KNOBS["QUEST_HEARTBEAT_S"].parse("-1")
+
+
 def test_ql003_catches_tracer_leaks(tmp_path):
     vs = _lint_fixture(tmp_path, """
         import jax
@@ -1328,6 +1390,50 @@ def test_fleet_workload_lock_order_is_acyclic():
         fl.drain(timeout_s=300)
         for f in futs:
             f.result(timeout=60)
+    assert aud.acquisitions, "no audited acquisitions recorded"
+    aud.assert_acyclic()
+
+
+def test_process_fleet_workload_lock_order_is_acyclic():
+    """The PR-18 process stack under audit: wrap the fleet lock, every
+    ReplicaProxy's ledger lock AND write lock (the two locks the IPC
+    boundary adds — rx pump, submit path, heartbeat bookkeeping), the
+    shared registry lock, and the autoscaler's streak lock, run a
+    mixed workload (submits + stats + scrape + autoscaler ticks +
+    drain) through a 2-process fleet, and assert the recorded
+    acquisition-order graph is acyclic — the checked claim behind the
+    _GUARDED_BY maps in serve/ipc.py and serve/autoscaler.py."""
+    import threading
+
+    from quest_tpu.circuit import Circuit
+    from quest_tpu.serve import Autoscaler, ServeFleet, metrics
+
+    rng = np.random.default_rng(11)
+    n = 4
+    states = rng.standard_normal((8, 2, 1 << n)).astype(np.float32)
+    states /= np.sqrt((states ** 2).sum(axis=(1, 2), keepdims=True))
+    circ = Circuit(n).h(0).cnot(0, 1).rz(2, 0.25)
+
+    aud = audit.LockOrderAuditor()
+    reg = metrics.Registry()
+    reg._lock = aud.wrap("registry", reg._lock)
+    with ServeFleet(replicas=2, process=True, registry=reg,
+                    max_wait_ms=2, max_batch=4) as fl:
+        fl._lock = aud.wrap("fleet", fl._lock)
+        for i, p in enumerate(fl._engines):
+            p._lock = aud.wrap(f"proxy{i}", p._lock)
+            p._wlock = aud.wrap(f"wlock{i}", p._wlock)
+        auto = Autoscaler(fl, min_replicas=1, max_replicas=2,
+                          up_ticks=1, down_ticks=100)
+        auto._lock = aud.wrap("autoscaler", auto._lock)
+        futs = [fl.submit(circ, state=states[i]) for i in range(8)]
+        auto.tick()
+        fl.stats()
+        fl.scrape()
+        fl.drain(timeout_s=300)
+        for f in futs:
+            f.result(timeout=60)
+        auto.tick()
     assert aud.acquisitions, "no audited acquisitions recorded"
     aud.assert_acyclic()
 
