@@ -1,0 +1,1070 @@
+"""Adjoint differentiation — O(1)-memory gradients at full width.
+
+The taped reverse pass of `jax.grad` through a variational circuit
+holds one residual state per parametric gate: at 30 qubits a 40-layer
+ansatz wants ~40 state copies of HBM, so training is pinned at toy
+widths. The adjoint method (PennyLane-Lightning's flagship for the
+same reason, arXiv:2508.13615) needs THREE live registers total,
+independent of parameter count and depth:
+
+    E(theta) = <psi0| U(theta)+ H U(theta) |psi0>
+
+    forward:   psi_L = U_L ... U_1 |psi0>            (one sweep)
+    seed:      lambda = H |psi_L>                    (fused Pauli-sum
+                                                      operator apply)
+    backward, k = L..1 (gradient BEFORE un-apply):
+        rotation  U_k = exp(-i s theta/2 P):
+                       dE/dtheta_k += w * s * Im <lambda| P |psi>
+        projector U_k = exp(+i s theta Proj):
+                       dE/dtheta_k += w * s * Im <lambda| Proj |psi>
+        psi    <- U_k+ psi        (gates are unitary: the inverse op
+        lambda <- U_k+ lambda      stream is exact — circuit.inverse_op)
+
+with w = 1 (rotations) / -2 (projectors) on statevectors and w = 1/2 /
+-1 per copy on the doubled density register, where each gate and its
+column-space dual (`circuit.dual_of`) SHARE one parameter index and the
+dual flips the angle sign per family (`_DUAL_S`).
+
+The per-parameter overlap rides the fused expectation geometry
+(ops/expec `_group_view` / `_parity_tables`): the generator of every
+parametric family is a signed Pauli-with-projector in flip form
+(x/zy/ny + a control mask), so Im<lambda|G|psi> is ONE elementwise
+sweep — no generator matrix is ever formed. Constant gate runs between
+parameters band-fuse through `fusion.fixed_run_plan` exactly like the
+forward engines.
+
+Surface: `value_and_grad(target, hamiltonian)` returns a jitted
+`fn(theta) -> (E, dE/dtheta)` built on `jax.custom_vjp`, so optimizer
+loops, `variational.sweep` and `jax.vmap` are oblivious. Program-key
+discipline: equal specs return the SAME cached callable (value-keyed,
+`_GUARDED_BY(_CACHE_LOCK)`), so a rebuilt loop retraces nothing.
+Engine selection (`QUEST_ADJOINT` knob, default auto) is priced into
+the plan IR — `plan.autotune` grows a grad axis querying
+`grad_record()` here, incumbent(taped)-wins-ties (docs/AUTODIFF.md,
+docs/PLANNING.md).
+
+Sharded: the same walk runs inside one shard_map body per direction
+(forward+energy, backward), the backward op stream riding the exact
+kernels of parallel/sharded.py (`_parity_op`, `_butterfly_1q`,
+`_apply_gateop`); predicted exchanges are asserted against the lowered
+HLO like every other engine (tests/test_adjoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu import circuit as CC
+from quest_tpu import precision
+from quest_tpu.ops import apply as A
+from quest_tpu.ops import expec as E
+from quest_tpu.validation import QuESTError
+
+
+class AdjointError(QuESTError):
+    """A target the adjoint engine cannot differentiate — always names
+    the offending op/mode. Measurements, noise channels and classical
+    control have no inverse stream; traced operands have no concrete
+    angle to recover (circuit.as_rotation)."""
+
+
+# ---------------------------------------------------------------------------
+# the program: parametric entries + fused constant runs
+# ---------------------------------------------------------------------------
+
+
+#: generator flip form per rotation family: targets -> (x_bits, zy_bits,
+#: ny) of the signed Pauli G in U = exp(-i s theta/2 G)
+_ROT_FORMS = {
+    "parity": lambda targets: ((), tuple(targets), 0),
+    "rx": lambda targets: ((targets[0],), (), 0),
+    "ry": lambda targets: ((targets[0],), (targets[0],), 1),
+}
+
+#: density column-dual angle sign per family: conj(U(theta)) = U(s*theta)
+#: (rx/parity/phase/allones conjugate to the negated angle; ry is real)
+_DUAL_S = {"parity": -1.0, "rx": -1.0, "ry": 1.0,
+           "phase": -1.0, "allones": -1.0}
+
+_REJECT_KINDS = {"superop": "noise channels",
+                 "measure": "measurements",
+                 "measure_dm": "measurements",
+                 "classical": "classically-controlled gates"}
+
+
+@dataclasses.dataclass(frozen=True)
+class _Param:
+    """One parametric gate occurrence. `kind` 'rot' is
+    U = exp(-i s theta/2 P_mask (x) G), 'proj' is
+    U = exp(+i s theta Proj(mask)); the overlap reads the flip form
+    (x/zy/ny) under the (mask_bits, mask_states) control projector."""
+    pidx: int
+    family: str
+    kind: str                    # 'rot' | 'proj'
+    targets: Tuple[int, ...]
+    controls: Tuple[int, ...]
+    cstates: Tuple[int, ...]
+    s: float                     # angle sign (column duals flip it)
+    w: float                     # overlap weight (register-kind factor)
+    x_bits: Tuple[int, ...]
+    zy_bits: Tuple[int, ...]
+    ny: int
+    mask_bits: Tuple[int, ...]
+    mask_states: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class _Fixed:
+    """A constant gate run between parameters: `fwd`/`inv` apply the
+    band-fused run (and its exact inverse) to (2, 2^n) planes; `ops` /
+    `inv_ops` keep the raw GateOp streams for the sharded walk and the
+    comm predictor (None for trotter frame blocks, which are
+    single-device)."""
+    fwd: Callable
+    inv: Callable
+    ops: Optional[Tuple] = None
+    inv_ops: Optional[Tuple] = None
+
+    def __hash__(self):          # entries live inside hashable programs
+        return id(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Program:
+    n: int                       # register qubits (2N for density)
+    density: bool
+    entries: Tuple
+    num_params: int
+
+    def __hash__(self):
+        return id(self)
+
+
+def _rot_param(pidx, family, targets, controls, cstates, s, w):
+    x, zy, ny = _ROT_FORMS[family](targets)
+    return _Param(pidx, family, "rot", targets, controls, cstates,
+                  s, w, x, zy, ny, controls, cstates)
+
+
+def _proj_param(pidx, family, targets, controls, cstates, s, w):
+    mask_bits = targets + controls
+    mask_states = (1,) * len(targets) + cstates
+    return _Param(pidx, family, "proj", targets, controls, cstates,
+                  s, w, (), (), 0, mask_bits, mask_states)
+
+
+def _param_entry(op, family, pidx, density, col, N):
+    shift = N if col else 0
+    targets = tuple(t + shift for t in op.targets)
+    controls = tuple(c + shift for c in op.controls)
+    cstates = tuple(op.cstates) if op.cstates else (1,) * len(controls)
+    s = _DUAL_S[family] if col else 1.0
+    if family in _ROT_FORMS:
+        w = 0.5 if density else 1.0
+        return _rot_param(pidx, family, targets, controls, cstates, s, w)
+    w = -1.0 if density else -2.0
+    return _proj_param(pidx, family, targets, controls, cstates, s, w)
+
+
+def _make_fixed(ops, n):
+    from quest_tpu.ops import fusion as F
+    ops = tuple(ops)
+    inv_ops = tuple(CC.inverse_op(op) for op in reversed(ops))
+    fwd_items = F.fixed_run_plan(ops, n)
+    inv_items = F.fixed_run_plan(inv_ops, n)
+
+    def fwd(amps, _items=tuple(fwd_items), _n=n):
+        return CC._apply_banded_items(amps, _n, _items)
+
+    def inv(amps, _items=tuple(inv_items), _n=n):
+        return CC._apply_banded_items(amps, _n, _items)
+
+    return _Fixed(fwd=fwd, inv=inv, ops=ops, inv_ops=inv_ops)
+
+
+def build_circuit_program(circuit, density: bool):
+    """(program, theta0) for a Circuit: parametric ops (everything
+    `circuit.as_rotation` recovers) become `_Param` entries sharing one
+    theta index with their density dual; constant runs band-fuse into
+    `_Fixed` blocks. Rejects loudly — typed, naming the op — on
+    anything it cannot differentiate."""
+    from quest_tpu.ops import fusion as F
+    N = circuit.num_qubits
+    n = 2 * N if density else N
+    entries = []
+    theta0 = []
+    run = []
+
+    def flush():
+        if run:
+            entries.append(_make_fixed(run, n))
+            run.clear()
+
+    for idx, op in enumerate(circuit.ops):
+        if op.kind in _REJECT_KINDS:
+            raise AdjointError(
+                f"Invalid adjoint target: op {idx} ({_REJECT_KINDS[op.kind]}"
+                f") is not differentiable — the backward walk needs an "
+                f"exact inverse stream")
+        if not F._concrete(op.operand):
+            raise AdjointError(
+                f"Invalid adjoint target: op {idx} ({op.kind}) carries a "
+                f"traced operand; adjoint differentiation recovers angles "
+                f"from CONCRETE gates (circuit.as_rotation)")
+        rot = CC.as_rotation(op)
+        if rot is None:
+            run.append(op)
+            if density:
+                d = CC.dual_of(op, N)
+                if d is not None:
+                    run.append(d)
+            continue
+        family, th = rot
+        pidx = len(theta0)
+        theta0.append(th)
+        flush()
+        entries.append(_param_entry(op, family, pidx, density, False, N))
+        if density:
+            entries.append(_param_entry(op, family, pidx, density, True, N))
+    flush()
+    program = _Program(n=n, density=density, entries=tuple(entries),
+                       num_params=len(theta0))
+    return program, np.asarray(theta0, dtype=np.float64)
+
+
+def build_trotter_program(ansatz):
+    """(program, angle_meta) for an `evolution.trotter_ansatz` callable:
+    the Strang schedule (`evolution.step_schedule`) replays gate-by-gate
+    — frame band changes as `_Fixed` blocks, every parity-phase
+    occurrence as a `_Param` — so the walk differentiates EXACTLY the
+    program `evolve_planes` runs. `angle_meta` = (idx, scale) arrays
+    mapping params=(coeffs, dt) onto the per-occurrence theta vector
+    theta_e = 2 * dt * coeffs[idx_e] * scale_e (jax chains the VJP of
+    that map onto the custom adjoint VJP automatically). Identity terms
+    are a global phase — E-invariant, zero gradient — and are skipped."""
+    import quest_tpu.evolution as EV
+    key = getattr(ansatz, "program_key", None)
+    if not (isinstance(key, tuple) and key and key[0] == "trotter_ansatz"):
+        raise AdjointError(
+            "Invalid adjoint target: expected a Circuit or an "
+            "evolution.trotter_ansatz callable (program_key contract)")
+    _, codes_key, n, order, steps, imag_time = key
+    if imag_time:
+        raise AdjointError(
+            "Invalid adjoint target: imaginary-time evolution is "
+            "non-unitary — the backward walk cannot invert the decay")
+    plan = EV._plan_trotter(codes_key)
+    sched = EV.step_schedule(plan, order)
+    entries = []
+    idxs, scales = [], []
+
+    def add_parity(i, scale):
+        pidx = len(idxs)
+        idxs.append(i)
+        scales.append(scale)
+        targets = tuple(plan.supports[i])
+        entries.append(_rot_param(pidx, "parity", targets, (), (),
+                                  1.0, 1.0))
+
+    def band_fixed(bands, forward):
+        if forward:
+            def go(amps, _b=bands, _n=n):
+                for ql, w, fp, _ip in _b:
+                    amps = A.apply_band(amps, _n, fp, ql, w, ())
+                return amps
+
+            def back(amps, _b=bands, _n=n):
+                for ql, w, _fp, ip in reversed(_b):
+                    amps = A.apply_band(amps, _n, ip, ql, w, ())
+                return amps
+        else:
+            def go(amps, _b=bands, _n=n):
+                for ql, w, _fp, ip in _b:
+                    amps = A.apply_band(amps, _n, ip, ql, w, ())
+                return amps
+
+            def back(amps, _b=bands, _n=n):
+                for ql, w, fp, _ip in reversed(_b):
+                    amps = A.apply_band(amps, _n, fp, ql, w, ())
+                return amps
+        return _Fixed(fwd=go, inv=back)
+
+    for _ in range(int(steps)):
+        for (kind, payload), scale in sched:
+            if kind == "diag":
+                for i in payload:
+                    add_parity(i, scale)
+            else:
+                bands = EV._frame_band_ops(payload.axes, n)
+                entries.append(band_fixed(bands, True))
+                for i in payload.terms:
+                    add_parity(i, scale)
+                entries.append(band_fixed(bands, False))
+    program = _Program(n=n, density=False, entries=tuple(entries),
+                       num_params=len(idxs))
+    return program, (np.asarray(idxs, np.int32),
+                     np.asarray(scales, np.float64))
+
+
+# ---------------------------------------------------------------------------
+# primitives: the masked Im-overlap and the parametric appliers
+# ---------------------------------------------------------------------------
+
+
+def _control_tables(ranges, bits, states, rdt):
+    """[(axis, 0/1 table)] control-projector companion of
+    expec._parity_tables: table[v] = 1 iff every listed bit inside the
+    axis' chunk matches its required state. Broadcast-multiplied along
+    the group view, never 2^n-sized."""
+    req = dict(zip(bits, states))
+    out = []
+    for ax, (lo, w) in enumerate(ranges):
+        hit = [(b, req[b]) for b in range(lo, lo + w) if b in req]
+        if not hit:
+            continue
+        idx = np.arange(1 << w)
+        m = np.ones(1 << w, dtype=bool)
+        for b, want in hit:
+            m &= ((idx >> (b - lo)) & 1) == int(want)
+        out.append((ax, m.astype(rdt)))
+    return out
+
+
+def _overlap_plane(lam, src_r, src_i, dims, k):
+    """The Im((-i)^ny t) integrand plane of t = sum conj(lam)*psi_flip:
+    ny even selects t_im, odd t_re; k in (1, 2) negates (the caller
+    applies the negation to the reduced scalar)."""
+    lr = lam[0].reshape(dims)
+    li = lam[1].reshape(dims)
+    if k % 2 == 0:
+        return lr * src_i - li * src_r
+    return lr * src_r + li * src_i
+
+
+def _im_overlap(lam, psi, n, e: _Param):
+    """Im <lambda| G |psi> of entry `e`'s generator (flip form x/zy/ny
+    under the mask projector) — one fused elementwise sweep over the
+    expec group view; the caller multiplies w*s."""
+    dims, axis_of, ranges = E._group_view(n, e.x_bits)
+    pr = psi[0].reshape(dims)
+    pi = psi[1].reshape(dims)
+    if e.x_bits:
+        axes = [axis_of[q] for q in e.x_bits]
+        pr = jnp.flip(pr, axes)
+        pi = jnp.flip(pi, axes)
+    k = e.ny % 4
+    plane = _overlap_plane(lam, pr, pi, dims, k)
+    rdt = np.dtype(plane.dtype)
+    tabs = (E._parity_tables(ranges, e.zy_bits, rdt)
+            + _control_tables(ranges, e.mask_bits, e.mask_states, rdt))
+    plane = E._apply_sign_tables(plane, tabs, len(dims))
+    acc = precision.accum_dtype(lam.dtype)
+    val = jnp.sum(plane.astype(acc))
+    if k in (1, 2):
+        val = -val
+    return val
+
+
+def _apply_param(amps, n, e: _Param, ang):
+    """Apply entry `e` at (already sign-folded) angle `ang` to (2, 2^n)
+    planes — the single-device parametric applier, riding the
+    variational gate set so taped and adjoint run the same kernels."""
+    from quest_tpu import variational as V
+    if e.family == "parity":
+        return A.apply_parity_phase(amps, n, e.targets, ang)
+    if e.family == "rx":
+        return V.rx(amps, n, e.targets[0], ang, e.controls, e.cstates)
+    if e.family == "ry":
+        return V.ry(amps, n, e.targets[0], ang, e.controls, e.cstates)
+    # proj families: e^{i ang} on the mask subspace
+    t = jnp.asarray(ang, dtype=amps.dtype)
+    q0 = e.mask_bits[0]
+    s0 = e.mask_states[0]
+    one = jnp.ones((), amps.dtype)
+    zero = jnp.zeros((), amps.dtype)
+    c, sn = jnp.cos(t), jnp.sin(t)
+    dre = jnp.stack([one, c]) if s0 else jnp.stack([c, one])
+    dim_ = jnp.stack([zero, sn]) if s0 else jnp.stack([sn, zero])
+    return A.apply_diagonal(amps, n, (dre, dim_), (q0,),
+                            tuple(e.mask_bits[1:]),
+                            tuple(e.mask_states[1:]))
+
+
+def _density_lambda(amps, cf, eplan):
+    """The density bra seed: E = Re<lambda_planes, a_planes> is LINEAR
+    in the doubled register, so lambda is exactly the gradient of the
+    fused trace at any point — evaluated at zeros, one O(2^n) pass."""
+    def f(a):
+        return E.expec_traced(a, cf, eplan).astype(a.dtype)
+    return jax.grad(f)(jnp.zeros_like(amps))
+
+
+# ---------------------------------------------------------------------------
+# single-device engine
+# ---------------------------------------------------------------------------
+
+
+def _forward_traced(theta, program: _Program, rdt, initial_index):
+    from quest_tpu.state import basis_planes
+    amps = basis_planes(initial_index, n=program.n, rdt=rdt)
+    for e in program.entries:
+        if isinstance(e, _Param):
+            amps = _apply_param(amps, program.n, e, e.s * theta[e.pidx])
+        else:
+            amps = e.fwd(amps)
+    return amps
+
+
+def _build_single(program: _Program, eplan, cf0, rdt, initial_index):
+    """energy(theta) with the custom adjoint VJP, single device."""
+    n = program.n
+
+    def _energy_of(amps):
+        cf = jnp.asarray(cf0, dtype=amps.dtype)
+        return E.expec_traced(amps, cf, eplan).astype(amps.dtype)
+
+    def _state(theta):
+        return _forward_traced(theta, program, rdt, initial_index)
+
+    @jax.custom_vjp
+    def energy(theta):
+        return _energy_of(_state(theta))
+
+    def energy_fwd(theta):
+        amps = _state(theta)
+        return _energy_of(amps), (amps, theta)
+
+    def energy_bwd(res, ct):
+        amps, theta = res
+        cf = jnp.asarray(cf0, dtype=amps.dtype)
+        if program.density:
+            lam = _density_lambda(amps, cf, eplan)
+        else:
+            lam = E.apply_pauli_sum_planes(amps, cf, eplan)
+        acc = precision.accum_dtype(amps.dtype)
+        grads = [jnp.zeros((), dtype=acc)] * program.num_params
+        for e in reversed(program.entries):
+            if isinstance(e, _Param):
+                g = _im_overlap(lam, amps, n, e)
+                grads[e.pidx] = grads[e.pidx] + g * (e.w * e.s)
+                ia = -e.s * theta[e.pidx]
+                amps = _apply_param(amps, n, e, ia)
+                lam = _apply_param(lam, n, e, ia)
+            else:
+                amps = e.inv(amps)
+                lam = e.inv(lam)
+        if grads:
+            g = jnp.stack(grads).astype(theta.dtype) * ct
+        else:
+            g = jnp.zeros_like(theta)
+        return (g,)
+
+    energy.defvjp(energy_fwd, energy_bwd)
+    return energy
+
+
+def _taped_energy(program: _Program, eplan, cf0, rdt, initial_index):
+    """The taped twin: the SAME forward trace, differentiated by plain
+    jax reverse mode — the baseline adjoint is priced against, and the
+    parity oracle in tests (identical parametrization by construction)."""
+    def energy(theta):
+        amps = _forward_traced(theta, program, rdt, initial_index)
+        cf = jnp.asarray(cf0, dtype=amps.dtype)
+        return E.expec_traced(amps, cf, eplan).astype(amps.dtype)
+    return energy
+
+
+# ---------------------------------------------------------------------------
+# sharded engine (statevector circuits)
+# ---------------------------------------------------------------------------
+
+
+def _im_overlap_sharded(lam, psi, local_n, dev, D, e: _Param):
+    """Per-shard Im <lambda| G |psi>: local flip bits flip in-shard, a
+    global flip mask is one plain ppermute pair exchange, global zy
+    bits fold into the device parity sign and global mask bits into a
+    device predicate — the `_group_contrib_sharded` geometry applied to
+    the adjoint overlap. Caller psums."""
+    from quest_tpu.env import AMP_AXIS
+    from quest_tpu.parallel import sharded as S
+    lx = tuple(q for q in e.x_bits if q < local_n)
+    gxm = 0
+    for q in e.x_bits:
+        if q >= local_n:
+            gxm |= 1 << (q - local_n)
+    src = psi
+    if gxm:
+        src = jax.lax.ppermute(psi, AMP_AXIS,
+                               [(d, d ^ gxm) for d in range(D)])
+    dims, axis_of, ranges = E._group_view(local_n, lx)
+    sr = src[0].reshape(dims)
+    si = src[1].reshape(dims)
+    if lx:
+        axes = [axis_of[q] for q in lx]
+        sr = jnp.flip(sr, axes)
+        si = jnp.flip(si, axes)
+    k = e.ny % 4
+    plane = _overlap_plane(lam, sr, si, dims, k)
+    rdt = np.dtype(plane.dtype)
+    loc = [(b, st) for b, st in zip(e.mask_bits, e.mask_states)
+           if b < local_n]
+    lzy = tuple(b for b in e.zy_bits if b < local_n)
+    tabs = (E._parity_tables(ranges, lzy, rdt)
+            + _control_tables(ranges, tuple(b for b, _ in loc),
+                              tuple(st for _, st in loc), rdt))
+    plane = E._apply_sign_tables(plane, tabs, len(dims))
+    acc = precision.accum_dtype(lam.dtype)
+    val = jnp.sum(plane.astype(acc))
+    gzy = tuple(b - local_n for b in e.zy_bits if b >= local_n)
+    if gzy:
+        val = val * E._device_parity_sign(dev, gzy, acc)
+    glob = [(b - local_n, st) for b, st in zip(e.mask_bits, e.mask_states)
+            if b >= local_n]
+    pred = S._global_pred(dev, glob)
+    if pred is not None:
+        val = jnp.where(pred, val, jnp.zeros((), acc))
+    if k in (1, 2):
+        val = -val
+    return val
+
+
+def _apply_param_sharded(chunk, dev, e: _Param, ang, D, local_n):
+    """The sharded parametric applier: parity phases and local-target
+    gates never communicate; a global-target rx/ry is one
+    `_butterfly_1q` pair exchange with a TRACED 2x2; projectors split
+    their mask into a device predicate + a local diagonal."""
+    from quest_tpu.parallel import sharded as S
+    from quest_tpu import variational as V
+    if e.family == "parity":
+        return S._parity_op(chunk, dev, local_n=local_n,
+                            targets=e.targets, angle=ang)
+    if e.family in ("rx", "ry"):
+        t = e.targets[0]
+        hh = jnp.asarray(ang, chunk.dtype) / 2.0
+        c, sn = jnp.cos(hh), jnp.sin(hh)
+        if e.family == "rx":
+            pair = V._mat2(chunk, (c, None), (None, -sn), (None, -sn),
+                           (c, None))
+        else:
+            pair = V._mat2(chunk, (c, None), (-sn, None), (sn, None),
+                           (c, None))
+        loc_c, loc_s, glob_c = S._split_controls(e.controls, e.cstates,
+                                                 local_n)
+        pred = S._global_pred(dev, glob_c)
+        if t < local_n:
+            new = A.apply_matrix(chunk, local_n, pair, (t,), loc_c, loc_s)
+            if pred is not None:
+                new = jnp.where(pred, new, chunk)
+            return new
+        return S._butterfly_1q(chunk, dev, D=D, local_n=local_n,
+                               m_pair=pair, gbit=t - local_n,
+                               loc_c=loc_c, loc_s=loc_s, pred=pred)
+    # proj
+    glob = [(b - local_n, st) for b, st in zip(e.mask_bits, e.mask_states)
+            if b >= local_n]
+    loc = [(b, st) for b, st in zip(e.mask_bits, e.mask_states)
+           if b < local_n]
+    t = jnp.asarray(ang, chunk.dtype)
+    tre, tim = jnp.cos(t), jnp.sin(t)
+    pred = S._global_pred(dev, glob)
+    if pred is not None:
+        tre = jnp.where(pred, tre, jnp.ones((), chunk.dtype))
+        tim = jnp.where(pred, tim, jnp.zeros((), chunk.dtype))
+    if loc:
+        q0, s0 = loc[0]
+        one = jnp.ones((), chunk.dtype)
+        zero = jnp.zeros((), chunk.dtype)
+        dre = jnp.stack([one, tre]) if s0 else jnp.stack([tre, one])
+        dim_ = jnp.stack([zero, tim]) if s0 else jnp.stack([tim, zero])
+        return A.apply_diagonal(chunk, local_n, (dre, dim_), (q0,),
+                                tuple(b for b, _ in loc[1:]),
+                                tuple(st for _, st in loc[1:]))
+    re, im = chunk[0], chunk[1]
+    return jnp.stack([re * tre - im * tim, re * tim + im * tre])
+
+
+def _build_sharded(program: _Program, eplan, cf0, rdt, initial_index,
+                   mesh):
+    """energy(theta) with the custom adjoint VJP, one shard_map body per
+    direction. The forward body runs the op walk + the fused per-shard
+    energy partials (one psum); the backward body seeds lambda through
+    `apply_pauli_sum_planes_sharded`, walks the inverse stream on both
+    registers through the sharded kernels, and psums the stacked
+    per-parameter partials ONCE."""
+    from jax.sharding import PartitionSpec as P
+    from quest_tpu import compat
+    from quest_tpu.env import AMP_AXIS
+    from quest_tpu.parallel import sharded as S
+
+    if program.density:
+        raise AdjointError(
+            "Invalid adjoint target: sharded density registers are not "
+            "supported by the adjoint engine (statevector meshes only)")
+    D = int(mesh.devices.size)
+    gbits = D.bit_length() - 1
+    local_n = program.n - gbits
+    n = program.n
+    idx_local = int(initial_index) & ((1 << local_n) - 1)
+    idx_dev = int(initial_index) >> local_n
+
+    def _walk_fixed_ops(chunk, dev, ops):
+        for op in ops:
+            chunk = S._apply_gateop(chunk, dev, D=D, local_n=local_n,
+                                    density=False, op=op)
+        return chunk
+
+    def fwd_body(theta):
+        dev = jax.lax.axis_index(AMP_AXIS)
+        pos = jnp.arange(1 << local_n)
+        hit = jnp.equal(dev, idx_dev)
+        re = jnp.where(hit & (pos == idx_local),
+                       jnp.ones((), rdt), jnp.zeros((), rdt))
+        chunk = jnp.stack([re, jnp.zeros_like(re)])
+        for e in program.entries:
+            if isinstance(e, _Param):
+                chunk = _apply_param_sharded(chunk, dev, e,
+                                             e.s * theta[e.pidx],
+                                             D, local_n)
+            else:
+                chunk = _walk_fixed_ops(chunk, dev, e.ops)
+        cf = jnp.asarray(cf0, dtype=chunk.dtype)
+        acc = precision.accum_dtype(chunk.dtype)
+        exchanged = {"__D__": D}
+        total = jnp.zeros((), dtype=acc)
+        for pack in eplan.sweeps:
+            flat = None
+            for gi in pack:
+                c = E._group_contrib_sharded(chunk, cf, local_n, dev,
+                                             eplan.groups[gi], exchanged)
+                flat = c if flat is None else flat + c
+            total = total + jnp.sum(flat.astype(acc))
+        val = jax.lax.psum(total, AMP_AXIS).astype(chunk.dtype)
+        return val, chunk
+
+    fwd_run = compat.shard_map(fwd_body, mesh, (P(),),
+                               (P(), P(None, AMP_AXIS)))
+
+    def bwd_body(theta, chunk, ct):
+        dev = jax.lax.axis_index(AMP_AXIS)
+        cf = jnp.asarray(cf0, dtype=chunk.dtype)
+        exchanged = {"__D__": D}
+        lam = E.apply_pauli_sum_planes_sharded(chunk, cf, local_n, dev,
+                                               eplan, exchanged)
+        acc = precision.accum_dtype(chunk.dtype)
+        parts = [jnp.zeros((), dtype=acc)] * program.num_params
+        amps = chunk
+        for e in reversed(program.entries):
+            if isinstance(e, _Param):
+                g = _im_overlap_sharded(lam, amps, local_n, dev, D, e)
+                parts[e.pidx] = parts[e.pidx] + g * (e.w * e.s)
+                ia = -e.s * theta[e.pidx]
+                amps = _apply_param_sharded(amps, dev, e, ia, D, local_n)
+                lam = _apply_param_sharded(lam, dev, e, ia, D, local_n)
+            else:
+                amps = _walk_fixed_ops(amps, dev, e.inv_ops)
+                lam = _walk_fixed_ops(lam, dev, e.inv_ops)
+        if parts:
+            g = jax.lax.psum(jnp.stack(parts), AMP_AXIS)
+            g = g.astype(theta.dtype) * ct
+        else:
+            g = jnp.zeros_like(theta)
+        return g
+
+    bwd_run = compat.shard_map(bwd_body, mesh,
+                               (P(), P(None, AMP_AXIS), P()), P())
+
+    @jax.custom_vjp
+    def energy(theta):
+        return fwd_run(theta)[0]
+
+    def energy_fwd(theta):
+        val, chunk = fwd_run(theta)
+        return val, (theta, chunk)
+
+    def energy_bwd(res, ct):
+        theta, chunk = res
+        return (bwd_run(theta, chunk, jnp.asarray(ct)),)
+
+    energy.defvjp(energy_fwd, energy_bwd)
+
+    def taped(theta):
+        return fwd_run(theta)[0]
+
+    return energy, taped
+
+
+def predict_vjp_collectives(program: _Program, eplan, D: int) -> dict:
+    """HOST-side predicted collective counts of ONE jitted
+    value-and-grad application on D devices — mirrored 1:1 from the
+    dispatch in `_build_sharded` (fixed ops through the same
+    comm.gateop_exchanges routing the executor uses, parametric
+    butterflies through effective_slices, expectation/seed exchanges one
+    plain ppermute per distinct global flip mask) and asserted against
+    introspect.parse_collectives of the lowered HLO in
+    tests/test_adjoint.py — the no-drift discipline every sharded
+    engine carries (docs/PARALLEL.md)."""
+    from quest_tpu.parallel import comm as C
+    gbits = D.bit_length() - 1
+    local_n = program.n - gbits
+    topo = C.topology(D)
+    ici_b = topo.ici_bits(D) if topo.hierarchical else None
+    m = 1 << local_n
+    cps = a2as = 0
+
+    def op_exchanges(ops):
+        c = a = 0
+        for op in ops:
+            for kind, _elems, _g in C.gateop_exchanges(op, local_n, ici_b):
+                if kind == "cp":
+                    c += 1
+                else:
+                    a += 1
+        return c, a
+
+    def param_apply_cps(e):
+        if e.family in ("rx", "ry") and e.targets[0] >= local_n:
+            gbit = e.targets[0] - local_n
+            return C.effective_slices(m, C._link(gbit, ici_b))
+        return 0
+
+    def gxm_of(x_bits):
+        gxm = 0
+        for q in x_bits:
+            if q >= local_n:
+                gxm |= 1 << (q - local_n)
+        return gxm
+
+    emasks = {gxm_of(g.x_bits) for g in eplan.groups} - {0}
+    # forward body: the op walk + one exchange per distinct E flip mask
+    for e in program.entries:
+        if isinstance(e, _Param):
+            cps += param_apply_cps(e)
+        else:
+            c, a = op_exchanges(e.ops)
+            cps += c
+            a2as += a
+    cps += len(emasks)
+    # backward body: the lambda seed shares nothing with the forward's
+    # exchanges (separate shard_map body), then the walk un-applies
+    # every entry to BOTH registers and each global-flip overlap is one
+    # plain pair exchange
+    cps += len(emasks)
+    for e in program.entries:
+        if isinstance(e, _Param):
+            cps += 2 * param_apply_cps(e)
+            if gxm_of(e.x_bits):
+                cps += 1
+        else:
+            c, a = op_exchanges(e.inv_ops)
+            cps += 2 * c
+            a2as += 2 * a
+    return {"collective_permutes": cps, "all_to_alls": a2as,
+            "all_reduces": 2 if program.num_params else 1,
+            "devices": D}
+
+
+# ---------------------------------------------------------------------------
+# capacity + pricing (the plan IR's grad axis)
+# ---------------------------------------------------------------------------
+
+
+def capacity_stats(n: int, num_params: int, depth: int,
+                   dtype=np.float32) -> dict:
+    """The grad-engine capacity model: adjoint holds THREE live state
+    registers (psi, lambda, the overlap integrand fuses elementwise)
+    plus O(masks) sign/control tables; taped reverse-mode holds one
+    residual per parametric gate (the constant-gate VJPs are
+    state-independent — the circuit is linear in the state) plus primal
+    and cotangent. Bytes against the HBM budget (QUEST_HBM_BYTES
+    override, else the v5e model) — the same budget every other
+    capacity decision prices against (ops/apply.f64_capacity_stats,
+    plan.sweep_chunk)."""
+    from quest_tpu.env import knob_value
+    rdt = precision.real_dtype_of(np.dtype(dtype))
+    state_bytes = 2 * (1 << n) * rdt.itemsize
+    seg = 1 << E._SEG_BITS
+    mask_bytes = 4 * seg * rdt.itemsize * max(1, -(-n // E._SEG_BITS))
+    hbm = knob_value("QUEST_HBM_BYTES")
+    if hbm is None:
+        hbm = A._V5E_HBM_BYTES
+    adjoint_peak = 3 * state_bytes + mask_bytes
+    taped_peak = (num_params + 2) * state_bytes
+    return {
+        "state_bytes": int(state_bytes),
+        "hbm_bytes": int(hbm),
+        "adjoint_peak_bytes": int(adjoint_peak),
+        "adjoint_fits": bool(adjoint_peak <= hbm),
+        "taped_residual_bytes": int(taped_peak),
+        "taped_fits": bool(taped_peak <= hbm),
+        "params": int(num_params),
+        "depth": int(depth),
+    }
+
+
+def _engine_choice(cap: dict, knob: str) -> str:
+    """The priced decision, incumbent-wins-ties: taped (the incumbent
+    reverse-mode) keeps every width where its residuals fit; adjoint is
+    selected only where taped CANNOT run and adjoint can — a strict
+    capability extension, so no existing grad path regresses by
+    construction (the plan.autotune `_rank` discipline applied to the
+    grad axis)."""
+    if knob == "0":
+        return "taped"
+    if knob == "1":
+        return "adjoint"
+    if cap["taped_fits"]:
+        return "taped"
+    if cap["adjoint_fits"]:
+        return "adjoint"
+    return "taped"
+
+
+def grad_record(circuit, *, density: bool = False, dtype=np.float32,
+                devices: Optional[int] = None) -> Optional[dict]:
+    """The plan IR's grad axis for one circuit: parameter count, both
+    engines' capacity rows, and the engine the QUEST_ADJOINT knob (or
+    the capacity pricing, under 'auto') resolves to. None when the
+    circuit has no parametric ops (nothing to differentiate — the grad
+    axis stays silent rather than pricing a vacuous choice); a
+    non-invertible circuit reports {'supported': False, ...} with the
+    taped engine, which differentiates anything jax can trace."""
+    from quest_tpu.env import knob_value
+    knob = str(knob_value("QUEST_ADJOINT"))
+    N = circuit.num_qubits
+    n = 2 * N if density else N
+    depth = len(circuit.ops)
+    try:
+        program, _theta0 = build_circuit_program(circuit, density)
+    except AdjointError as err:
+        num_params = 0
+        for op in circuit.ops:
+            if op.kind in _REJECT_KINDS:
+                continue
+            try:
+                if CC.as_rotation(op) is not None:
+                    num_params += 1
+            except Exception:
+                pass
+        if num_params == 0:
+            return None
+        cap = capacity_stats(n, num_params, depth, dtype)
+        return {"supported": False, "reason": str(err), "engine": "taped",
+                "incumbent": "taped", "knob": knob, "params": num_params,
+                "depth": depth, "taped": {
+                    "residual_bytes": cap["taped_residual_bytes"],
+                    "fits": cap["taped_fits"]}}
+    if program.num_params == 0:
+        return None
+    cap = capacity_stats(n, program.num_params, depth, dtype)
+    if devices:
+        # per-device chunks: every register and residual shards evenly
+        shard = max(1, int(devices))
+        for key in ("adjoint_peak_bytes", "taped_residual_bytes",
+                    "state_bytes"):
+            cap[key] = int(cap[key] // shard)
+        cap["taped_fits"] = cap["taped_residual_bytes"] <= cap["hbm_bytes"]
+        cap["adjoint_fits"] = cap["adjoint_peak_bytes"] <= cap["hbm_bytes"]
+    engine = _engine_choice(cap, knob)
+    return {
+        "supported": True,
+        "params": int(program.num_params),
+        "depth": depth,
+        "engine": engine,
+        "incumbent": "taped",
+        "knob": knob,
+        "taped": {"residual_bytes": cap["taped_residual_bytes"],
+                  "fits": cap["taped_fits"]},
+        "adjoint": {"peak_bytes": cap["adjoint_peak_bytes"],
+                    "fits": cap["adjoint_fits"]},
+    }
+
+
+# ---------------------------------------------------------------------------
+# the public surface
+# ---------------------------------------------------------------------------
+
+
+# compiled value-and-grad programs by VALUE (the program-key
+# discipline: a rebuilt-but-equal spec returns the SAME callable, so
+# optimizer loops retrace nothing). Bounded FIFO — value keys cannot
+# be weak.
+# _GUARDED_BY(_CACHE_LOCK): _FN_CACHE
+_FN_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
+_CACHE_LOCK = threading.Lock()
+_FN_CACHE_MAX = 32
+
+
+def _resolve_observable(hamiltonian, coeffs, num_qubits):
+    if isinstance(hamiltonian, E.PauliSum):
+        if coeffs is not None:
+            raise ValueError("pass coefficients inside the PauliSum, not "
+                             "as a separate coeffs= argument")
+        codes_key = E.parse_pauli_sum(np.asarray(hamiltonian.codes),
+                                      num_qubits)
+        cf = np.asarray(hamiltonian.coeffs, dtype=np.float64)
+    else:
+        codes_key = E.parse_pauli_sum(hamiltonian, num_qubits)
+        cf = np.asarray(coeffs, dtype=np.float64).reshape(-1)
+    if len(cf) != len(codes_key):
+        from quest_tpu import validation as val
+        val._err("Invalid Pauli sum: must give exactly one coefficient "
+                 "per term.")
+    return codes_key, cf
+
+
+def _freeze(x):
+    if isinstance(x, list):
+        return tuple(_freeze(i) for i in x)
+    return x
+
+
+def _circuit_key(circuit):
+    from quest_tpu import plan as PL
+    fps = []
+    for i, op in enumerate(circuit.ops):
+        fp = PL._op_fingerprint(op)
+        if fp is None:
+            raise AdjointError(
+                f"Invalid adjoint target: op {i} ({op.kind}) carries a "
+                f"traced operand; adjoint differentiation needs concrete "
+                f"gates")
+        fps.append(_freeze(fp))
+    return ("circuit", circuit.num_qubits, tuple(fps))
+
+
+def value_and_grad(target, hamiltonian, *, coeffs=None,
+                   initial_index: int = 0, dtype=np.float32,
+                   density: bool = False, mesh=None,
+                   engine: Optional[str] = None) -> Callable:
+    """`fn(theta) -> (E, dE/dtheta)` for `target` (a Circuit, or an
+    `evolution.trotter_ansatz` callable taking params=(coeffs, dt))
+    against the Pauli-sum `hamiltonian` — the gradient engine behind it
+    resolved by `engine` ('adjoint' | 'taped' | 'auto'; default the
+    QUEST_ADJOINT knob). Both engines differentiate the SAME forward
+    parametrization, so they agree to numerical precision
+    (tests/test_adjoint.py pins parity and the docs/AUTODIFF.md
+    contract).
+
+    The returned callable is jitted, cached by VALUE (equal specs —
+    ops, observable, dtype, mesh, keyed knobs — return the identical
+    object: zero-retrace optimizer loops), carries the
+    `variational.sweep` geometry tags (num_qubits/real_dtype/sweep_key)
+    and exposes `initial_params` (a Circuit target's recovered angles),
+    `engine`, `num_params`, and — sharded — `comm_record`, the
+    predicted collective counts of one application."""
+    from quest_tpu.env import engine_mode_key, knob_value
+
+    is_circuit = isinstance(target, CC.Circuit)
+    if is_circuit:
+        nq = target.num_qubits
+        tkey = _circuit_key(target)
+    else:
+        pk = getattr(target, "program_key", None)
+        if not (isinstance(pk, tuple) and pk
+                and pk[0] == "trotter_ansatz"):
+            raise AdjointError(
+                "Invalid adjoint target: expected a Circuit or an "
+                "evolution.trotter_ansatz callable, got "
+                f"{type(target).__name__!r}")
+        nq = target.num_qubits
+        tkey = pk
+    codes_key, cf0 = _resolve_observable(hamiltonian, coeffs, nq)
+    rdt = precision.real_dtype_of(np.dtype(dtype))
+    if engine not in (None, "auto", "adjoint", "taped"):
+        raise ValueError(f"engine must be 'adjoint', 'taped' or 'auto', "
+                         f"got {engine!r}")
+
+    devices_key = None
+    if mesh is not None:
+        devices_key = (int(mesh.devices.size),
+                       tuple(str(d) for d in mesh.devices.flat))
+    key = (tkey, codes_key, cf0.tobytes(), int(initial_index), rdt.str,
+           bool(density), devices_key, engine, engine_mode_key())
+    with _CACHE_LOCK:
+        fn = _FN_CACHE.get(key)
+        if fn is not None:
+            return fn
+
+    if is_circuit:
+        program, theta0 = build_circuit_program(target, density)
+        angle_meta = None
+    else:
+        if mesh is not None:
+            raise AdjointError(
+                "Invalid adjoint target: sharded trotter ansatz gradients "
+                "are not supported (single-device registers only)")
+        if density:
+            raise AdjointError(
+                "Invalid adjoint target: trotter ansatz gradients run on "
+                "statevector registers only")
+        program, angle_meta = build_trotter_program(target)
+        theta0 = None
+
+    eplan = E.plan_expec(codes_key, nq, density=density)
+    # density layout: flat = row + col*2^N, so |i><i| sits at i*(2^N+1)
+    init_flat = (int(initial_index) * ((1 << nq) + 1) if density
+                 else int(initial_index))
+
+    resolved = engine
+    if resolved in (None, "auto"):
+        knob = str(knob_value("QUEST_ADJOINT"))
+        if knob in ("0", "1"):
+            resolved = {"0": "taped", "1": "adjoint"}[knob]
+        else:
+            cap = capacity_stats(program.n, program.num_params,
+                                 len(program.entries), rdt)
+            resolved = _engine_choice(cap, "auto")
+
+    comm_record = None
+    if mesh is not None and int(mesh.devices.size) > 1:
+        adjoint_e, taped_e = _build_sharded(program, eplan, cf0, rdt,
+                                            init_flat, mesh)
+        if resolved == "adjoint":
+            comm_record = predict_vjp_collectives(
+                program, eplan, int(mesh.devices.size))
+        energy = adjoint_e if resolved == "adjoint" else taped_e
+    elif resolved == "adjoint":
+        energy = _build_single(program, eplan, cf0, rdt, init_flat)
+    else:
+        energy = _taped_energy(program, eplan, cf0, rdt, init_flat)
+
+    if is_circuit:
+        jitted = jax.jit(jax.value_and_grad(energy))
+    else:
+        idx_arr, scale_arr = angle_meta
+
+        def param_energy(params):
+            cfv, dt = params
+            cfv = jnp.asarray(cfv)
+            dt = jnp.asarray(dt, cfv.dtype)
+            theta = (2.0 * dt * cfv[jnp.asarray(idx_arr)]
+                     * jnp.asarray(scale_arr, cfv.dtype))
+            return energy(theta.astype(rdt))
+
+        jitted = jax.jit(jax.value_and_grad(param_energy))
+
+    # thin wrapper: jit callables reject attribute assignment, and the
+    # sweep/bench surfaces need the geometry tags on the object itself
+    def fn(params):
+        return jitted(params)
+
+    fn.jitted = jitted               # .lower() access for HLO asserts
+    fn.num_qubits = nq
+    fn.real_dtype = rdt.str
+    fn.engine = resolved
+    fn.num_params = program.num_params
+    fn.initial_params = theta0
+    fn.comm_record = comm_record
+    fn.sweep_key = ("adjoint.value_and_grad",) + key
+    with _CACHE_LOCK:
+        _FN_CACHE[key] = fn
+        while len(_FN_CACHE) > _FN_CACHE_MAX:
+            _FN_CACHE.popitem(last=False)
+    return fn

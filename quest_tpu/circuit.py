@@ -162,6 +162,99 @@ def _named_diag(d):
     return None
 
 
+def as_rotation(op: GateOp):
+    """(family, theta) of a parametric op, or None for a constant gate.
+
+    The structural inverse of the builder emitters: every angle-taking
+    Circuit method stores a dense operand (rx/ry -> 2x2 matrix, phase/
+    cphase -> diagonal/allones term, rz/parity/multi_rotate_* -> parity
+    angle), and the adjoint engine (quest_tpu/adjoint.py) needs the
+    angle BACK to differentiate the gate. Families and their appliers:
+
+      'parity'  exp(-i th/2 Z..Z)  theta = stored angle
+      'rx'/'ry' M.rotation(th, x/y axis), recovered via arctan2 over
+                the full 4pi matrix period (same recovery as _named_1q)
+      'phase'   diagonal [1, e^{i th}] on one target
+      'allones' phase e^{i th} on the all-ones subspace (cphase)
+
+    EXACT constant gates (h/x/y/z, z/s/t diagonals, cz's -1 term) return
+    None — they carry no parameter. The builder emitters never produce
+    those exact constants from a generic angle (np.exp(1j*pi) retains a
+    residual imaginary part), so round-tripping every parametric emitter
+    is loss-free; pinned in tests/test_adjoint.py."""
+    if op.kind == "parity":
+        return ("parity", float(op.operand))
+    if op.kind == "matrix":
+        u = np.asarray(op.operand)
+        if u.shape != (2, 2):
+            return None
+        for _, mat in _NAMED_2x2:
+            if np.array_equal(u, mat):
+                return None
+        c, o = u[0, 0], u[0, 1]
+        if (abs(c.imag) < 1e-14 and abs(o.real) < 1e-14
+                and np.allclose(u, [[c, o], [o, c]])):
+            th = 2.0 * np.arctan2(-o.imag, c.real)
+            if np.allclose(u, M.rotation(th, (1.0, 0.0, 0.0))):
+                return ("rx", float(th))
+        if (np.allclose(u.imag, 0.0, atol=1e-14)
+                and np.allclose(u, [[c, o], [-o, c]])):
+            th = 2.0 * np.arctan2(-o.real, c.real)
+            if np.allclose(u, M.rotation(th, (0.0, 1.0, 0.0))):
+                return ("ry", float(th))
+        return None
+    if op.kind == "diagonal":
+        d = np.asarray(op.operand)
+        if d.shape != (2,):
+            return None
+        if (np.array_equal(d, M.Z_DIAG) or np.array_equal(d, M.S_DIAG)
+                or np.array_equal(d, M.T_DIAG)):
+            return None
+        if abs(d[0] - 1.0) < 1e-14 and abs(abs(d[1]) - 1.0) < 1e-14:
+            return ("phase", float(np.angle(d[1])))
+        return None
+    if op.kind == "allones":
+        term = complex(op.operand)
+        if abs(term + 1.0) < 1e-14:      # cz/ccz: exact constant
+            return None
+        if abs(abs(term) - 1.0) < 1e-14:
+            return ("allones", float(np.angle(term)))
+        return None
+    return None
+
+
+def inverse_op(op: GateOp) -> GateOp:
+    """The adjoint of ONE GateOp (matrix -> U+, diagonal/allones ->
+    conjugate, parity -> negated angle; controls preserved). The single
+    place the per-op inverse rules live — Circuit.inverse reverses the
+    stream through here, and the adjoint backward walk (adjoint.py)
+    un-applies gates one at a time through the same rules. Raises on
+    non-invertible kinds, naming the op."""
+    if op.kind in ("superop", "measure", "measure_dm", "classical"):
+        from quest_tpu.validation import QuESTError
+        what = {"superop": "noise channels",
+                "measure": "measurements",
+                "measure_dm": "measurements",
+                "classical": "classically-controlled gates"}
+        raise QuESTError(
+            f"Invalid operation: a circuit containing "
+            f"{what[op.kind]} has no inverse.")
+    if op.kind == "matrix":
+        operand = np.asarray(op.operand).conj().T
+    elif op.kind in ("diagonal", "allones"):
+        operand = np.conj(op.operand)
+    else:                      # parity: exp(-i a/2 Z..Z)
+        operand = -op.operand
+    parts = getattr(op, "parts", None)
+    if parts:
+        # ComposedDiag: keep the phase components in step with the
+        # conjugated table (see dual_of)
+        return dataclasses.replace(
+            op, operand=operand,
+            parts=tuple((k, b, -a) for k, b, a in parts))
+    return dataclasses.replace(op, operand=operand)
+
+
 def flatten_ops(ops, n: int, density: bool) -> List[GateOp]:
     """Expand density duals into a flat op list (ref QuEST.c:8-10);
     superops become explicit matrix ops on the doubled targets. The ONE
@@ -884,29 +977,7 @@ class Circuit:
         uncomputation patterns like QPE's inverse QFT."""
         inv = Circuit(self.num_qubits)
         for op in reversed(self.ops):
-            if op.kind in ("superop", "measure", "classical"):
-                from quest_tpu.validation import QuESTError
-                what = {"superop": "noise channels",
-                        "measure": "measurements",
-                        "classical": "classically-controlled gates"}
-                raise QuESTError(
-                    f"Invalid operation: a circuit containing "
-                    f"{what[op.kind]} has no inverse.")
-            if op.kind == "matrix":
-                operand = np.asarray(op.operand).conj().T
-            elif op.kind in ("diagonal", "allones"):
-                operand = np.conj(op.operand)
-            else:                      # parity: exp(-i a/2 Z..Z)
-                operand = -op.operand
-            parts = getattr(op, "parts", None)
-            if parts:
-                # ComposedDiag: keep the phase components in step with
-                # the conjugated table (see dual_of)
-                inv.ops.append(dataclasses.replace(
-                    op, operand=operand,
-                    parts=tuple((k, b, -a) for k, b, a in parts)))
-            else:
-                inv.ops.append(dataclasses.replace(op, operand=operand))
+            inv.ops.append(inverse_op(op))
         return inv
 
     @classmethod

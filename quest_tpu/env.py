@@ -310,6 +310,14 @@ _KNOB_LIST = (
          doc="commutation-aware gate scheduler in front of the fusing "
              "engines' planners: 1/0 (default: 1)",
          malformed="2", flips=("1", "0")),
+    Knob("QUEST_ADJOINT", _parse_choice("QUEST_ADJOINT", ("auto", "0", "1")),
+         "auto",
+         scope="keyed", layer="planner",
+         doc="gradient engine for adjoint.value_and_grad: auto (planner "
+             "prices adjoint vs taped per width), 0 = force taped "
+             "autodiff, 1 = force the adjoint backward walk "
+             "(default: auto)",
+         malformed="2", flips=("auto", "1")),
     Knob("QUEST_FUSED_SCAN", _bool01("QUEST_FUSED_SCAN"), False,
          scope="keyed", layer="planner",
          doc="lax.scan over repeated-structure kernel segments in the "

@@ -574,17 +574,26 @@ def _apply_group_traced(amps, n, cf, tau, plan: TrotterPlan, group,
     return amps
 
 
-def _step_traced(amps, n, cf, tau, plan: TrotterPlan, order: int,
-                 imag: bool, renorm: bool):
+def step_schedule(plan: TrotterPlan, order: int):
+    """The per-step (group, scale) splitting schedule: order 1 applies
+    each group once; order 2 is the symmetric Strang arrangement with
+    halved end groups. The ONE place the splitting lives — shared by
+    the traced step below and by the adjoint engine
+    (quest_tpu/adjoint.py), which replays the identical schedule
+    gate-by-gate so its gradients differentiate exactly the program
+    `evolve_planes` runs."""
     seq = plan.group_seq()
     if order == 1 or len(seq) <= 1:
-        sched = [(g, 1.0) for g in seq]
-    else:
-        sched = ([(seq[0], 0.5)] + [(g, 0.5) for g in seq[1:-1]]
+        return tuple((g, 1.0) for g in seq)
+    return tuple([(seq[0], 0.5)] + [(g, 0.5) for g in seq[1:-1]]
                  + [(seq[-1], 1.0)]
                  + [(g, 0.5) for g in reversed(seq[1:-1])]
                  + [(seq[0], 0.5)])
-    for g, scale in sched:
+
+
+def _step_traced(amps, n, cf, tau, plan: TrotterPlan, order: int,
+                 imag: bool, renorm: bool):
+    for g, scale in step_schedule(plan, order):
         amps = _apply_group_traced(amps, n, cf, tau, plan, g, scale,
                                    imag)
     if plan.identity:

@@ -358,6 +358,60 @@ def _expec_fused(amps, coeffs, *, plan: ExpecPlan):
     return expec_traced(amps, coeffs, plan)
 
 
+def _quarter_turn(k: int, fr, fi):
+    """(re, im) planes of (-i)^k (fr + i fi) — the per-term Y-count
+    phase applied as a plane swap/negate, never a complex multiply."""
+    if k == 0:
+        return fr, fi
+    if k == 1:
+        return fi, -fr
+    if k == 2:
+        return -fr, -fi
+    return -fi, fr
+
+
+def apply_pauli_sum_planes(amps, coeffs, plan: ExpecPlan):
+    """|out> = (sum_t c_t P_t) |a> on (2, 2^n) planes — the OPERATOR
+    application companion of `expec_traced` over the same grouped plan:
+
+        out_j = sum_t c_t (-i)^{ny_t} (-1)^{parity(j & zy_t)} a_{j^{x_t}}
+
+    One flipped read per mask group (terms sharing a flip mask share
+    it), per-term parity signs as broadcast chunk tables, the (-i)^ny
+    phase as a quarter-turn plane select. This seeds the adjoint
+    engine's bra register lambda = H|psi_L> (quest_tpu/adjoint.py) in
+    O(#mask-groups) sweeps with no 2^n x 2^n operator ever formed.
+    Statevector plans only (plan.density must be False — the density
+    walk runs on the doubled register through the sv form)."""
+    assert not plan.density
+    cf = jnp.asarray(coeffs, dtype=amps.dtype)
+    out_re = jnp.zeros_like(amps[0])
+    out_im = jnp.zeros_like(amps[1])
+    for g in plan.groups:
+        dims, axis_of, ranges = _group_view(plan.n, g.x_bits)
+        ar = amps[0].reshape(dims)
+        ai = amps[1].reshape(dims)
+        if g.x_bits:
+            axes = [axis_of[q] for q in g.x_bits]
+            fr = jnp.flip(ar, axes)
+            fi = jnp.flip(ai, axes)
+        else:
+            fr, fi = ar, ai
+        rdt = np.dtype(ar.dtype)
+        gre = gim = None
+        for t in g.terms:
+            pre, pim = _quarter_turn(t.ny % 4, fr, fi)
+            tabs = _parity_tables(ranges, t.zy_bits, rdt)
+            w = cf[t.index]
+            tre = _apply_sign_tables(pre, tabs, len(dims)) * w
+            tim = _apply_sign_tables(pim, tabs, len(dims)) * w
+            gre = tre if gre is None else gre + tre
+            gim = tim if gim is None else gim + tim
+        out_re = out_re + gre.reshape(-1)
+        out_im = out_im + gim.reshape(-1)
+    return jnp.stack([out_re, out_im])
+
+
 # ---------------------------------------------------------------------------
 # density evaluation: grouped tr(H rho) strided trace
 # ---------------------------------------------------------------------------
@@ -493,6 +547,60 @@ def _group_contrib_sharded(amps, cf, local_n, dev, group: _Group,
         term = term * _signed_weight(cf, t, extra)
         contrib = term if contrib is None else contrib + term
     return contrib.reshape(-1)
+
+
+def apply_pauli_sum_planes_sharded(amps, cf, local_n: int, dev,
+                                   plan: ExpecPlan, exchanged: Dict):
+    """Per-shard |out> = H |a|: the apply_pauli_sum_planes companion of
+    `_group_contrib_sharded`, run INSIDE a shard_map body. Local flip
+    bits flip in-shard; each distinct GLOBAL flip mask costs one
+    ppermute pair exchange, fetched once and shared via `exchanged`
+    (seed it with {"__D__": D}). Global zy bits fold into a per-device
+    scalar sign. `amps` is this shard's (2, 2^local_n) chunk; `cf` an
+    already-traced coefficient vector."""
+    from quest_tpu.env import AMP_AXIS
+
+    out_re = jnp.zeros_like(amps[0])
+    out_im = jnp.zeros_like(amps[1])
+    for g in plan.groups:
+        lx = tuple(q for q in g.x_bits if q < local_n)
+        gxm = 0
+        for q in g.x_bits:
+            if q >= local_n:
+                gxm |= 1 << (q - local_n)
+        src = amps
+        if gxm:
+            src = exchanged.get(gxm)
+            if src is None:
+                D = exchanged["__D__"]
+                perm = [(d, d ^ gxm) for d in range(D)]
+                src = jax.lax.ppermute(amps, AMP_AXIS, perm)
+                exchanged[gxm] = src
+        dims, axis_of, ranges = _group_view(local_n, lx)
+        sr = src[0].reshape(dims)
+        si = src[1].reshape(dims)
+        if lx:
+            axes = [axis_of[q] for q in lx]
+            sr = jnp.flip(sr, axes)
+            si = jnp.flip(si, axes)
+        rdt = np.dtype(sr.dtype)
+        ndims = len(dims)
+        gre = gim = None
+        for t in g.terms:
+            pre, pim = _quarter_turn(t.ny % 4, sr, si)
+            lzy = tuple(b for b in t.zy_bits if b < local_n)
+            tabs = _parity_tables(ranges, lzy, rdt)
+            gzy = tuple(b - local_n for b in t.zy_bits if b >= local_n)
+            w = cf[t.index]
+            if gzy:
+                w = w * _device_parity_sign(dev, gzy, amps.dtype)
+            tre = _apply_sign_tables(pre, tabs, ndims) * w
+            tim = _apply_sign_tables(pim, tabs, ndims) * w
+            gre = tre if gre is None else gre + tre
+            gim = tim if gim is None else gim + tim
+        out_re = out_re + gre.reshape(-1)
+        out_im = out_im + gim.reshape(-1)
+    return jnp.stack([out_re, out_im])
 
 
 def _expec_sharded_body(amps, coeffs, *, plan: ExpecPlan, D: int):

@@ -607,6 +607,29 @@ def compose_diag_runs(ops: Sequence, diag_max: int = DIAG_FUSE_MAX
     return [op for _, op in emitted]
 
 
+def fixed_run_plan(ops: Sequence, n: int) -> List:
+    """Band-fuse a CONSTANT op run for the adjoint engine's fixed
+    segments (quest_tpu/adjoint.py): a plain `plan()` call with the
+    adjoint contract asserted up front — every operand concrete (a
+    traced operand would silently become an unfusable PassOp and the
+    backward walk could no longer invert it exactly) and no dynamic
+    ops (measurement/classical control have no inverse stream). The
+    returned items feed circuit._apply_banded_items on both the
+    forward sweep and, rebuilt from the inverted run, the backward
+    walk."""
+    for i, op in enumerate(ops):
+        if op.kind in ("superop", "measure", "measure_dm", "classical",
+                       "relabel"):
+            raise ValueError(
+                f"fixed_run_plan: op {i} ({op.kind}) is not a constant "
+                f"invertible gate")
+        if not _concrete(op.operand):
+            raise ValueError(
+                f"fixed_run_plan: op {i} ({op.kind}) carries a traced "
+                f"operand; the adjoint engine needs concrete gates")
+    return plan(ops, n)
+
+
 def schedule(flat: Sequence, n: int,
              diag_max: int = DIAG_FUSE_MAX) -> Tuple[List, dict]:
     """Commutation-aware reorder + diagonal composition of a FLAT op

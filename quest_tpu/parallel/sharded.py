@@ -127,6 +127,49 @@ def _swap_global_local(chunk, dev, D, gbit, l, local_n):
     return t.reshape(2, -1)
 
 
+def _butterfly_1q(chunk, dev, *, D, local_n, m_pair, gbit, loc_c=(),
+                  loc_s=(), pred=None):
+    """Single-qubit butterfly on GLOBAL bit `gbit` via one full-chunk
+    pair exchange (ref statevec_compactUnitary distributed path,
+    :846-881), sliced per QUEST_EXCHANGE_SLICES with the combine
+    consuming each received slice independently. `m_pair` may be a
+    TRACED (re, im) pair — only scalar selects touch it — which is how
+    the adjoint engine (quest_tpu/adjoint.py) runs parametric rx/ry on
+    a global target without leaving the sharded body."""
+    mybit = (dev >> gbit) & 1
+    mre = jnp.asarray(m_pair[0], dtype=chunk.dtype)
+    mim = jnp.asarray(m_pair[1], dtype=chunk.dtype)
+    # chunk with bit 0 holds "up" amps: new_up = m00*up + m01*lo;
+    # bit 1 holds "lo": new_lo = m10*up + m11*lo
+    dre = jnp.where(mybit == 0, mre[0, 0], mre[1, 1])
+    die = jnp.where(mybit == 0, mim[0, 0], mim[1, 1])
+    ore = jnp.where(mybit == 0, mre[0, 1], mre[1, 0])
+    oie = jnp.where(mybit == 0, mim[0, 1], mim[1, 0])
+
+    def combine(part, recv):
+        re, im = part[0], part[1]
+        rre, rim = recv[0], recv[1]
+        return jnp.stack([
+            dre * re - die * im + ore * rre - oie * rim,
+            dre * im + die * re + ore * rim + oie * rre,
+        ])
+
+    s = C.effective_slices(chunk.shape[-1],
+                           C.topology(D).link_of(gbit, D))
+    if s == 1:
+        recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
+        new = combine(chunk, recv)
+    else:
+        xs = chunk.reshape(2, s, -1)
+        parts = []
+        for i in range(s):
+            recv = lax.ppermute(xs[:, i], AMP_AXIS,
+                                _pair_perm(D, gbit))
+            parts.append(combine(xs[:, i], recv))
+        new = jnp.concatenate(parts, axis=1)
+    return _mask_blend(new, chunk, local_n, loc_c, loc_s, pred)
+
+
 def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
     """General k-qubit matrix gate on the local chunk, distributing over
     global target qubits when needed. Concrete operands with global
@@ -183,43 +226,9 @@ def _matrix_op(chunk, dev, *, D, local_n, m_pair, targets, controls, cstates):
     if route[0] == "butterfly":
         loc_c, loc_s, glob_c = _split_controls(controls, cstates, local_n)
         pred = _global_pred(dev, glob_c)
-        # single-qubit butterfly via one full-chunk pair exchange
-        # (ref statevec_compactUnitary distributed path, :846-881),
-        # sliced per QUEST_EXCHANGE_SLICES with the combine consuming
-        # each received slice independently
-        gbit = route[1]
-        mybit = (dev >> gbit) & 1
-        mre = jnp.asarray(m_pair[0], dtype=chunk.dtype)
-        mim = jnp.asarray(m_pair[1], dtype=chunk.dtype)
-        # chunk with bit 0 holds "up" amps: new_up = m00*up + m01*lo;
-        # bit 1 holds "lo": new_lo = m10*up + m11*lo
-        dre = jnp.where(mybit == 0, mre[0, 0], mre[1, 1])
-        die = jnp.where(mybit == 0, mim[0, 0], mim[1, 1])
-        ore = jnp.where(mybit == 0, mre[0, 1], mre[1, 0])
-        oie = jnp.where(mybit == 0, mim[0, 1], mim[1, 0])
-
-        def combine(part, recv):
-            re, im = part[0], part[1]
-            rre, rim = recv[0], recv[1]
-            return jnp.stack([
-                dre * re - die * im + ore * rre - oie * rim,
-                dre * im + die * re + ore * rim + oie * rre,
-            ])
-
-        s = C.effective_slices(chunk.shape[-1],
-                               C.topology(D).link_of(gbit, D))
-        if s == 1:
-            recv = lax.ppermute(chunk, AMP_AXIS, _pair_perm(D, gbit))
-            new = combine(chunk, recv)
-        else:
-            xs = chunk.reshape(2, s, -1)
-            parts = []
-            for i in range(s):
-                recv = lax.ppermute(xs[:, i], AMP_AXIS,
-                                    _pair_perm(D, gbit))
-                parts.append(combine(xs[:, i], recv))
-            new = jnp.concatenate(parts, axis=1)
-        return _mask_blend(new, chunk, local_n, loc_c, loc_s, pred)
+        return _butterfly_1q(chunk, dev, D=D, local_n=local_n,
+                             m_pair=m_pair, gbit=route[1], loc_c=loc_c,
+                             loc_s=loc_s, pred=pred)
 
     # multi-target with global targets: swap each global target into a local
     # position, apply locally, swap back (ref :1441-1483). Slots not holding

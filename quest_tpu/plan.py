@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-PLAN_FORMAT_VERSION = 1
+PLAN_FORMAT_VERSION = 2   # 2: grad axis (adjoint vs taped capacity row)
 
 # every engine the autotuner can choose between; "pergate" is the
 # semantic-oracle XLA chain, the rest are the fusing/sharded families
@@ -113,6 +113,8 @@ class ProgramPlan:
     f64: dict                  # apply.f64_capacity_stats chunk capacity
     comm: Optional[dict]       # predicted collective schedule (devices=)
     extra: dict                # subsystem extensions (Trotter frames ...)
+    grad: Optional[dict] = None  # adjoint.grad_record: differentiation
+    #                              engine pricing (None: no parameters)
 
     def stats(self) -> dict:
         """The historical `Circuit.plan_stats()` dict, bit-compatible:
@@ -133,6 +135,8 @@ class ProgramPlan:
         rec["f64"] = dict(self.f64)
         if self.comm is not None:
             rec["comm"] = dict(self.comm)
+        if self.grad is not None:
+            rec["grad"] = dict(self.grad)
         return rec
 
     def to_meta(self) -> dict:
@@ -154,8 +158,11 @@ class ProgramPlan:
         cost_s = (f"~{tot:.3g} ms/app" if tot is not None else "unpriced")
         src = {"cache": "cache hit", "search": "searched",
                "build": "unsearched"}.get(self.source, self.source)
+        grad_s = ""
+        if self.grad is not None:
+            grad_s = f", grad={self.grad.get('engine', 'taped')}"
         return (f"plan: engine={self.engine} {cost_s} "
-                f"(incumbent={self.incumbent}, "
+                f"(incumbent={self.incumbent}{grad_s}, "
                 f"{len(self.candidates)} candidate(s), {src}; "
                 f"docs/PLANNING.md)")
 
@@ -242,7 +249,21 @@ def build_plan(circuit, *, density: bool = False,
         planned_ops=len(recs["planned"]), scheduler=recs["scheduler"],
         banded=recs["banded"], fused=recs["fused"],
         batched=recs["batched"], f64=recs["f64"], comm=recs["comm"],
-        extra=_plan_extra(circuit, density))
+        extra=_plan_extra(circuit, density),
+        grad=_grad_record(circuit, density, dtype, devices))
+
+
+def _grad_record(circuit, density: bool, dtype,
+                 devices: Optional[int]) -> Optional[dict]:
+    """The plan IR's grad axis: adjoint vs taped differentiation-engine
+    pricing for this circuit (adjoint.grad_record — capacity rows for
+    both engines plus the engine QUEST_ADJOINT resolves to,
+    incumbent-wins-ties on 'taped'). None when the circuit carries no
+    parametric ops. Imported lazily like every subsystem planner so
+    plan.py stays import-light."""
+    from quest_tpu import adjoint as AD
+    return AD.grad_record(circuit, density=density, dtype=dtype,
+                          devices=devices)
 
 
 def _plan_extra(circuit, density: bool) -> dict:
@@ -500,7 +521,8 @@ def autotune(circuit, state_kind: str = "pure", mesh=None, topology=None,
         planned_ops=len(recs["planned"]), scheduler=recs["scheduler"],
         banded=recs["banded"], fused=recs["fused"],
         batched=recs["batched"], f64=recs["f64"], comm=recs["comm"],
-        extra=_plan_extra(circuit, density))
+        extra=_plan_extra(circuit, density),
+        grad=_grad_record(circuit, density, dtype, devices))
     if persist and key is not None:
         save_plan(plan)
     return plan

@@ -58,20 +58,20 @@ def _mat2(amps, m00, m01, m10, m11):
     return re, im
 
 
-def rx(amps, n, target, theta, controls=()):
+def rx(amps, n, target, theta, controls=(), cstates=()):
     """exp(-i theta/2 X) on `target` (ref rotateX, QuEST_common.c:292)."""
     hh = jnp.asarray(theta, dtype=amps.dtype) / 2.0
     c, s = jnp.cos(hh), jnp.sin(hh)
     pair = _mat2(amps, (c, None), (None, -s), (None, -s), (c, None))
-    return A.apply_matrix(amps, n, pair, (target,), controls)
+    return A.apply_matrix(amps, n, pair, (target,), controls, cstates)
 
 
-def ry(amps, n, target, theta, controls=()):
+def ry(amps, n, target, theta, controls=(), cstates=()):
     """exp(-i theta/2 Y) on `target` (ref rotateY)."""
     hh = jnp.asarray(theta, dtype=amps.dtype) / 2.0
     c, s = jnp.cos(hh), jnp.sin(hh)
     pair = _mat2(amps, (c, None), (-s, None), (s, None), (c, None))
-    return A.apply_matrix(amps, n, pair, (target,), controls)
+    return A.apply_matrix(amps, n, pair, (target,), controls, cstates)
 
 
 def rz(amps, n, target, theta):
@@ -86,9 +86,16 @@ def parity(amps, n, targets: Sequence[int], theta):
     return A.apply_parity_phase(amps, n, tuple(targets), theta)
 
 
-def phase(amps, n, target, theta, controls=()):
-    """diag(1, e^{i theta}) on `target` (ref [controlled]phaseShift)."""
+def phase(amps, n, target, theta, controls=(), cstates=None):
+    """diag(1, e^{i theta}) on `target` (ref [controlled]phaseShift).
+    `cstates` optionally conditions on zero-controls; the default
+    (all-ones) keeps the symmetric phase_on_all_ones fast path."""
     t = jnp.asarray(theta, dtype=amps.dtype)
+    if cstates is not None and any(int(s) == 0 for s in cstates):
+        dre = jnp.stack([jnp.ones((), amps.dtype), jnp.cos(t)])
+        dim = jnp.stack([jnp.zeros((), amps.dtype), jnp.sin(t)])
+        return A.apply_diagonal(amps, n, (dre, dim), (target,),
+                                tuple(controls), tuple(cstates))
     qubits = (target,) + tuple(controls)
     return A.apply_phase_on_all_ones(amps, n, qubits,
                                      (jnp.cos(t), jnp.sin(t)))

@@ -204,6 +204,45 @@ elif mode == "autotune":
         chooser_ranked_right=(
             chosen == min(timed.values()) if timed and
             isinstance(chosen, float) else None))
+elif mode == "grad":
+    # ISSUE 19 satellite: the adjoint differentiation engine on real
+    # silicon — optimizer steps/s of the VQE training step under
+    # whatever engine QUEST_ADJOINT resolves to in THIS process
+    # (0=taped, 1=adjoint, unset=capacity auto), plus gradient parity
+    # against the taped reference so a chip-only numerics drift is
+    # caught in the same session that times it
+    import bench
+    from quest_tpu import adjoint as AD
+    from quest_tpu.ops import expec as E
+    layers = 2 if interpret else 4
+    c = bench._build_vqe_ansatz(n, layers)
+    ham = E.PauliSum.of(*bench._build_tfim_sum(n), n)
+    fn = AD.value_and_grad(c, ham)            # knob-resolved engine
+    th = jnp.asarray(fn.initial_params, jnp.float32)
+    v, g = fn(th)
+    sync(g)
+    steps = 3 if interpret else 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        v, g = fn(th)
+        th = th - 0.05 * g
+    sync(th)
+    dt = (time.perf_counter() - t0) / steps
+    parity = None
+    if fn.engine != "taped":
+        ref = AD.value_and_grad(c, ham, engine="taped")
+        _, gt = ref(jnp.asarray(fn.initial_params, jnp.float32))
+        _, ga = fn(jnp.asarray(fn.initial_params, jnp.float32))
+        parity = float(jnp.max(jnp.abs(ga - gt)))
+    cap = AD.capacity_stats(n, fn.num_params, len(c.ops), np.float32)
+    out(mode=mode, n=n, engine=fn.engine,
+        knob=os.environ.get("QUEST_ADJOINT", "auto"),
+        params=fn.num_params,
+        steps_per_s=round(1.0 / dt, 3),
+        ms_per_step=round(dt * 1e3, 2),
+        adjoint_peak_bytes=cap["adjoint_peak_bytes"],
+        taped_residual_bytes=cap["taped_residual_bytes"],
+        grad_parity=parity)
 else:
     raise SystemExit(f"unknown mode {mode!r}")
 """
@@ -308,6 +347,19 @@ def main():
         v: run("autotune", n, env={"QUEST_APPLY_AUTOROUTE": v},
                reps=reps, interpret=interpret)
         for v in ("1", "0")}
+
+    # 8. the adjoint differentiation engine (ISSUE 19 satellite):
+    # forced-taped vs forced-adjoint vs capacity-auto on the VQE
+    # training step — on chip this measures the steps/s ratio the CPU
+    # host can only model (docs/AUTODIFF.md; the capacity gates live in
+    # scripts/check_adjoint_golden.py). Sized down from the headline n:
+    # the taped leg materializes (P+2) state registers
+    ng = 10 if smoke else min(n, 26)
+    report["grad"] = {
+        v or "auto": run("grad", ng,
+                         env={"QUEST_ADJOINT": v} if v else {},
+                         reps=reps, interpret=interpret)
+        for v in ("0", "1", None)}
 
     print("[ab-silicon] " + json.dumps(report), flush=True)
     print(json.dumps(report, indent=1))

@@ -1,0 +1,438 @@
+"""Adjoint differentiation engine (quest_tpu/adjoint.py,
+docs/AUTODIFF.md): O(1)-memory gradients through the fused sweep
+machinery. Pins gradient parity against the taped (jax.grad) engine and
+finite differences on statevector / density / sharded / f64 registers,
+the as_rotation round-trip for EVERY parametric emitter, loud typed
+rejection of non-invertible circuits, the zero-retrace optimizer-loop
+contract through variational.sweep, comm-plan parity of the backward
+walk against the lowered StableHLO, and the plan IR's grad axis
+(capacity pricing, incumbent-wins-ties)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from quest_tpu import adjoint as AD
+from quest_tpu import evolution as EV
+from quest_tpu import plan as P
+from quest_tpu import variational as V
+from quest_tpu.circuit import Circuit, as_rotation
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.ops import expec as E
+from quest_tpu.parallel.introspect import parse_collectives
+
+from .helpers import max_mesh_devices
+
+
+def _tfim(n, h=0.6):
+    codes, cf = [], []
+    for i in range(n - 1):
+        row = [0] * n
+        row[i] = row[i + 1] = 3
+        codes.append(row)
+        cf.append(-1.0)
+    for i in range(n):
+        row = [0] * n
+        row[i] = 1
+        codes.append(row)
+        cf.append(-h)
+    return E.PauliSum.of(np.array(codes), np.array(cf), n)
+
+
+def _rand_ansatz(n, layers=2, seed=0):
+    """Every parametric family the adjoint walk differentiates, mixed
+    with constant entanglers: the parity-sweep stress shape."""
+    rng = np.random.default_rng(seed)
+    a = lambda: float(rng.uniform(-np.pi, np.pi))
+    c = Circuit(n)
+    for _ in range(layers):
+        for q in range(n):
+            c.ry(q, a())
+        for q in range(0, n - 1, 2):
+            c.cnot(q, q + 1)
+        c.rx(0, a()).rz(1, a()).phase(2 % n, a())
+        c.multi_rotate_z((0, n - 1), a())
+        c.cphase(a(), 0, 1)
+        c.multi_rotate_pauli((0, 1), (1, 2), a())
+        c.h(n - 1)
+    return c
+
+
+def _fd(fn, theta, eps=1e-5):
+    th = np.asarray(theta, np.float64)
+    g = np.zeros_like(th)
+    for i in range(th.size):
+        up, dn = th.copy(), th.copy()
+        up[i] += eps
+        dn[i] -= eps
+        g[i] = (float(fn(up)[0]) - float(fn(dn)[0])) / (2 * eps)
+    return g
+
+
+# -- gradient parity ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_adjoint_matches_taped_statevector(seed):
+    n = 4
+    c = _rand_ansatz(n, layers=2, seed=seed)
+    ham = _tfim(n)
+    adj = AD.value_and_grad(c, ham, engine="adjoint")
+    tap = AD.value_and_grad(c, ham, engine="taped")
+    th = jnp.asarray(adj.initial_params, jnp.float32)
+    va, ga = adj(th)
+    vt, gt = tap(th)
+    assert adj.num_params == tap.num_params > 0
+    np.testing.assert_allclose(float(va), float(vt), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gt), atol=2e-6)
+
+
+def test_adjoint_matches_fd_f64():
+    n = 4
+    c = _rand_ansatz(n, layers=1, seed=3)
+    ham = _tfim(n)
+    adj = AD.value_and_grad(c, ham, engine="adjoint", dtype=np.float64)
+    th = np.asarray(adj.initial_params, np.float64)
+    _, g = adj(jnp.asarray(th))
+    np.testing.assert_allclose(np.asarray(g), _fd(adj, th), atol=1e-9)
+
+
+def test_adjoint_density_matches_statevector():
+    """Unitary circuit: density-register gradients equal the pure-state
+    engine's (both copies of each gate share one parameter slot)."""
+    n = 3
+    c = _rand_ansatz(n, layers=1, seed=4)
+    ham = _tfim(n)
+    sv = AD.value_and_grad(c, ham, engine="adjoint")
+    dm = AD.value_and_grad(c, ham, engine="adjoint", density=True)
+    dm_t = AD.value_and_grad(c, ham, engine="taped", density=True)
+    th = jnp.asarray(sv.initial_params, jnp.float32)
+    v_sv, g_sv = sv(th)
+    v_dm, g_dm = dm(th)
+    v_dt, g_dt = dm_t(th)
+    np.testing.assert_allclose(float(v_dm), float(v_sv), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_dm), np.asarray(g_sv),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_dm), np.asarray(g_dt),
+                               atol=1e-5)
+
+
+def test_adjoint_sharded_matches_single_device():
+    ndev = max_mesh_devices(2)
+    if ndev < 2:
+        pytest.skip("needs >= 2 devices")
+    n = 5
+    c = _rand_ansatz(n, layers=2, seed=5)
+    ham = _tfim(n)
+    mesh = Mesh(np.array(jax.devices()[:2]), (AMP_AXIS,))
+    one = AD.value_and_grad(c, ham, engine="adjoint")
+    two = AD.value_and_grad(c, ham, engine="adjoint", mesh=mesh)
+    th = jnp.asarray(one.initial_params, jnp.float32)
+    v1, g1 = one(th)
+    v2, g2 = two(th)
+    np.testing.assert_allclose(float(v2), float(v1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), atol=1e-6)
+
+
+def test_adjoint_from_nonzero_basis_state():
+    n = 4
+    c = _rand_ansatz(n, layers=1, seed=6)
+    ham = _tfim(n)
+    adj = AD.value_and_grad(c, ham, engine="adjoint", initial_index=5)
+    tap = AD.value_and_grad(c, ham, engine="taped", initial_index=5)
+    th = jnp.asarray(adj.initial_params, jnp.float32)
+    np.testing.assert_allclose(np.asarray(adj(th)[1]),
+                               np.asarray(tap(th)[1]), atol=2e-6)
+
+
+# -- the as_rotation round-trip (every parametric emitter) -------------------
+
+
+EMITTERS = [
+    ("rx", lambda c, a: c.rx(1, a), "rx"),
+    ("ry", lambda c, a: c.ry(1, a), "ry"),
+    ("rz", lambda c, a: c.rz(1, a), "parity"),
+    ("phase", lambda c, a: c.phase(1, a), "phase"),
+    ("multi_rotate_z", lambda c, a: c.multi_rotate_z((0, 2), a),
+     "parity"),
+    ("cphase", lambda c, a: c.cphase(a, 0, 2), "allones"),
+    ("controlled-rx", lambda c, a: c.cu(
+        _rot(a, (1.0, 0.0, 0.0)), 1, 0), "rx"),
+    ("controlled-ry", lambda c, a: c.cu(
+        _rot(a, (0.0, 1.0, 0.0)), 2, 0, cstates=(0,)), "ry"),
+]
+
+
+def _rot(angle, axis):
+    from quest_tpu.ops import matrices as M
+    return np.asarray(M.rotation(angle, axis))
+
+
+@pytest.mark.parametrize("name,emit,family",
+                         EMITTERS, ids=[e[0] for e in EMITTERS])
+def test_as_rotation_roundtrip(name, emit, family):
+    """Every angle-taking emitter round-trips through as_rotation with
+    the original angle recovered, INCLUDING controlled variants — and
+    the recovered parametrization differentiates to the taped truth."""
+    angle = 0.37
+    c = emit(Circuit(3), angle)
+    params = [as_rotation(op) for op in c.ops
+              if as_rotation(op) is not None]
+    assert len(params) == 1, f"{name} must emit exactly one parameter"
+    fam, theta = params[0]
+    assert fam == family
+    # phase/allones store the angle mod 2pi; rx/ry recover over the
+    # full 4pi matrix period
+    assert np.isclose(theta % (2 * np.pi), angle % (2 * np.pi),
+                      atol=1e-12)
+    ham = _tfim(3)
+    adj = AD.value_and_grad(c, ham, engine="adjoint")
+    tap = AD.value_and_grad(c, ham, engine="taped")
+    th = jnp.asarray(adj.initial_params, jnp.float32)
+    np.testing.assert_allclose(np.asarray(adj(th)[1]),
+                               np.asarray(tap(th)[1]), atol=1e-6)
+
+
+def test_multi_rotate_pauli_roundtrips_through_basis_changes():
+    """multi_rotate_pauli decomposes into basis rotations around one
+    parity core — and every one of them round-trips as a rotation (the
+    +-pi/2 basis changes are generic Rx/Ry matrices, so the adjoint
+    walk differentiates them too: 5 parameter slots, the user's angle
+    at the parity core). Pinned so a change to the decomposition
+    surfaces here instead of silently renumbering gradients."""
+    c = Circuit(3).multi_rotate_pauli((0, 1, 2), (1, 2, 3), 0.81)
+    params = [as_rotation(op) for op in c.ops
+              if as_rotation(op) is not None]
+    assert [f for f, _ in params] == ["ry", "rx", "parity", "ry", "rx"]
+    assert np.isclose(params[2][1], 0.81)
+    # the basis pairs invert each other: angles cancel pairwise
+    assert np.isclose(params[0][1], -params[3][1])
+    assert np.isclose(params[1][1], -params[4][1])
+
+
+# -- loud rejection ----------------------------------------------------------
+
+
+def test_adjoint_rejects_measurement_naming_the_op():
+    c = Circuit(3).h(0).measure(1).rx(0, 0.5)
+    with pytest.raises(AD.AdjointError, match=r"op 1.*measure"):
+        AD.build_circuit_program(c, density=False)
+
+
+def test_adjoint_rejects_classical_control():
+    """Every gate_if circuit also holds the measure that feeds it, so
+    the classical naming path is pinned on a hand-built op stream."""
+    from quest_tpu.circuit import GateOp
+    from quest_tpu.ops import matrices as M
+    c = Circuit(3).rx(2, 0.3)
+    inner = GateOp("matrix", (1,), (), (), np.asarray(M.PAULI_X))
+    c.ops.append(GateOp("classical", (1,), (), (),
+                        ((inner,), ((0, 1),))))
+    with pytest.raises(AD.AdjointError,
+                       match=r"op 1.*classically-controlled"):
+        AD.build_circuit_program(c, density=False)
+
+
+def test_adjoint_rejects_non_concrete_operand():
+    from quest_tpu.circuit import GateOp
+    c = Circuit(2).rx(0, 0.4)
+    c.ops.append(GateOp("matrix", (1,), (), (),
+                        np.empty((2, 2), dtype=object)))
+    with pytest.raises(AD.AdjointError, match="op 1"):
+        AD.build_circuit_program(c, density=False)
+
+
+def test_adjoint_rejects_unsupported_shard_targets():
+    mesh = Mesh(np.array(jax.devices()[:2]), (AMP_AXIS,))
+    spec = _tfim(3)
+    ansatz = EV.trotter_ansatz(spec, order=2, steps=1)
+    with pytest.raises(AD.AdjointError, match="sharded trotter"):
+        AD.value_and_grad(ansatz, spec, mesh=mesh)
+    c = _rand_ansatz(3, layers=1, seed=7)
+    with pytest.raises(AD.AdjointError, match="density"):
+        AD.value_and_grad(c, spec, density=True, mesh=mesh)
+
+
+def test_grad_record_reports_unsupported_not_raises():
+    c = Circuit(3).rx(0, 0.5).measure(1)
+    rec = AD.grad_record(c)
+    assert rec["supported"] is False and rec["engine"] == "taped"
+    assert "measure" in rec["reason"]
+
+
+# -- zero-retrace optimizer loop ---------------------------------------------
+
+
+def test_equal_specs_return_the_identical_callable():
+    n = 4
+    ham = _tfim(n)
+    f1 = AD.value_and_grad(_rand_ansatz(n, seed=8), ham,
+                           engine="adjoint")
+    f2 = AD.value_and_grad(_rand_ansatz(n, seed=8), ham,
+                           engine="adjoint")
+    assert f1 is f2
+    f3 = AD.value_and_grad(_rand_ansatz(n, seed=9), ham,
+                           engine="adjoint")
+    assert f3 is not f1
+
+
+def test_zero_retrace_optimizer_loop(compile_auditor):
+    """An optimizer loop that REBUILDS circuit + hamiltonian + grad
+    function every iteration compiles nothing after warmup: equal specs
+    hit adjoint's value-keyed function cache, and variational.sweep's
+    value-keyed program cache keys on fn.sweep_key."""
+    n = 4
+
+    def build():
+        return AD.value_and_grad(_rand_ansatz(n, seed=10), _tfim(n),
+                                 engine="adjoint")
+
+    f0 = build()
+    th = jnp.asarray(f0.initial_params, jnp.float32)
+    # one FULL warm iteration (grad program, swept batch, and the tiny
+    # eager update ops — each eager jnp op traces once too)
+    _v, g = f0(th)
+    V.sweep(f0, jnp.stack([th, th * 0.9]))
+    th = th - 0.05 * g
+    with compile_auditor as aud:
+        for _ in range(3):
+            fn = build()                          # rebuilt every step
+            _v, g = fn(th)
+            vals = V.sweep(fn, jnp.stack([th, th * 0.9]))
+            th = th - 0.05 * g
+        assert np.isfinite(np.asarray(vals[0])).all()
+    assert aud.traces == 0, (
+        f"rebuilt equal grad specs must retrace nothing, "
+        f"traced {aud.traces}")
+
+
+# -- trotter ansatz ----------------------------------------------------------
+
+
+def test_trotter_grads_match_taped_and_incumbent():
+    n = 4
+    spec = _tfim(n)
+    ansatz = EV.trotter_ansatz(spec, order=2, steps=2)
+    adj = AD.value_and_grad(ansatz, spec, engine="adjoint")
+    tap = AD.value_and_grad(ansatz, spec, engine="taped")
+    cf = jnp.asarray(np.asarray(spec.coeffs), jnp.float32)
+    params = (cf, jnp.asarray(0.08, jnp.float32))
+    va, ga = adj(params)
+    vt, gt = tap(params)
+    np.testing.assert_allclose(float(va), float(vt), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ga[0]), np.asarray(gt[0]),
+                               atol=5e-6)
+    np.testing.assert_allclose(float(ga[1]), float(gt[1]), atol=5e-5)
+    # and the incumbent expectation path agrees on the value
+    e = V.expectation(ansatz, n, spec)
+    v_inc = e((cf, jnp.asarray(0.08, jnp.float32)))
+    np.testing.assert_allclose(float(va), float(v_inc), atol=1e-6)
+
+
+def test_trotter_imag_time_rejected():
+    spec = _tfim(3)
+    ansatz = EV.trotter_ansatz(spec, order=1, steps=1, imag_time=True)
+    with pytest.raises(AD.AdjointError, match="imag"):
+        AD.value_and_grad(ansatz, spec, engine="adjoint")
+
+
+@pytest.mark.slow
+def test_trotter_30q_tfim_grad_smoke():
+    """The paper's training width: one 30q TFIM gradient step through
+    the adjoint engine — the width where taped CANNOT run ((P+2) state
+    registers ~ 500 GB; adjoint holds 3). Value finite, gradients
+    finite and nonzero."""
+    n = 30
+    spec = _tfim(n)
+    cap = AD.capacity_stats(n, 2 * n - 1, 4 * n, np.float32)
+    assert not cap["taped_fits"] and cap["adjoint_peak_bytes"] < (
+        4 * cap["state_bytes"] + (1 << 20))
+    ansatz = EV.trotter_ansatz(spec, order=1, steps=1)
+    adj = AD.value_and_grad(ansatz, spec, engine="adjoint")
+    cf = jnp.asarray(np.asarray(spec.coeffs), jnp.float32)
+    v, (g_cf, g_dt) = adj((cf, jnp.asarray(0.05, jnp.float32)))
+    assert np.isfinite(float(v))
+    g = np.asarray(g_cf)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+# -- comm-plan parity --------------------------------------------------------
+
+
+def test_backward_walk_comm_plan_matches_hlo():
+    """The predicted collective schedule of one value_and_grad
+    application (forward + seed + backward walk) equals the lowered
+    StableHLO's accounting exactly — the plan->predict->assert
+    discipline extended to the gradient program."""
+    if max_mesh_devices(2) < 2:
+        pytest.skip("needs >= 2 devices")
+    n = 5
+    c = _rand_ansatz(n, layers=2, seed=11)
+    ham = _tfim(n)
+    mesh = Mesh(np.array(jax.devices()[:2]), (AMP_AXIS,))
+    fn = AD.value_and_grad(c, ham, engine="adjoint", mesh=mesh)
+    assert fn.comm_record is not None
+    th = jnp.asarray(fn.initial_params, jnp.float32)
+    got = parse_collectives(fn.jitted.lower(th).as_text(),
+                            num_devices=2)
+    for key in ("collective_permutes", "all_to_alls", "all_reduces"):
+        assert got[key] == fn.comm_record[key], (
+            f"{key}: predicted {fn.comm_record[key]}, "
+            f"lowered HLO has {got[key]}")
+
+
+# -- the plan IR grad axis ---------------------------------------------------
+
+
+def test_plan_grad_axis_prices_both_engines(monkeypatch):
+    # 8q, not smaller: below that the O(masks) term dominates the
+    # 3-register adjoint peak and neither engine fits a between-peaks
+    # budget (the model is honest about it — taped stays incumbent)
+    c = _rand_ansatz(8, layers=2, seed=12)
+    plan = P.autotune(c, persist=False)
+    g = plan.grad
+    assert g["supported"] and g["params"] == c_num_params(c)
+    assert g["incumbent"] == "taped"
+    assert g["taped"]["residual_bytes"] == (
+        (g["params"] + 2) * 2 * (1 << 8) * 4)
+    # taped fits at 8q -> incumbent-wins-ties keeps taped
+    assert g["engine"] == "taped"
+    # shrink the modeled HBM below taped's residuals: auto flips
+    mid = (AD.capacity_stats(8, g["params"], g["depth"])
+           ["adjoint_peak_bytes"]
+           + g["taped"]["residual_bytes"]) // 2
+    monkeypatch.setenv("QUEST_HBM_BYTES", str(mid))
+    g2 = P.autotune(c, persist=False).grad
+    assert g2["engine"] == "adjoint" and not g2["taped"]["fits"]
+    # the knob overrides the pricing in both directions
+    monkeypatch.setenv("QUEST_ADJOINT", "1")
+    monkeypatch.delenv("QUEST_HBM_BYTES")
+    assert P.autotune(c, persist=False).grad["engine"] == "adjoint"
+    monkeypatch.setenv("QUEST_ADJOINT", "0")
+    assert P.autotune(c, persist=False).grad["engine"] == "taped"
+
+
+def c_num_params(c):
+    return sum(1 for op in c.ops if as_rotation(op) is not None)
+
+
+def test_knob_resolves_the_engine(monkeypatch):
+    n, ham = 4, _tfim(4)
+    c = _rand_ansatz(n, seed=13)
+    monkeypatch.setenv("QUEST_ADJOINT", "1")
+    assert AD.value_and_grad(c, ham).engine == "adjoint"
+    monkeypatch.setenv("QUEST_ADJOINT", "0")
+    assert AD.value_and_grad(c, ham).engine == "taped"
+    monkeypatch.delenv("QUEST_ADJOINT")
+    # auto at 4q: taped fits -> incumbent wins
+    assert AD.value_and_grad(c, ham).engine == "taped"
+
+
+def test_capacity_model_is_depth_independent():
+    a = AD.capacity_stats(18, 10, 50)
+    b = AD.capacity_stats(18, 1000, 5000)
+    assert a["adjoint_peak_bytes"] == b["adjoint_peak_bytes"]
+    assert b["taped_residual_bytes"] > 50 * a["state_bytes"]

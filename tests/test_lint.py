@@ -585,6 +585,47 @@ def test_autoroute_knob_is_keyed_with_flips():
         k.parse(k.malformed)
 
 
+def test_adjoint_knob_registry_coverage(tmp_path):
+    """QUEST_ADJOINT coverage of the registry rules (ISSUE 19): a
+    registry read (knob_value) on a jit-reachable path passes QL001
+    because the knob is registered KEYED (value_and_grad folds
+    engine_mode_key into its program key, so flipping the gradient
+    engine re-keys every cached callable); a direct os.environ read
+    of the same knob fires QL004's bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_ADJOINT") == "1":
+                return amps
+            return amps * 2
+
+        def configure():
+            return os.environ.get("QUEST_ADJOINT")
+    """, name="adjointknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and "bypasses" in q4[0].message, vs
+
+
+def test_adjoint_knob_is_keyed_with_flips():
+    """The adjoint knob must stay keyed (it selects which gradient
+    program value_and_grad builds — flipping it mid-process must miss
+    every cached grad callable and every cached plan, the zero-retrace
+    contract of the optimizer-loop acceptance) and its parser must
+    reject anything outside auto/0/1 loudly."""
+    from quest_tpu.env import KNOBS
+    k = KNOBS["QUEST_ADJOINT"]
+    assert k.scope == "keyed" and k.layer == "planner"
+    assert k.flips == ("auto", "1")
+    assert k.default == "auto"
+    with pytest.raises(ValueError):
+        k.parse(k.malformed)
+
+
 def test_serve_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
     serve knobs are RUNTIME scope — read once at ServeEngine

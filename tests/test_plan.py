@@ -86,15 +86,21 @@ def test_plan_stats_emits_the_historical_shape():
     want = ["scheduled", "flat_ops", "planned_ops", "scheduler", "banded"]
     if PB.usable(6):
         want.append("fused")
-    want += ["batched", "f64", "comm"]
+    # "grad" (PR 19) rides at the end: parametric circuits price the
+    # differentiation engine; parameter-free circuits drop the section
+    want += ["batched", "f64", "comm", "grad"]
     assert list(rec) == want
     assert rec["flat_ops"] >= len(c.ops)
     assert rec["banded"]["full_state_passes"] >= 1
     assert rec["comm"]["devices"] == devices
     assert rec["batched"]["bucket"] == 4      # 3 rounds up on pow2 grid
+    assert rec["grad"]["incumbent"] == "taped"
     # no-devices / no-batch variants drop exactly those sections
     rec2 = c.plan_stats()
     assert "comm" not in rec2 and "batched" not in rec2
+    # parameter-free circuit: no grad axis
+    free = Circuit(3).h(0).cnot(0, 1)
+    assert "grad" not in free.plan_stats()
 
 
 def test_build_plan_is_the_one_home_of_plan_stats():
