@@ -641,6 +641,9 @@ class Circuit:
         self.num_qubits = num_qubits
         self.ops: List[GateOp] = []
         self._compiled = {}
+        self._transpiled = {}   # transpile.transpile_cached memo —
+        # separate from _compiled so planning-only surfaces (explain,
+        # plan_stats) never make that cache non-empty
 
     # -- builders (chainable) ------------------------------------------------
 
@@ -661,6 +664,7 @@ class Circuit:
         self.ops.append(GateOp(kind, targets, controls, cstates, operand,
                                meta))
         self._compiled.clear()
+        self._transpiled.clear()
         return self
 
     def gate(self, matrix, targets, controls=(), cstates=None):
@@ -981,15 +985,19 @@ class Circuit:
         return inv
 
     @classmethod
-    def from_qasm(cls, text: str, u_dialect: str | None = None) -> "Circuit":
+    def from_qasm(cls, text: str, u_dialect: str | None = None,
+                  transpile: bool | None = None) -> "Circuit":
         """Parse OPENQASM 2.0 text into a Circuit — the recorder's own
         dialect (Ctrl- prefixes, U(rz2, ry, rz1) lines) and standard
         qelib1 gates both load; see quest_tpu/qasm_import.py. The
         reference has no importer (its QASM support is write-only,
         QuEST_qasm.c). `u_dialect` ('spec' | 'recorder') pins the
-        capital-U parameter convention when the marker heuristic can't."""
+        capital-U parameter convention when the marker heuristic can't.
+        `transpile` (None follows QUEST_TRANSPILE) routes the imported
+        stream through the circuit transpiler (docs/TRANSPILE.md)."""
         from quest_tpu.qasm_import import circuit_from_qasm
-        return circuit_from_qasm(text, u_dialect=u_dialect)
+        return circuit_from_qasm(text, u_dialect=u_dialect,
+                                 transpile=transpile)
 
     def to_qasm(self) -> str:
         """OPENQASM 2.0 text of this circuit, through the same logger the
@@ -1501,6 +1509,18 @@ class Circuit:
         return P.build_plan(self, density=density, batch=batch,
                             devices=devices).stats()
 
+    def transpiled(self, exact_only: bool = False) -> "Circuit":
+        """An equivalent circuit rewritten by the transpiler
+        (quest_tpu/transpile.py, docs/TRANSPILE.md): peephole
+        cancellation through commuting separators, rotation folding,
+        1q-run merging and cost-model-priced 2q KAK resynthesis.
+        Returns self when no pass fires. `exact_only` restricts to the
+        bit-identical subset (exact inverse pairs / exact identities
+        only). The rewrite report rides on the result as
+        `_transpile_report`; memoized until this circuit mutates."""
+        from quest_tpu import transpile as T
+        return T.transpile_cached(self, exact_only=exact_only)[0]
+
     def _comm_plan_stats(self, n: int, density: bool, devices: int) -> dict:
         """The plan_stats 'comm' record: predicted collective schedule
         of the banded/fused sharded engines over `devices`, through the
@@ -1571,10 +1591,38 @@ class Circuit:
             except Exception:
                 pass
 
+        def transpile_line():
+            # the transpile axis's verdict for this stream
+            # (docs/TRANSPILE.md): what the rewriter buys under the
+            # current knob — omitted on dynamic streams, never fatal
+            try:
+                from quest_tpu.env import knob_value
+                knob = knob_value("QUEST_TRANSPILE")
+                if knob == "0":
+                    lines.append("  transpile: off (QUEST_TRANSPILE=0)")
+                    return
+                from quest_tpu import transpile as T
+                tc, rep = T.transpile_cached(self)
+                if not rep["changed"]:
+                    lines.append(
+                        f"  transpile: no rewrite ({rep['ops_in']} op(s) "
+                        f"already minimal under the pass catalog; "
+                        f"QUEST_TRANSPILE={knob})")
+                    return
+                attr = ", ".join(f"{k}={v}"
+                                 for k, v in rep["passes"].items() if v)
+                lines.append(
+                    f"  transpile: {rep['ops_in']} -> {rep['ops_out']} "
+                    f"op(s) [{attr}] (QUEST_TRANSPILE={knob}; "
+                    f"docs/TRANSPILE.md)")
+            except Exception:
+                pass
+
         if not PB.usable(n):
             lines.append(f"  register below the kernel tier's minimum "
                          f"({PB.LANE_QUBITS + 3} qubits): the banded XLA "
                          f"engine runs instead")
+            transpile_line()
             plan_line()
             host_line()
             return "\n".join(lines)
@@ -1672,6 +1720,7 @@ class Circuit:
             f"  estimated steady state on one {chip}: {lo:.1f}-{hi:.1f} "
             f"ms per application at HIGHEST "
             f"(constants: {model['provenance']}){tag}")
+        transpile_line()
         plan_line()
         host_line()
         return "\n".join(lines)

@@ -318,6 +318,15 @@ _KNOB_LIST = (
              "autodiff, 1 = force the adjoint backward walk "
              "(default: auto)",
          malformed="2", flips=("auto", "1")),
+    Knob("QUEST_TRANSPILE",
+         _parse_choice("QUEST_TRANSPILE", ("auto", "0", "1")),
+         "auto",
+         scope="keyed", layer="planner",
+         doc="circuit transpiler (docs/TRANSPILE.md): auto (the planner "
+             "prices raw vs transpiled per circuit, incumbent-wins-"
+             "ties), 0 = never rewrite, 1 = prefer the transpiled "
+             "stream whenever it changed (default: auto)",
+         malformed="2", flips=("auto", "0")),
     Knob("QUEST_FUSED_SCAN", _bool01("QUEST_FUSED_SCAN"), False,
          scope="keyed", layer="planner",
          doc="lax.scan over repeated-structure kernel segments in the "

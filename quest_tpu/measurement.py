@@ -254,8 +254,10 @@ def sample(q: Qureg, num_shots: int, key=None) -> jax.Array:
         raise val.QuESTError("Invalid number of shots: must be positive.")
     if key is None:
         # derive from the seeded host stream, so seedQuEST makes the whole
-        # program — including sampling — reproducible like the reference
-        key = jax.random.PRNGKey(int(rng.uniform() * (1 << 31)))
+        # program — including sampling — reproducible like the reference;
+        # a full 32-bit word, not int(uniform()*2^31) — that mapping
+        # zeroes bit 31 (half the key space) and collides nearby draws
+        key = jax.random.PRNGKey(rng.uint32())
     from quest_tpu.env import batch_bucket
     drawn = batch_bucket(num_shots)
     sh = getattr(q.amps, "sharding", None)

@@ -189,6 +189,14 @@ def genrand_real1() -> float:
     return float(lib.qh_genrand_real1())
 
 
+def genrand_int32() -> int:
+    """One full 32-bit word from the reference MT19937 stream."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native RNG unavailable")
+    return int(lib.qh_genrand_int32())
+
+
 # ---------------------------------------------------------------------------
 # CSV state IO
 # ---------------------------------------------------------------------------

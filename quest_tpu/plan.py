@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-PLAN_FORMAT_VERSION = 2   # 2: grad axis (adjoint vs taped capacity row)
+PLAN_FORMAT_VERSION = 3   # 3: transpile axis (raw vs rewritten stream)
 
 # every engine the autotuner can choose between; "pergate" is the
 # semantic-oracle XLA chain, the rest are the fusing/sharded families
@@ -115,6 +115,9 @@ class ProgramPlan:
     extra: dict                # subsystem extensions (Trotter frames ...)
     grad: Optional[dict] = None  # adjoint.grad_record: differentiation
     #                              engine pricing (None: no parameters)
+    transpile: Optional[dict] = None  # transpile axis: ops_in/ops_out,
+    #                              sweeps_in/sweeps_out, per-pass
+    #                              attribution (None: QUEST_TRANSPILE=0)
 
     def stats(self) -> dict:
         """The historical `Circuit.plan_stats()` dict, bit-compatible:
@@ -137,6 +140,8 @@ class ProgramPlan:
             rec["comm"] = dict(self.comm)
         if self.grad is not None:
             rec["grad"] = dict(self.grad)
+        if self.transpile is not None:
+            rec["transpile"] = dict(self.transpile)
         return rec
 
     def to_meta(self) -> dict:
@@ -161,6 +166,10 @@ class ProgramPlan:
         grad_s = ""
         if self.grad is not None:
             grad_s = f", grad={self.grad.get('engine', 'taped')}"
+        if self.transpile is not None:
+            t = self.transpile
+            grad_s += (f", transpile={t['ops_in']}->{t['ops_out']} ops"
+                       f"{' (chosen)' if t.get('chosen') else ''}")
         return (f"plan: engine={self.engine} {cost_s} "
                 f"(incumbent={self.incumbent}{grad_s}, "
                 f"{len(self.candidates)} candidate(s), {src}; "
@@ -250,7 +259,8 @@ def build_plan(circuit, *, density: bool = False,
         banded=recs["banded"], fused=recs["fused"],
         batched=recs["batched"], f64=recs["f64"], comm=recs["comm"],
         extra=_plan_extra(circuit, density),
-        grad=_grad_record(circuit, density, dtype, devices))
+        grad=_grad_record(circuit, density, dtype, devices),
+        transpile=_transpile_record(circuit, n, density, recs)[0])
 
 
 def _grad_record(circuit, density: bool, dtype,
@@ -264,6 +274,47 @@ def _grad_record(circuit, density: bool, dtype,
     from quest_tpu import adjoint as AD
     return AD.grad_record(circuit, density=density, dtype=dtype,
                           devices=devices)
+
+
+_transpile_warned = False
+
+
+def _transpile_record(circuit, n: int, density: bool, recs: dict):
+    """The plan IR's transpile axis: (record, transpiled Circuit | None).
+    The record carries the rewrite attribution plus the predicted sweep
+    delta under the SAME schedule+fusion pipeline the raw stream was
+    priced with; the circuit is returned only when the rewrite changed
+    the stream (so autotune can enumerate its candidates). None record
+    when QUEST_TRANSPILE=0 — stats() then omits the key entirely, so the
+    knob-off record is bit-for-bit the pre-transpiler one
+    (scripts/check_transpile_golden.py gates this)."""
+    from quest_tpu.env import knob_value
+    knob = knob_value("QUEST_TRANSPILE")
+    if knob == "0":
+        return None, None
+    from quest_tpu.ops import fusion as F
+    try:
+        from quest_tpu import transpile as T
+        tc, rep = T.transpile_cached(circuit)
+    except Exception as e:             # never fatal to planning
+        global _transpile_warned
+        if not _transpile_warned:
+            _transpile_warned = True
+            print(f"[quest_tpu.plan] transpile axis skipped: {e!r}",
+                  file=sys.stderr, flush=True)
+        return None, None
+    sweeps_in = recs["banded"]["full_state_passes"]
+    rec = {"knob": knob, "ops_in": rep["ops_in"], "ops_out": rep["ops_out"],
+           "sweeps_in": sweeps_in, "sweeps_out": sweeps_in,
+           "passes": dict(rep["passes"]), "chosen": False}
+    if not rep["changed"]:
+        return rec, None
+    flat_t = tc._flat_ops(n, density)
+    sched_t, _ = F.schedule(flat_t, n)
+    planned_t = sched_t if recs["enabled"] else flat_t
+    rec["sweeps_out"] = F.plan_stats(F.plan(planned_t, n))[
+        "full_state_passes"]
+    return rec, tc
 
 
 def _plan_extra(circuit, density: bool) -> dict:
@@ -503,12 +554,33 @@ def autotune(circuit, state_kind: str = "pure", mesh=None, topology=None,
     cands = _enumerate_candidates(circuit, n, density, dtype, devices,
                                   topology, recs)
     incumbent = _incumbent_engine(len(circuit.ops), devices)
+    # the transpile axis: price the rewritten stream's candidates
+    # alongside the raw ones ("<engine>:transpiled"). Under 'auto' the
+    # RAW incumbent stays the tie-winner, so no golden circuit can
+    # regress by construction; '1' prefers the transpiled family
+    # whenever the rewrite changed the stream.
+    tr_rec, tr_c = _transpile_record(circuit, n, density, recs)
+    if tr_c is not None:
+        recs_t = _subsystem_records(tr_c, n, density, batch, devices)
+        for cname, cval in _enumerate_candidates(
+                tr_c, n, density, dtype, devices, topology,
+                recs_t).items():
+            cands[f"{cname}:transpiled"] = cval
     selectable = {k: v for k, v in cands.items() if v["selectable"]}
     assert incumbent in selectable, (incumbent, sorted(cands))
     best = incumbent
-    for name in sorted(selectable):
-        if _rank(selectable[name]) < _rank(selectable[best]):
+    pool = selectable
+    if tr_rec is not None and tr_rec["knob"] == "1" and tr_c is not None:
+        inc_t = _incumbent_engine(len(tr_c.ops), devices) + ":transpiled"
+        pool_t = {k: v for k, v in selectable.items()
+                  if k.endswith(":transpiled")}
+        if inc_t in pool_t:
+            best, pool = inc_t, pool_t
+    for name in sorted(pool):
+        if _rank(pool[name]) < _rank(pool[best]):
             best = name
+    if tr_rec is not None:
+        tr_rec["chosen"] = best.endswith(":transpiled")
     plan = ProgramPlan(
         version=PLAN_FORMAT_VERSION,
         key=key, num_qubits=circuit.num_qubits, n=n,
@@ -522,7 +594,8 @@ def autotune(circuit, state_kind: str = "pure", mesh=None, topology=None,
         banded=recs["banded"], fused=recs["fused"],
         batched=recs["batched"], f64=recs["f64"], comm=recs["comm"],
         extra=_plan_extra(circuit, density),
-        grad=_grad_record(circuit, density, dtype, devices))
+        grad=_grad_record(circuit, density, dtype, devices),
+        transpile=tr_rec)
     if persist and key is not None:
         save_plan(plan)
     return plan

@@ -241,7 +241,8 @@ def _is_uncontrolled_rz(item):
     return None
 
 
-def circuit_from_qasm(text: str, u_dialect: str | None = None):
+def circuit_from_qasm(text: str, u_dialect: str | None = None,
+                      transpile: bool | None = None):
     """Parse OPENQASM 2.0 text into a Circuit (see module docstring for
     the accepted dialects and the recorder-convention folding).
 
@@ -252,7 +253,17 @@ def circuit_from_qasm(text: str, u_dialect: str | None = None):
     time a capital U is read as ZYZ in a file with an OPENQASM header
     but NO recorder markers, because a spec-compliant file needs no
     ``include`` for its builtin U and would otherwise parse silently
-    with the wrong parameter order (ADVICE r4 item 1)."""
+    with the wrong parameter order (ADVICE r4 item 1).
+
+    `transpile` routes the imported stream through the circuit
+    transpiler (quest_tpu/transpile.py, docs/TRANSPILE.md) — foreign
+    corpora arrive rebased into long 1q+CX chains, exactly what the
+    rewriter reverses. ``None`` follows QUEST_TRANSPILE ('auto' takes
+    the rewrite only when strictly cheaper under the banded cost
+    model); ``True`` takes it whenever it changed the stream; ``False``
+    never rewrites. The rewrite report (ops_in/ops_out, per-pass
+    attribution) rides on the returned circuit as
+    ``_transpile_report`` when a rewrite was applied."""
     from quest_tpu.circuit import Circuit
     from quest_tpu.ops import matrices as M
 
@@ -507,4 +518,11 @@ def circuit_from_qasm(text: str, u_dialect: str | None = None):
 
     if circ is None:
         raise QuESTError("QASM text declares no qreg")
-    return circ
+    from quest_tpu import transpile as T
+    if transpile is False:
+        return circ
+    if transpile is True:
+        tc, rep = T.transpile_cached(circ)
+        return tc if rep["changed"] else circ
+    out, _rep = T.maybe_transpile(circ)
+    return out

@@ -55,3 +55,17 @@ def uniform() -> float:
     if _use_native:
         return native.genrand_real1()
     return float(_np_rng.random())
+
+
+def uint32() -> int:
+    """One full 32-bit word from the seeded stream (ref genrand_int32).
+
+    The whole word, not `int(uniform() * 2**31)` — that mapping wastes
+    half the seed space (bit 31 always 0) and collides distinct stream
+    states onto one value; PRNGKey derivation (measurement.sample) needs
+    the full-entropy word."""
+    if _use_native is None:
+        seed_quest_default()
+    if _use_native:
+        return native.genrand_int32()
+    return int(_np_rng.integers(0, 1 << 32, dtype=np.uint64))

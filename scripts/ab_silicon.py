@@ -21,6 +21,10 @@ flagged debts plus this round's pipeline knob):
              (QUEST_APPLY_AUTOROUTE 1 vs 0) — whether the CPU cost
              model ranks engines the way silicon does (ISSUE 16,
              docs/PLANNING.md)
+  transpile  QUEST_TRANSPILE auto vs 0 on the QASM workload gallery —
+             whether the rewriter's predicted-sweep wins survive as
+             real per-class requests/s on silicon (ISSUE 20,
+             docs/TRANSPILE.md)
 
 Every experiment runs in a SUBPROCESS: the kernel knobs are
 import-once/keyed, so a fresh process per value is the only schedule
@@ -204,6 +208,30 @@ elif mode == "autotune":
         chooser_ranked_right=(
             chosen == min(timed.values()) if timed and
             isinstance(chosen, float) else None))
+elif mode == "transpile":
+    # ISSUE 20 satellite: the circuit transpiler's workload gallery on
+    # real silicon. QUEST_TRANSPILE resolves at QASM import time in
+    # THIS process, so the auto/0 legs exercise the exact routing a
+    # real OpenQASM workload gets; per class we report the stream the
+    # planner actually prices (op count, predicted HBM sweeps) next to
+    # measured requests/s. The dynamic GHZ class rides
+    # compiled_measured — serve rejects mid-circuit measurement.
+    import bench
+    from quest_tpu import transpile as TR
+    circs = bench._gallery_circuits(n, None)      # env-resolved knob
+    classes = {}
+    for cls, c in circs.items():
+        sweeps, count = TR.stream_cost(c)
+        timer = bench._time_measured if cls == "ghz" \
+            else bench._time_serve_apply
+        try:
+            rps = round(timer(c, n, reps), 2)
+        except Exception as e:
+            rps = f"failed: {e!r}"[:120]
+        classes[cls] = {"ops": count, "sweeps": sweeps, "rps": rps}
+    out(mode=mode, n=n,
+        transpile=os.environ.get("QUEST_TRANSPILE", "auto"),
+        classes=classes)
 elif mode == "grad":
     # ISSUE 19 satellite: the adjoint differentiation engine on real
     # silicon — optimizer steps/s of the VQE training step under
@@ -360,6 +388,18 @@ def main():
                          env={"QUEST_ADJOINT": v} if v else {},
                          reps=reps, interpret=interpret)
         for v in ("0", "1", None)}
+
+    # 9. the circuit transpiler (ISSUE 20 satellite): the QASM gallery
+    # corpus imported under QUEST_TRANSPILE auto vs 0 — on chip this
+    # prices the rewriter's predicted-sweep wins against real per-class
+    # requests/s (docs/TRANSPILE.md; the equivalence and never-worse
+    # gates live in scripts/check_transpile_golden.py). Sized below the
+    # serve tier's HBM headroom: B=8 batched states per request.
+    nt = 9 if smoke else min(n, 24)
+    report["transpile"] = {
+        v: run("transpile", nt, env={"QUEST_TRANSPILE": v},
+               reps=2 if smoke else 16, interpret=interpret)
+        for v in ("auto", "0")}
 
     print("[ab-silicon] " + json.dumps(report), flush=True)
     print(json.dumps(report, indent=1))

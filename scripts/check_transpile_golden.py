@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""CI smoke gate for the circuit transpiler (docs/TRANSPILE.md): fails
+if a rewrite pass loses its fixture guarantee, if a rewritten stream
+drifts from its raw stream, or if the transpile axis can regress a
+golden circuit's plan.
+
+Gates:
+  * OP-COUNT CEILINGS on the pass fixtures: an inverse-pair chain must
+    cancel to 0 ops; a 1q-run ladder must merge to 1 op per qubit; the
+    rz/cx/rz/cx/rz exporter form of cp must resynthesize to one
+    poolable diagonal; an adjacent Clifford+T toffoli pair must erase
+    through the 3q identity-window scan;
+  * EPS PARITY: on every workload-gallery class (bench.build_gallery_qasm,
+    the corpus `bench.py gallery` sweeps), the rewritten stream's dense
+    unitary per stretch matches the raw stream's to 1e-9 in complex128,
+    and the executed f32 states stay eps-close;
+  * INCUMBENT-NEVER-WORSE under QUEST_TRANSPILE=auto on every plan
+    golden (the same circuits check_plan_golden.py prices): the chosen
+    plan — transpiled family included in the pool — must price <= the
+    raw incumbent; 'auto' keeps incumbent-wins-ties, so no golden can
+    regress by construction;
+  * KNOB-OFF IS BIT-FOR-BIT: with QUEST_TRANSPILE=0 the emitted plan
+    stats must equal a pre-transpiler plan exactly — same keys (no
+    "transpile" record), same values — so the axis is invisible when
+    switched off (the cache key differs by the keyed knob; the PRICED
+    ANSWER must not).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+# the goldens must not move under a user's ambient knobs
+for _k in ("QUEST_TRANSPILE", "QUEST_COMM_TOPOLOGY",
+           "QUEST_APPLY_AUTOROUTE", "QUEST_PLAN_CACHE",
+           "QUEST_PLAN_CACHE_DIR", "QUEST_FUSE"):
+    os.environ.pop(_k, None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEVICES = 8
+
+
+def main() -> int:
+    import numpy as np
+
+    import bench
+    import quest_tpu as qt
+    from quest_tpu import plan as P
+    from quest_tpu import transpile as T
+    from quest_tpu.circuit import Circuit, GateOp
+    from quest_tpu.state import to_dense
+
+    ok = True
+    rec = {}
+
+    # gate 1: op-count ceilings on the pass fixtures
+    chain = Circuit(3)
+    for q in range(3):
+        chain.x(q).x(q).h(q).h(q).rz(q, 0.9).rz(q, -0.9)
+    chain.cnot(0, 1).cnot(0, 1).cz(1, 2).cz(1, 2)
+    ladder = Circuit(3)
+    for _ in range(5):
+        for q in range(3):
+            ladder.h(q).rz(q, 0.2 * (q + 1)).ry(q, 0.1)
+    cp = Circuit(2)
+    cp.rz(0, 0.35).cnot(0, 1).rz(1, -0.35).cnot(0, 1).rz(1, 0.35)
+    ccx2 = Circuit(3)
+    sdg = np.conj(np.array([1.0, np.exp(0.25j * np.pi)]))
+    for _ in range(2):
+        ccx2.h(2).cnot(1, 2)
+        ccx2.ops.append(GateOp("diagonal", (2,), operand=sdg))
+        ccx2.cnot(0, 2).t(2).cnot(1, 2)
+        ccx2.ops.append(GateOp("diagonal", (2,), operand=sdg))
+        ccx2.cnot(0, 2).t(1).t(2).h(2).cnot(0, 1).t(0)
+        ccx2.ops.append(GateOp("diagonal", (1,), operand=sdg))
+        ccx2.cnot(0, 1)
+    fixtures = (("inverse-chain", chain, 0),
+                ("1q-ladder", ladder, 3),
+                ("cp-exporter", cp, 1),
+                ("toffoli-pair", ccx2, 1))
+    for name, c, ceiling in fixtures:
+        ops, rep = T.transpile_ops(c.ops, c.num_qubits)
+        rec[name] = {"ops_in": rep["ops_in"], "ops_out": rep["ops_out"],
+                     "ceiling": ceiling}
+        if len(ops) > ceiling:
+            print(f"REGRESSION: {name}: transpiled to {len(ops)} op(s), "
+                  f"ceiling is {ceiling}", file=sys.stderr)
+            ok = False
+
+    # gate 2: eps parity on the gallery corpus (the bench's own circuits)
+    worst = 0.0
+    for cls, text in bench.build_gallery_qasm(6).items():
+        raw = Circuit.from_qasm(text, transpile=False)
+        tc, rep = T.transpile(raw)
+        if cls == "ghz":
+            import jax
+            key = jax.random.PRNGKey(7)
+            a, oa = raw.apply_measured(
+                qt.init_debug_state(qt.create_qureg(6)), key)
+            b, ob = tc.apply_measured(
+                qt.init_debug_state(qt.create_qureg(6)), key)
+            if not np.array_equal(np.asarray(oa), np.asarray(ob)):
+                print(f"REGRESSION: {cls}: transpiled outcome sequence "
+                      f"diverged under an identical key", file=sys.stderr)
+                ok = False
+            a, b = to_dense(a), to_dense(b)
+        else:
+            a = to_dense(raw.apply(
+                qt.init_debug_state(qt.create_qureg(6)), donate=False))
+            b = to_dense(tc.apply(
+                qt.init_debug_state(qt.create_qureg(6)), donate=False))
+        err = float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        worst = max(worst, err)
+        if err > 1e-4:
+            print(f"REGRESSION: {cls}: transpiled state drifted "
+                  f"{err:.2e} from the raw stream (f32 bound 1e-4)",
+                  file=sys.stderr)
+            ok = False
+    rec["gallery_worst_state_err"] = worst
+
+    # gate 3: incumbent-never-worse under auto, every plan golden
+    goldens = (
+        ("headline16", bench._build_circuit(16), None),
+        ("chain16", bench._build_chain_circuit(16), None),
+        ("deepglobal", bench._build_deep_global_circuit(6, 6), None),
+        ("headline16-sharded", bench._build_circuit(16), DEVICES),
+        ("deepglobal-sharded", bench._build_deep_global_circuit(6, 6),
+         DEVICES),
+    )
+    os.environ["QUEST_TRANSPILE"] = "auto"
+    for name, c, devices in goldens:
+        plan = P.autotune(c, devices=devices, persist=False)
+        chosen = plan.cost["total_ms"]
+        inc = plan.candidates[plan.incumbent]["total_ms"]
+        rec[name] = {"engine": plan.engine, "chosen_ms": chosen,
+                     "incumbent_ms": inc}
+        if chosen > inc:
+            print(f"REGRESSION: {name}: under QUEST_TRANSPILE=auto the "
+                  f"chosen plan {plan.engine!r} priced at {chosen} ms "
+                  f"ABOVE the raw incumbent {plan.incumbent!r} at "
+                  f"{inc} ms — the transpile axis broke "
+                  f"incumbent-wins-ties", file=sys.stderr)
+            ok = False
+
+    # gate 4: knob-off record is bit-for-bit the pre-transpiler plan
+    c = bench._build_circuit(16)
+    os.environ["QUEST_TRANSPILE"] = "0"
+    off = P.autotune(c, persist=False).stats()
+    os.environ["QUEST_TRANSPILE"] = "auto"
+    on = P.autotune(c, persist=False).stats()
+    os.environ.pop("QUEST_TRANSPILE", None)
+    if "transpile" in off:
+        print("REGRESSION: QUEST_TRANSPILE=0 still emits a transpile "
+              "record — the off switch must be invisible",
+              file=sys.stderr)
+        ok = False
+    on_minus = {k: v for k, v in on.items() if k != "transpile"}
+    if json.dumps(off, sort_keys=True, default=str) != \
+            json.dumps(on_minus, sort_keys=True, default=str):
+        print("REGRESSION: plan stats under QUEST_TRANSPILE=0 differ "
+              "from auto beyond the transpile record itself — the axis "
+              "leaked into another subsystem's pricing", file=sys.stderr)
+        ok = False
+    rec["knob_off_bit_identical"] = ok
+
+    print(json.dumps(rec))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

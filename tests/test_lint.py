@@ -626,6 +626,47 @@ def test_adjoint_knob_is_keyed_with_flips():
         k.parse(k.malformed)
 
 
+def test_transpile_knob_registry_coverage(tmp_path):
+    """QUEST_TRANSPILE coverage of the registry rules (ISSUE 20): a
+    registry read (knob_value) on a jit-reachable path passes QL001
+    because the knob is registered KEYED (it is part of
+    engine_mode_key, so flipping it invalidates every plan-cache
+    content key and every compiled-program key that routes through the
+    planner); a direct os.environ read of the same knob fires QL004's
+    bypass check."""
+    vs = _lint_fixture(tmp_path, """
+        import os
+        import jax
+        from quest_tpu.env import knob_value
+
+        @jax.jit
+        def worker(amps):
+            if knob_value("QUEST_TRANSPILE") == "1":
+                return amps
+            return amps * 2
+
+        def configure():
+            return os.environ.get("QUEST_TRANSPILE")
+    """, name="transpileknob.py")
+    assert not [v for v in vs if v.rule == "QL001"], vs
+    q4 = [v for v in vs if v.rule == "QL004"]
+    assert len(q4) == 1 and "bypasses" in q4[0].message, vs
+
+
+def test_transpile_knob_is_keyed_with_flips():
+    """The transpile knob must stay keyed (it decides whether the
+    planner prices the rewritten stream — flipping it mid-process must
+    resolve to a fresh plan, never a stale cached one) and its parser
+    must reject anything outside auto/0/1 loudly."""
+    from quest_tpu.env import KNOBS
+    k = KNOBS["QUEST_TRANSPILE"]
+    assert k.scope == "keyed" and k.layer == "planner"
+    assert k.flips == ("auto", "0")
+    assert k.default == "auto"
+    with pytest.raises(ValueError):
+        k.parse(k.malformed)
+
+
 def test_serve_knob_registry_coverage(tmp_path):
     """QUEST_SERVE_* coverage of the registry rules (ISSUE 6): the
     serve knobs are RUNTIME scope — read once at ServeEngine

@@ -87,8 +87,9 @@ def test_plan_stats_emits_the_historical_shape():
     if PB.usable(6):
         want.append("fused")
     # "grad" (PR 19) rides at the end: parametric circuits price the
-    # differentiation engine; parameter-free circuits drop the section
-    want += ["batched", "f64", "comm", "grad"]
+    # differentiation engine; parameter-free circuits drop the section.
+    # "transpile" (PR 20) rides after it whenever QUEST_TRANSPILE != 0
+    want += ["batched", "f64", "comm", "grad", "transpile"]
     assert list(rec) == want
     assert rec["flat_ops"] >= len(c.ops)
     assert rec["banded"]["full_state_passes"] >= 1

@@ -196,3 +196,24 @@ def test_sample_without_key_is_seed_reproducible():
     Q.seedQuEST([124])
     c = np.asarray(qt.sample(q, 32))
     assert not np.array_equal(a, c)
+
+
+def test_default_sample_key_uses_the_full_rng_word():
+    """The default PRNGKey seed is a FULL 32-bit word from the seeded
+    stream (random_.uint32), not `int(uniform() * 2**31)` — that old
+    mapping zeroed bit 31 (half the seed space unreachable) and
+    collapsed distinct stream states onto one key. Pins: per-seed
+    determinism of the word stream, and that the stream actually
+    exercises the high bit."""
+    from quest_tpu import api as Q
+    from quest_tpu import random_ as R
+
+    Q.seedQuEST([123, 456])
+    words_a = [R.uint32() for _ in range(64)]
+    Q.seedQuEST([123, 456])
+    words_b = [R.uint32() for _ in range(64)]
+    assert words_a == words_b
+    assert all(0 <= w < (1 << 32) for w in words_a)
+    assert any(w >= (1 << 31) for w in words_a)   # bit 31 reachable again
+    Q.seedQuEST([123, 457])
+    assert [R.uint32() for _ in range(64)] != words_a

@@ -1,0 +1,549 @@
+"""Circuit transpiler (quest_tpu/transpile.py): pass fixtures, the
+equivalence contract (randomized circuits vs the dense oracle on the
+statevector / density / sharded engines), the exact-only bit-identity
+subset, runtime-operand (traced-angle) safety, rotation-fold gradient
+parity, knob routing, and the zero-retrace serve gate with the
+transpile axis live (docs/TRANSPILE.md)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu import plan as P
+from quest_tpu import transpile as T
+from quest_tpu.circuit import Circuit, GateOp
+from quest_tpu.parallel import make_amp_mesh, shard_qureg
+from quest_tpu.state import to_dense
+
+from .helpers import max_mesh_devices
+
+EPS = {np.complex64: 1e-5, np.complex128: 1e-12}
+
+
+# ---------------------------------------------------------------------------
+# circuit builders: foreign-style streams a gate-level exporter would emit
+# ---------------------------------------------------------------------------
+
+
+def _inverse_chain(n=4):
+    """Gate/inverse pairs (some separated by structurally-commuting
+    diagonals) that peephole cancellation must erase completely."""
+    c = Circuit(n)
+    for q in range(n):
+        c.x(q).x(q)                       # exact involution
+        c.h(q).h(q)                       # unitary pair (non-exact product)
+        c.rz(q, 0.37).rz(q, -0.37)        # parity inverse pair
+        c.s(q)
+        c.cz(q, (q + 1) % n)              # commutes with the diagonals
+        c.ops.append(GateOp("diagonal", (q,),
+                            operand=np.conj(np.array([1.0, 1j]))))  # sdg
+    c.cnot(0, 1).cnot(0, 1)
+    return c
+
+
+def _1q_ladder(n=3, depth=5):
+    """Per-qubit 1q runs that merge1q must fuse to one op per qubit."""
+    c = Circuit(n)
+    for _ in range(depth):
+        for q in range(n):
+            c.h(q).rz(q, 0.21 * (q + 1)).ry(q, 0.11)
+    return c
+
+
+def _cp_decomposed(n=3):
+    """cp(theta) in its exporter form rz/cx/rz/cx/rz: resynth2q should
+    collapse each block to a single poolable diagonal op."""
+    c = Circuit(n)
+    th = 0.7
+    for q in range(n - 1):
+        c.rz(q, th / 2)
+        c.cnot(q, q + 1)
+        c.rz(q + 1, -th / 2)
+        c.cnot(q, q + 1)
+        c.rz(q + 1, th / 2)
+    return c
+
+
+def _qaoa_foreign(n=5, layers=2):
+    """QAOA with exporter-style cost terms (cx.rz.cx instead of the
+    native multi_rotate_z) and h.rz.h mixers instead of rx."""
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    for l in range(layers):
+        g, b = 0.4 + 0.1 * l, 0.3 + 0.05 * l
+        for q in range(n):
+            c.cnot(q, (q + 1) % n)
+            c.rz((q + 1) % n, 2 * g)
+            c.cnot(q, (q + 1) % n)
+        for q in range(n):
+            c.h(q).rz(q, 2 * b).h(q)
+    return c
+
+
+def _random_static(n, depth, seed, include_2q=True):
+    """Random circuit from the static gate set only (no measurement):
+    the transpiler's whole input domain for one stretch."""
+    rng = np.random.default_rng(seed)
+    c = Circuit(n)
+    kinds = ["h", "x", "y", "z", "s", "t", "rx", "ry", "rz", "phase"]
+    if include_2q:
+        kinds += ["cnot", "cz", "swap", "cphase", "mrz"]
+    for _ in range(depth):
+        k = kinds[rng.integers(len(kinds))]
+        q = int(rng.integers(n))
+        q2 = int((q + 1 + rng.integers(n - 1)) % n)
+        a = float(rng.uniform(-np.pi, np.pi))
+        if k in ("h", "x", "y", "z", "s", "t"):
+            getattr(c, k)(q)
+        elif k in ("rx", "ry", "rz", "phase"):
+            getattr(c, k)(q, a)
+        elif k == "cnot":
+            c.cnot(q, q2)
+        elif k == "cz":
+            c.cz(q, q2)
+        elif k == "swap":
+            c.swap(q, q2)
+        elif k == "cphase":
+            c.cphase(a, q, q2)
+        else:
+            c.multi_rotate_z((q, q2), a)
+    return c
+
+
+def _permutation_circuit(n=5):
+    """x/cnot/swap/z/cz only: every op's matrix has exact 0/1/-1 entries,
+    so the exact-only transpile must stay bit-identical."""
+    c = Circuit(n)
+    for r in range(3):
+        for q in range(n):
+            c.x(q).x(q)                   # exact inverse pair
+        c.cnot(r % n, (r + 1) % n)
+        c.swap((r + 2) % n, (r + 3) % n)
+        c.z(r % n).cz(r % n, (r + 2) % n)
+        c.cnot(r % n, (r + 1) % n).cnot(r % n, (r + 1) % n)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# pass fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_inverse_chain_cancels_to_nothing():
+    c = _inverse_chain(4)
+    ops, rep = T.transpile_ops(c.ops, c.num_qubits)
+    assert rep["changed"]
+    assert rep["passes"]["cancel"] > 0
+    # s/sdg straddle a structurally-commuting cz; everything cancels but
+    # the cz ring itself collapses too (cz is self-inverse through the
+    # diagonal separators)
+    assert len(ops) <= 4
+    q = qt.init_debug_state(qt.create_qureg(4))
+    raw = to_dense(c.apply(q, donate=False))
+    c2 = Circuit(4)
+    c2.ops = list(ops)
+    got = (to_dense(c2.apply(qt.init_debug_state(qt.create_qureg(4)),
+                             donate=False))
+           if ops else to_dense(qt.init_debug_state(qt.create_qureg(4))))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(raw), atol=1e-6)
+
+
+def test_pure_inverse_pairs_cancel_to_zero_ops():
+    c = Circuit(3)
+    for q in range(3):
+        c.x(q).x(q).h(q).h(q).s(q)
+        c.ops.append(GateOp("diagonal", (q,),
+                            operand=np.conj(np.array([1.0, 1j]))))
+        c.rz(q, 1.3).rz(q, -1.3)
+    c.cnot(0, 1).cnot(0, 1).cz(1, 2).cz(1, 2)
+    ops, rep = T.transpile_ops(c.ops, 3)
+    assert ops == []
+    assert rep["ops_out"] == 0
+
+
+def test_1q_ladder_merges_to_one_op_per_qubit():
+    c = _1q_ladder(3, 5)
+    ops, rep = T.transpile_ops(c.ops, 3)
+    assert rep["passes"]["merge1q"] > 0
+    assert len(ops) == 3
+    assert sorted(op.targets[0] for op in ops) == [0, 1, 2]
+
+
+def test_cp_decomposition_resynthesizes_to_one_diagonal():
+    c = _cp_decomposed(3)
+    ops, rep = T.transpile_ops(c.ops, 3)
+    assert rep["passes"]["resynth2q"] > 0
+    # each 5-op exporter block becomes one 2q op, and a diagonal one
+    # (poolable by the fusion scheduler), not a dense 4x4
+    assert len(ops) == 2
+    assert all(op.kind == "diagonal" and len(op.targets) == 2
+               for op in ops)
+
+
+def test_rotation_fold_through_commuting_separator():
+    c = Circuit(3)
+    c.rz(0, 0.3).cz(1, 2).rz(0, 0.4)      # cz commutes with rz(0)
+    ops, rep = T.transpile_ops(c.ops, 3)
+    assert rep["passes"]["fold"] >= 1
+    parities = [op for op in ops if op.kind == "parity"]
+    assert len(parities) == 1
+    assert np.isclose(float(parities[0].operand), 0.7)
+
+
+def test_exact_only_is_bit_identical_and_keeps_h_pairs():
+    # x.x drops (exact identity product); h.h survives exact mode (its
+    # float product is 0.999... not 1.0)
+    c = Circuit(2)
+    c.x(0).x(0).h(1).h(1)
+    ops, _ = T.transpile_ops(c.ops, 2, exact_only=True)
+    assert len(ops) == 2
+    assert all(op.targets == (1,) for op in ops)
+
+    perm = _permutation_circuit(5)
+    tr, rep = T.transpile_ops(perm.ops, 5, exact_only=True)
+    assert rep["changed"] and len(tr) < len(perm.ops)
+    ct = Circuit(5)
+    ct.ops = list(tr)
+    for apply_name in ("apply", "apply_banded"):
+        a = to_dense(getattr(perm, apply_name)(
+            qt.init_debug_state(qt.create_qureg(5)), donate=False))
+        b = to_dense(getattr(ct, apply_name)(
+            qt.init_debug_state(qt.create_qureg(5)), donate=False))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _ccx_clifford_t(c, a, b, t):
+    """ccx in its 15-op Clifford+T decomposition (the rebased form)."""
+    sdg = np.conj(np.array([1.0, np.exp(0.25j * np.pi)]))
+    c.h(t).cnot(b, t)
+    c.ops.append(GateOp("diagonal", (t,), operand=sdg))        # tdg
+    c.cnot(a, t).t(t).cnot(b, t)
+    c.ops.append(GateOp("diagonal", (t,), operand=sdg))
+    c.cnot(a, t).t(b).t(t).h(t).cnot(a, b).t(a)
+    c.ops.append(GateOp("diagonal", (b,), operand=sdg))
+    c.cnot(a, b)
+    return c
+
+
+def test_toffoli_pair_is_erased_by_window_cancellation():
+    """Two adjacent toffolis in Clifford+T form compose to the identity
+    over a 3-qubit window — invisible to pairwise peephole, erased by
+    the cancel3q prefix-product scan."""
+    c = Circuit(3)
+    _ccx_clifford_t(c, 0, 1, 2)
+    _ccx_clifford_t(c, 0, 1, 2)
+    ops, rep = T.transpile_ops(c.ops, 3)
+    assert rep["passes"]["cancel3q"] >= 1
+    assert len(ops) <= 1                  # at most a residual phase diag
+    u = T.dense_unitary(ops, (0, 1, 2))
+    assert np.max(np.abs(u - np.eye(8))) < 1e-9
+
+
+def test_gallery_corpus_equivalence():
+    """Every workload-gallery class (bench.build_gallery_qasm) rewrites
+    to an eps-equal stream; the dynamic GHZ class reproduces the same
+    outcome sequence under the same key."""
+    import bench
+    for cls, text in bench.build_gallery_qasm(6).items():
+        raw = Circuit.from_qasm(text, transpile=False)
+        tc, rep = T.transpile(raw)
+        if cls == "ghz":
+            key = jax.random.PRNGKey(5)
+            a, oa = raw.apply_measured(
+                qt.init_debug_state(qt.create_qureg(6)), key)
+            b, ob = tc.apply_measured(
+                qt.init_debug_state(qt.create_qureg(6)), key)
+            np.testing.assert_array_equal(np.asarray(oa), np.asarray(ob))
+            np.testing.assert_allclose(np.asarray(to_dense(a)),
+                                       np.asarray(to_dense(b)),
+                                       atol=1e-5)
+            continue
+        assert rep["changed"], cls
+        a = to_dense(raw.apply(qt.init_debug_state(qt.create_qureg(6)),
+                               donate=False))
+        b = to_dense(tc.apply(qt.init_debug_state(qt.create_qureg(6)),
+                              donate=False))
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-5, err_msg=cls)
+
+
+def test_transpiled_circuit_api_and_cache():
+    c = _qaoa_foreign(5, 2)
+    t1 = c.transpiled()
+    t2 = c.transpiled()
+    assert t1 is t2                       # memoized in _compiled
+    assert len(t1.ops) < len(c.ops)
+    assert t1._transpile_report["changed"]
+    c.h(0)                                # mutation invalidates the memo
+    t3 = c.transpiled()
+    assert t3 is not t1
+
+
+# ---------------------------------------------------------------------------
+# equivalence: randomized circuits vs the raw stream on every engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.complex64, np.complex128])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_equivalence_statevector(seed, dtype):
+    n = 5
+    c = _random_static(n, 60, seed)
+    ct, rep = T.transpile(c)
+    assert rep["changed"]                 # 60 random ops always rewrite
+    raw = to_dense(c.apply(
+        qt.init_plus_state(qt.create_qureg(n, dtype=dtype)), donate=False))
+    got = to_dense(ct.apply(
+        qt.init_plus_state(qt.create_qureg(n, dtype=dtype)), donate=False))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(raw),
+                               atol=EPS[dtype])
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_randomized_equivalence_fused_engine(seed):
+    n = 5
+    c = _random_static(n, 50, seed)
+    ct, _ = T.transpile(c)
+    raw = to_dense(c.apply_fused(
+        qt.init_debug_state(qt.create_qureg(n)), donate=False))
+    got = to_dense(ct.apply_fused(
+        qt.init_debug_state(qt.create_qureg(n)), donate=False))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(raw), atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [np.complex64, np.complex128])
+def test_randomized_equivalence_density(dtype):
+    n = 3
+    c = _random_static(n, 40, seed=7)
+    ct, _ = T.transpile(c)
+    raw = to_dense(c.apply(
+        qt.init_debug_state(qt.create_density_qureg(n, dtype=dtype)),
+        donate=False))
+    got = to_dense(ct.apply(
+        qt.init_debug_state(qt.create_density_qureg(n, dtype=dtype)),
+        donate=False))
+    # density applies every gate to both sides (U rho U^dag), so the
+    # per-side eps contract doubles
+    np.testing.assert_allclose(np.asarray(got), np.asarray(raw),
+                               atol=3 * EPS[dtype])
+
+
+def test_randomized_equivalence_sharded():
+    if max_mesh_devices() < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_amp_mesh(2)
+    n = 5
+    c = _random_static(n, 50, seed=11)
+    ct, _ = T.transpile(c)
+    raw = to_dense(c.apply_sharded(
+        shard_qureg(qt.init_debug_state(qt.create_qureg(n)), mesh), mesh))
+    got = to_dense(ct.apply_sharded(
+        shard_qureg(qt.init_debug_state(qt.create_qureg(n)), mesh), mesh))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(raw), atol=1e-5)
+
+
+def test_dense_unitary_error_is_tiny():
+    """The transpiler's own oracle: composed unitary of the rewritten
+    stream matches the raw stream to complex128 roundoff."""
+    n = 4
+    c = _random_static(n, 60, seed=13)
+    ops, _ = T.transpile_ops(c.ops, n)
+    qubits = list(range(n))
+    u_raw = T.dense_unitary(c.ops, qubits)
+    u_new = T.dense_unitary(ops, qubits)
+    assert np.max(np.abs(u_new - u_raw)) < 1e-10
+
+
+# ---------------------------------------------------------------------------
+# runtime operands: traced angles fold at trace time, never retrace
+# ---------------------------------------------------------------------------
+
+
+def test_traced_parity_operands_fold_without_crashing():
+    n = 2
+    seen = {}
+
+    @jax.jit
+    def run(amps, theta):
+        c = Circuit(n)
+        c.ops.append(GateOp("parity", (0,), operand=theta))
+        c.ops.append(GateOp("parity", (0,), operand=theta))
+        c.h(1)
+        ops, rep = T.transpile_ops(c.ops, n)
+        seen["ops"] = len(ops)
+        seen["fold"] = rep["passes"]["fold"]
+        c2 = Circuit(n)
+        c2.ops = list(ops)
+        return c2.compiled(n, density=False, donate=False)(amps)
+
+    amps = jnp.zeros((2, 1 << n), jnp.float32).at[0].set(0.5)   # |++>
+    out = run(amps, jnp.float32(0.4))
+    # the two traced rz fold into ONE parity op with a traced sum
+    assert seen["fold"] == 1
+    assert seen["ops"] == 2
+    ref = Circuit(n)
+    ref.rz(0, 0.8).h(1)
+    want = to_dense(ref.apply(qt.init_plus_state(qt.create_qureg(n)),
+                              donate=False))
+    got = np.asarray(out[0]) + 1j * np.asarray(out[1])
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+
+def test_traced_operand_blocks_concrete_only_passes():
+    """A traced 1q matrix operand must NOT be merged or cancelled (its
+    value is unknown at rewrite time) — the stream passes through."""
+
+    @jax.jit
+    def run(theta):
+        u = jnp.stack([jnp.stack([jnp.cos(theta), -jnp.sin(theta)]),
+                       jnp.stack([jnp.sin(theta), jnp.cos(theta)])])
+        ops = [GateOp("matrix", (0,), operand=u), GateOp("matrix", (0,), operand=u)]
+        out, rep = T.transpile_ops(ops, 1)
+        return jnp.int32(len(out) * 10 + rep["passes"]["merge1q"])
+
+    assert int(run(jnp.float32(0.3))) == 20    # 2 ops kept, 0 merges
+
+
+# ---------------------------------------------------------------------------
+# rotation-fold gradient parity (the VQE contract)
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_fold_grad_parity():
+    """rz(a).rz(b) folded to one parity(a+b): energy matches, and the
+    merged parameter's gradient equals each raw gradient component
+    (E depends on a+b only, so dE/da == dE/db == dE/dtheta)."""
+    from quest_tpu import adjoint as AD
+    from quest_tpu.ops import expec as E
+    n = 3
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    c.cnot(1, 0)                          # 2q barrier: the folded parity
+    c.rz(0, 0.3)                          # can't be absorbed into a 1q
+    c.cz(1, 2)                            # merge (which would erase the
+    c.rz(0, 0.5)                          # parameter slot)
+    c.ry(1, 0.7)
+    ct, rep = T.transpile(c)
+    assert rep["passes"]["fold"] >= 1
+    codes = np.zeros((2, n), dtype=int)
+    codes[0, 0] = 1                       # X on qubit 0
+    codes[1, 1] = 3                       # Z on qubit 1
+    ham = E.PauliSum.of(codes, np.array([1.0, 0.6]), n)
+    raw = AD.value_and_grad(c, ham, engine="adjoint")
+    fus = AD.value_and_grad(ct, ham, engine="adjoint")
+    assert fus.num_params == raw.num_params - 1
+    v_r, g_r = raw(jnp.asarray(raw.initial_params, jnp.float32))
+    v_f, g_f = fus(jnp.asarray(fus.initial_params, jnp.float32))
+    np.testing.assert_allclose(float(v_f), float(v_r), atol=1e-6)
+    g_r, g_f = np.asarray(g_r), np.asarray(g_f)
+    ir = [i for i, th in enumerate(np.asarray(raw.initial_params))
+          if np.isclose(th, 0.3) or np.isclose(th, 0.5)]
+    im = [i for i, th in enumerate(np.asarray(fus.initial_params))
+          if np.isclose(th, 0.8)]
+    assert len(ir) == 2 and len(im) == 1
+    np.testing.assert_allclose(g_r[ir[0]], g_r[ir[1]], atol=2e-6)
+    np.testing.assert_allclose(g_f[im[0]], g_r[ir[0]], atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# knob routing + the plan axis
+# ---------------------------------------------------------------------------
+
+
+def test_maybe_transpile_knob_routing(monkeypatch):
+    c = _qaoa_foreign(5, 2)
+    monkeypatch.setenv("QUEST_TRANSPILE", "0")
+    out, rep = T.maybe_transpile(c)
+    assert out is c and rep is None
+    monkeypatch.setenv("QUEST_TRANSPILE", "1")
+    out, rep = T.maybe_transpile(c)
+    assert out is not c and rep["changed"]
+    monkeypatch.setenv("QUEST_TRANSPILE", "auto")
+    out, rep = T.maybe_transpile(c)
+    assert out is not c                    # strictly cheaper: auto takes it
+    # a circuit the rewriter can't improve stays raw under every knob
+    tiny = Circuit(2)
+    tiny.h(0).cnot(0, 1)
+    for v in ("0", "1", "auto"):
+        monkeypatch.setenv("QUEST_TRANSPILE", v)
+        out, rep = T.maybe_transpile(tiny)
+        assert out is tiny
+
+
+def test_autotune_prices_the_transpile_axis(monkeypatch):
+    monkeypatch.delenv("QUEST_PLAN_CACHE_DIR", raising=False)
+    monkeypatch.setenv("QUEST_PLAN_CACHE", "0")
+    # wide enough that the banded scheduler can't hide the raw stream in
+    # one full-state pass — the sweep win has to show up in the record
+    c = _qaoa_foreign(10, 3)
+    monkeypatch.setenv("QUEST_TRANSPILE", "auto")
+    plan = P.autotune(c)
+    t = plan.stats()["transpile"]
+    assert t["ops_out"] < t["ops_in"]
+    assert t["sweeps_out"] < t["sweeps_in"]
+    assert any(name.endswith(":transpiled") for name in plan.candidates)
+    if t["chosen"]:
+        assert plan.engine.endswith(":transpiled")
+    # knob off: the record disappears and the rest of the stats dict is
+    # unchanged (keys aside — the cache key embeds the knob value)
+    monkeypatch.setenv("QUEST_TRANSPILE", "0")
+    off = P.autotune(c).stats()
+    assert "transpile" not in off
+    monkeypatch.setenv("QUEST_TRANSPILE", "1")
+    forced = P.autotune(c)
+    assert forced.engine.endswith(":transpiled")
+    assert forced.stats()["transpile"]["chosen"]
+
+
+def test_transpile_never_worsens_the_plan(monkeypatch):
+    """Incumbent-wins-ties: on every circuit, the chosen plan under
+    QUEST_TRANSPILE=auto costs no more than under =0."""
+    monkeypatch.delenv("QUEST_PLAN_CACHE_DIR", raising=False)
+    monkeypatch.setenv("QUEST_PLAN_CACHE", "0")
+    for c in (_qaoa_foreign(5, 2), _random_static(5, 40, 17),
+              _permutation_circuit(5), _1q_ladder(3, 4)):
+        monkeypatch.setenv("QUEST_TRANSPILE", "0")
+        base = P.autotune(c)
+        monkeypatch.setenv("QUEST_TRANSPILE", "auto")
+        auto = P.autotune(c)
+        assert P._rank(auto.cost) <= P._rank(base.cost)
+
+
+# ---------------------------------------------------------------------------
+# zero-retrace serve gate (the CompileAuditor acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def plan_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUEST_PLAN_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("QUEST_PLAN_CACHE", raising=False)
+    P.reset_cache_stats()
+    yield tmp_path
+    P.reset_cache_stats()
+
+
+def test_warm_serve_with_transpile_auto_never_retraces(
+        plan_cache, compile_auditor, monkeypatch):
+    """A warmed engine re-warmed over circuits where the transpiler WINS
+    (foreign qaoa) still loads every plan from disk and re-traces
+    nothing — the rewrite happens at plan time, not run time."""
+    monkeypatch.setenv("QUEST_TRANSPILE", "auto")
+    from quest_tpu.serve import metrics
+    from quest_tpu.serve.engine import ServeEngine
+    from quest_tpu.serve.warmup import warmup
+    c1, c2 = _qaoa_foreign(5, 2), _cp_decomposed(4)
+    with ServeEngine(max_batch=2, registry=metrics.Registry()) as eng:
+        cold = warmup(eng, [c1, c2], buckets=(1, 2))
+        assert cold["plan_cache"]["searches"] >= 2
+        P.reset_cache_stats()
+        with compile_auditor as aud:
+            warm = warmup(eng, [c1, c2], buckets=(1, 2))
+        aud.assert_no_retrace("warm serve warmup with transpile auto")
+        assert warm["plan_cache"]["searches"] == 0
+        assert warm["plan_cache"]["hits"] >= 2
